@@ -1,0 +1,48 @@
+"""ringpop_tpu — a TPU-native framework with the capabilities of Uber's ringpop.
+
+SWIM gossip membership + consistent hash ring + request routing, rebuilt from
+scratch for TPU: membership state lives in (sharded) device arrays, protocol
+periods run as `lax.scan` steps, gossip exchange is a batched gather/scatter
+over an N-node axis, and the FarmHash-based membership/ring checksums are
+computed by bit-exact hash kernels so results can be verified against the
+Node.js reference (reference layout: /root/reference, ringpop v10.9.6).
+
+Package layout
+--------------
+- ``ops``      — hash kernels (FarmHash32: C++ host oracle, numpy batch,
+                 in-jit JAX, Pallas TPU), checksum-string encoding, ring table
+                 kernels.
+- ``models``   — the protocol "models": membership state machine, hash ring,
+                 gossip engine (dissemination/suspicion/iterator/join), and
+                 the batched cluster simulator.
+- ``parallel`` — device-mesh sharding of the N-node axis (jax.sharding.Mesh,
+                 shard_map), collectives helpers.
+- ``utils``    — config store, typed errors, stats (statsd-style + meters and
+                 histograms), logging nulls, misc helpers.
+- ``api``      — the Ringpop facade (bootstrap/lookup/whoami/handleOrProxy/
+                 proxyReq/getStats...), admin control plane, request proxy,
+                 tracer subsystem, CLI and tick-cluster harness.
+
+Int64 note: SWIM incarnation numbers in the reference are `Date.now()`
+millisecond timestamps (member.js:80), which do not fit in int32.  The
+simulator therefore requires JAX x64 mode; importing this package enables it
+(before any array is created) unless RINGPOP_TPU_NO_X64 is set.
+"""
+
+import os as _os
+
+if not _os.environ.get("RINGPOP_TPU_NO_X64"):
+    import jax as _jax
+
+    _jax.config.update("jax_enable_x64", True)
+
+__version__ = "0.1.0"
+
+from ringpop_tpu.utils.config import Config  # noqa: E402
+from ringpop_tpu.utils import errors  # noqa: E402
+
+__all__ = [
+    "Config",
+    "errors",
+    "__version__",
+]

@@ -1,0 +1,2 @@
+"""Hash and kernel ops: FarmHash32 (host oracle, numpy batch, in-jit JAX,
+Pallas TPU), checksum-string encoding, and ring-table kernels."""

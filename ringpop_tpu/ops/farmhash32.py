@@ -1,0 +1,361 @@
+"""Bit-exact FarmHash32 (the ``farmhashmk::Hash32`` variant).
+
+The reference hashes every ring replica point, ring checksum, key lookup and
+membership checksum with the npm ``farmhash`` addon's ``hash32``
+(/root/reference/lib/ring/index.js:21,29,102,146 and
+/root/reference/lib/membership/index.js:24,65).  That addon wraps Google
+FarmHash; both ``farmhash::Hash32`` (portable build, no -msse4 flags — the
+node-gyp default) and ``farmhash::Fingerprint32`` dispatch to
+``farmhashmk::Hash32``, so farmhashmk is the variant to match.
+
+This module provides:
+
+- :func:`hash32` — scalar pure-Python implementation (the readable spec).
+- :func:`hash32_batch` — numpy-vectorized implementation over a padded
+  ``[B, L] uint8`` byte matrix with per-row lengths.  This is the host-side
+  batch oracle used by tests and by host ring/membership code.
+
+A C++ shared-library twin lives in ``ringpop_tpu/ops/_native`` (the native
+oracle, matching the reference's native-addon substrate), and an in-jit JAX
+twin in :mod:`ringpop_tpu.ops.jax_farmhash`.
+
+All four implementations are cross-checked in tests/ops/test_farmhash32.py
+over every length class (0-4, 5-12, 13-24, >24, multi-block).
+"""
+
+from __future__ import annotations
+
+from typing import Sequence, Tuple, Union
+
+import numpy as np
+
+C1 = 0xCC9E2D51
+C2 = 0x1B873593
+MASK = 0xFFFFFFFF
+
+
+# ---------------------------------------------------------------------------
+# Scalar pure-Python implementation (readable spec; python ints mod 2^32)
+# ---------------------------------------------------------------------------
+
+def _rot32(x: int, r: int) -> int:
+    """Right-rotate, matching FarmHash's Rotate32."""
+    if r == 0:
+        return x & MASK
+    return ((x >> r) | (x << (32 - r))) & MASK
+
+
+def _fmix(h: int) -> int:
+    h &= MASK
+    h ^= h >> 16
+    h = (h * 0x85EBCA6B) & MASK
+    h ^= h >> 13
+    h = (h * 0xC2B2AE35) & MASK
+    h ^= h >> 16
+    return h
+
+
+def _mur(a: int, h: int) -> int:
+    a = (a * C1) & MASK
+    a = _rot32(a, 17)
+    a = (a * C2) & MASK
+    h ^= a
+    h = _rot32(h, 19)
+    return (h * 5 + 0xE6546B64) & MASK
+
+
+def _fetch32(data: bytes, i: int) -> int:
+    return int.from_bytes(data[i : i + 4], "little")
+
+
+def _hash32_len_0_to_4(s: bytes, seed: int = 0) -> int:
+    b = seed
+    c = 9
+    for ch in s:
+        # signed char semantics: bytes >= 0x80 are negative
+        v = ch - 256 if ch >= 128 else ch
+        b = (b * C1 + v) & MASK
+        c ^= b
+    return _fmix(_mur(b, _mur(len(s), c)))
+
+
+def _hash32_len_5_to_12(s: bytes, seed: int = 0) -> int:
+    n = len(s)
+    a = (n + _fetch32(s, 0)) & MASK
+    b = (n * 5 + _fetch32(s, n - 4)) & MASK
+    c = (9 + _fetch32(s, (n >> 1) & 4)) & MASK
+    d = (n * 5 + seed) & MASK
+    return _fmix(seed ^ _mur(c, _mur(b, _mur(a, d))))
+
+
+def _hash32_len_13_to_24(s: bytes, seed: int = 0) -> int:
+    n = len(s)
+    a = _fetch32(s, (n >> 1) - 4)
+    b = _fetch32(s, 4)
+    c = _fetch32(s, n - 8)
+    d = _fetch32(s, n >> 1)
+    e = _fetch32(s, 0)
+    f = _fetch32(s, n - 4)
+    h = (d * C1 + n + seed) & MASK
+    a = (_rot32(a, 12) + f) & MASK
+    h = (_mur(c, h) + a) & MASK
+    a = (_rot32(a, 3) + c) & MASK
+    h = (_mur(e, h) + a) & MASK
+    a = (_rot32(a + f, 12) + d) & MASK
+    h = (_mur(b ^ seed, h) + a) & MASK
+    return _fmix(h)
+
+
+def hash32(data: Union[bytes, str]) -> int:
+    """farmhashmk::Hash32 over ``data``; strings are UTF-8 encoded (the npm
+    addon converts JS strings to utf-8 buffers before hashing)."""
+    if isinstance(data, str):
+        data = data.encode("utf-8")
+    n = len(data)
+    if n <= 4:
+        return _hash32_len_0_to_4(data)
+    if n <= 12:
+        return _hash32_len_5_to_12(data)
+    if n <= 24:
+        return _hash32_len_13_to_24(data)
+
+    # len > 24
+    h = n & MASK
+    g = (C1 * n) & MASK
+    f = g
+    a0 = (_rot32((_fetch32(data, n - 4) * C1) & MASK, 17) * C2) & MASK
+    a1 = (_rot32((_fetch32(data, n - 8) * C1) & MASK, 17) * C2) & MASK
+    a2 = (_rot32((_fetch32(data, n - 16) * C1) & MASK, 17) * C2) & MASK
+    a3 = (_rot32((_fetch32(data, n - 12) * C1) & MASK, 17) * C2) & MASK
+    a4 = (_rot32((_fetch32(data, n - 20) * C1) & MASK, 17) * C2) & MASK
+    h ^= a0
+    h = _rot32(h, 19)
+    h = (h * 5 + 0xE6546B64) & MASK
+    h ^= a2
+    h = _rot32(h, 19)
+    h = (h * 5 + 0xE6546B64) & MASK
+    g ^= a1
+    g = _rot32(g, 19)
+    g = (g * 5 + 0xE6546B64) & MASK
+    g ^= a3
+    g = _rot32(g, 19)
+    g = (g * 5 + 0xE6546B64) & MASK
+    f = (f + a4) & MASK
+    f = (_rot32(f, 19) + 113) & MASK
+    iters = (n - 1) // 20
+    off = 0
+    for _ in range(iters):
+        a = _fetch32(data, off)
+        b = _fetch32(data, off + 4)
+        c = _fetch32(data, off + 8)
+        d = _fetch32(data, off + 12)
+        e = _fetch32(data, off + 16)
+        h = (h + a) & MASK
+        g = (g + b) & MASK
+        f = (f + c) & MASK
+        h = (_mur(d, h) + e) & MASK
+        g = (_mur(c, g) + a) & MASK
+        f = (_mur((b + (e * C1 & MASK)) & MASK, f) + d) & MASK
+        f = (f + g) & MASK
+        g = (g + f) & MASK
+        off += 20
+    g = (_rot32(g, 11) * C1) & MASK
+    g = (_rot32(g, 17) * C1) & MASK
+    f = (_rot32(f, 11) * C1) & MASK
+    f = (_rot32(f, 17) * C1) & MASK
+    h = _rot32((h + g) & MASK, 19)
+    h = (h * 5 + 0xE6546B64) & MASK
+    h = (_rot32(h, 17) * C1) & MASK
+    h = _rot32((h + f) & MASK, 19)
+    h = (h * 5 + 0xE6546B64) & MASK
+    h = (_rot32(h, 17) * C1) & MASK
+    return h
+
+
+# ---------------------------------------------------------------------------
+# numpy-vectorized batch implementation over padded byte rows
+# ---------------------------------------------------------------------------
+
+U32 = np.uint32
+U64 = np.uint64
+
+
+def encode_rows(strings: Sequence[Union[bytes, str]], pad_to: int = 0) -> Tuple[np.ndarray, np.ndarray]:
+    """Encode strings into a zero-padded ``[B, L] uint8`` matrix + lengths.
+
+    L is ``max(len) + 4`` rounded up (slack so vectorized 4-byte fetches never
+    index out of bounds), or at least ``pad_to``.
+    """
+    rows = [s.encode("utf-8") if isinstance(s, str) else bytes(s) for s in strings]
+    lens = np.array([len(r) for r in rows], dtype=np.int64)
+    width = max(int(lens.max(initial=0)) + 4, pad_to, 8)
+    mat = np.zeros((len(rows), width), dtype=np.uint8)
+    for i, r in enumerate(rows):
+        mat[i, : len(r)] = np.frombuffer(r, dtype=np.uint8)
+    return mat, lens
+
+
+def _np_rot(x: np.ndarray, r: int) -> np.ndarray:
+    if r == 0:
+        return x
+    return ((x >> U32(r)) | (x << U32(32 - r))).astype(U32)
+
+
+def _np_fmix(h: np.ndarray) -> np.ndarray:
+    h = h ^ (h >> U32(16))
+    h = (h * U32(0x85EBCA6B)).astype(U32)
+    h = h ^ (h >> U32(13))
+    h = (h * U32(0xC2B2AE35)).astype(U32)
+    h = h ^ (h >> U32(16))
+    return h
+
+
+def _np_mur(a: np.ndarray, h: np.ndarray) -> np.ndarray:
+    a = (a * U32(C1)).astype(U32)
+    a = _np_rot(a, 17)
+    a = (a * U32(C2)).astype(U32)
+    h = h ^ a
+    h = _np_rot(h, 19)
+    return (h * U32(5) + U32(0xE6546B64)).astype(U32)
+
+
+def _np_fetch32(mat: np.ndarray, off: np.ndarray) -> np.ndarray:
+    """Per-row little-endian 4-byte fetch at per-row offsets.
+
+    ``off`` may be negative or out-of-range for rows where the value is
+    ultimately discarded; clamp for safety.
+    """
+    off = np.clip(off, 0, mat.shape[1] - 4).astype(np.int64)
+    b0 = np.take_along_axis(mat, off[:, None], axis=1)[:, 0].astype(U32)
+    b1 = np.take_along_axis(mat, off[:, None] + 1, axis=1)[:, 0].astype(U32)
+    b2 = np.take_along_axis(mat, off[:, None] + 2, axis=1)[:, 0].astype(U32)
+    b3 = np.take_along_axis(mat, off[:, None] + 3, axis=1)[:, 0].astype(U32)
+    return (b0 | (b1 << U32(8)) | (b2 << U32(16)) | (b3 << U32(24))).astype(U32)
+
+
+def _np_hash_0_4(mat: np.ndarray, lens: np.ndarray) -> np.ndarray:
+    n = lens.astype(U32)
+    b = np.zeros(mat.shape[0], dtype=U32)
+    c = np.full(mat.shape[0], 9, dtype=U32)
+    for i in range(4):
+        active = lens > i
+        v = mat[:, min(i, mat.shape[1] - 1)].astype(np.int8).astype(np.int32).astype(U32)
+        nb = (b * U32(C1) + v).astype(U32)
+        b = np.where(active, nb, b)
+        c = np.where(active, c ^ nb, c)
+    return _np_fmix(_np_mur(b, _np_mur(n, c)))
+
+
+def _np_hash_5_12(mat: np.ndarray, lens: np.ndarray) -> np.ndarray:
+    n = lens.astype(U32)
+    a = (n + _np_fetch32(mat, np.zeros_like(lens))).astype(U32)
+    b = (n * U32(5) + _np_fetch32(mat, lens - 4)).astype(U32)
+    c = (U32(9) + _np_fetch32(mat, (lens >> 1) & 4)).astype(U32)
+    d = (n * U32(5)).astype(U32)  # seed = 0
+    return _np_fmix(_np_mur(c, _np_mur(b, _np_mur(a, d))))
+
+
+def _np_hash_13_24(mat: np.ndarray, lens: np.ndarray) -> np.ndarray:
+    n = lens.astype(U32)
+    a = _np_fetch32(mat, (lens >> 1) - 4)
+    b = _np_fetch32(mat, np.full_like(lens, 4))
+    c = _np_fetch32(mat, lens - 8)
+    d = _np_fetch32(mat, lens >> 1)
+    e = _np_fetch32(mat, np.zeros_like(lens))
+    f = _np_fetch32(mat, lens - 4)
+    h = (d * U32(C1) + n).astype(U32)  # seed = 0
+    a = (_np_rot(a, 12) + f).astype(U32)
+    h = (_np_mur(c, h) + a).astype(U32)
+    a = (_np_rot(a, 3) + c).astype(U32)
+    h = (_np_mur(e, h) + a).astype(U32)
+    a = (_np_rot((a + f).astype(U32), 12) + d).astype(U32)
+    h = (_np_mur(b, h) + a).astype(U32)  # b ^ seed, seed = 0
+    return _np_fmix(h)
+
+
+def _np_hash_long(mat: np.ndarray, lens: np.ndarray) -> np.ndarray:
+    n32 = lens.astype(U32)
+    h = n32.copy()
+    g = (U32(C1) * n32).astype(U32)
+    f = g.copy()
+
+    def tail(off_from_end: int) -> np.ndarray:
+        v = _np_fetch32(mat, lens - off_from_end)
+        return (_np_rot((v * U32(C1)).astype(U32), 17) * U32(C2)).astype(U32)
+
+    a0, a1, a2, a3, a4 = tail(4), tail(8), tail(16), tail(12), tail(20)
+    h ^= a0
+    h = _np_rot(h, 19)
+    h = (h * U32(5) + U32(0xE6546B64)).astype(U32)
+    h ^= a2
+    h = _np_rot(h, 19)
+    h = (h * U32(5) + U32(0xE6546B64)).astype(U32)
+    g ^= a1
+    g = _np_rot(g, 19)
+    g = (g * U32(5) + U32(0xE6546B64)).astype(U32)
+    g ^= a3
+    g = _np_rot(g, 19)
+    g = (g * U32(5) + U32(0xE6546B64)).astype(U32)
+    f = (f + a4).astype(U32)
+    f = (_np_rot(f, 19) + U32(113)).astype(U32)
+
+    iters = (lens - 1) // 20
+    max_iters = int(iters.max(initial=0))
+    zeros = np.zeros_like(lens)
+    for i in range(max_iters):
+        active = iters > i
+        base = zeros + 20 * i
+        a = _np_fetch32(mat, base)
+        b = _np_fetch32(mat, base + 4)
+        c = _np_fetch32(mat, base + 8)
+        d = _np_fetch32(mat, base + 12)
+        e = _np_fetch32(mat, base + 16)
+        nh = (h + a).astype(U32)
+        ng = (g + b).astype(U32)
+        nf = (f + c).astype(U32)
+        nh = (_np_mur(d, nh) + e).astype(U32)
+        ng = (_np_mur(c, ng) + a).astype(U32)
+        nf = (_np_mur((b + (e * U32(C1)).astype(U32)).astype(U32), nf) + d).astype(U32)
+        nf = (nf + ng).astype(U32)
+        ng = (ng + nf).astype(U32)
+        h = np.where(active, nh, h)
+        g = np.where(active, ng, g)
+        f = np.where(active, nf, f)
+
+    g = (_np_rot(g, 11) * U32(C1)).astype(U32)
+    g = (_np_rot(g, 17) * U32(C1)).astype(U32)
+    f = (_np_rot(f, 11) * U32(C1)).astype(U32)
+    f = (_np_rot(f, 17) * U32(C1)).astype(U32)
+    h = _np_rot((h + g).astype(U32), 19)
+    h = (h * U32(5) + U32(0xE6546B64)).astype(U32)
+    h = (_np_rot(h, 17) * U32(C1)).astype(U32)
+    h = _np_rot((h + f).astype(U32), 19)
+    h = (h * U32(5) + U32(0xE6546B64)).astype(U32)
+    h = (_np_rot(h, 17) * U32(C1)).astype(U32)
+    return h
+
+
+def hash32_batch(mat: np.ndarray, lens: np.ndarray) -> np.ndarray:
+    """farmhashmk::Hash32 of each padded row; returns ``[B] uint32``."""
+    mat = np.ascontiguousarray(mat, dtype=np.uint8)
+    lens = np.asarray(lens, dtype=np.int64)
+    if mat.ndim != 2 or lens.shape != (mat.shape[0],):
+        raise ValueError("expected mat [B, L] and lens [B]")
+    if mat.shape[1] < int(lens.max(initial=0)) + 4:
+        # re-pad with slack so fetches past the end stay in-bounds
+        extra = int(lens.max(initial=0)) + 4 - mat.shape[1]
+        mat = np.pad(mat, ((0, 0), (0, extra)))
+
+    with np.errstate(over="ignore"):
+        out = _np_hash_0_4(mat, lens)
+        out = np.where(lens > 4, _np_hash_5_12(mat, lens), out)
+        out = np.where(lens > 12, _np_hash_13_24(mat, lens), out)
+        if (lens > 24).any():
+            out = np.where(lens > 24, _np_hash_long(mat, lens), out)
+    return out.astype(U32)
+
+
+def hash32_strings(strings: Sequence[Union[bytes, str]]) -> np.ndarray:
+    """Convenience: batch-hash a list of strings."""
+    mat, lens = encode_rows(strings)
+    return hash32_batch(mat, lens)

@@ -1,0 +1,136 @@
+"""ctypes loader for the native FarmHash32 oracle.
+
+Builds ``_native/libfarmhash.so`` on first use (g++ is in the base image;
+pybind11 is not, hence the plain C ABI).  Falls back to the numpy
+implementation transparently if the toolchain is unavailable, so the package
+stays importable everywhere.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import Optional, Sequence, Union
+
+import numpy as np
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_HERE, "_native", "farmhash.cc")
+_LIB = os.path.join(_HERE, "_native", "libfarmhash.so")
+
+_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+_build_failed = False
+
+
+def _build() -> bool:
+    try:
+        subprocess.run(
+            ["g++", "-O3", "-std=c++17", "-shared", "-fPIC", _SRC, "-o", _LIB],
+            check=True,
+            capture_output=True,
+        )
+        return True
+    except (subprocess.CalledProcessError, FileNotFoundError):
+        return False
+
+
+def get_lib() -> Optional[ctypes.CDLL]:
+    """Load (building if needed) the native library; None if unavailable."""
+    global _lib, _build_failed
+    if _lib is not None:
+        return _lib
+    if _build_failed:
+        return None
+    with _lock:
+        if _lib is not None:
+            return _lib
+        have_src = os.path.exists(_SRC)
+        stale = (
+            not os.path.exists(_LIB)
+            or (have_src and os.path.getmtime(_LIB) < os.path.getmtime(_SRC))
+        )
+        if stale:
+            if not have_src or not _build():
+                _build_failed = True
+                return None
+        try:
+            lib = ctypes.CDLL(_LIB)
+        except OSError:
+            _build_failed = True
+            return None
+        lib.rp_farmhash32.restype = ctypes.c_uint32
+        lib.rp_farmhash32.argtypes = [ctypes.c_char_p, ctypes.c_uint64]
+        lib.rp_farmhash32_batch.restype = None
+        lib.rp_farmhash32_batch.argtypes = [
+            ctypes.c_void_p,
+            ctypes.c_uint64,
+            ctypes.c_void_p,
+            ctypes.c_uint64,
+            ctypes.c_void_p,
+        ]
+        lib.rp_replica_hashes.restype = None
+        lib.rp_replica_hashes.argtypes = [
+            ctypes.c_char_p,
+            ctypes.c_uint64,
+            ctypes.c_uint64,
+            ctypes.c_void_p,
+        ]
+        _lib = lib
+        return _lib
+
+
+def available() -> bool:
+    return get_lib() is not None
+
+
+def hash32(data: Union[bytes, str]) -> int:
+    """Native farmhashmk::Hash32; falls back to pure Python."""
+    if isinstance(data, str):
+        data = data.encode("utf-8")
+    lib = get_lib()
+    if lib is None:
+        from ringpop_tpu.ops import farmhash32 as py
+
+        return py.hash32(data)
+    return int(lib.rp_farmhash32(data, len(data)))
+
+
+def hash32_batch(mat: np.ndarray, lens: np.ndarray) -> np.ndarray:
+    """Native batch hash over padded rows; falls back to numpy."""
+    lib = get_lib()
+    if lib is None:
+        from ringpop_tpu.ops import farmhash32 as py
+
+        return py.hash32_batch(mat, lens)
+    mat = np.ascontiguousarray(mat, dtype=np.uint8)
+    lens64 = np.ascontiguousarray(lens, dtype=np.uint64)
+    out = np.empty(mat.shape[0], dtype=np.uint32)
+    lib.rp_farmhash32_batch(
+        mat.ctypes.data,
+        mat.shape[1],
+        lens64.ctypes.data,
+        mat.shape[0],
+        out.ctypes.data,
+    )
+    return out
+
+
+def replica_hashes(name: Union[bytes, str], replica_points: int) -> np.ndarray:
+    """hash32(f"{name}{i}") for i in range(replica_points) — the ring's
+    replica expansion (lib/ring/index.js:54-57)."""
+    if isinstance(name, str):
+        name = name.encode("utf-8")
+    lib = get_lib()
+    if lib is None or len(name) > 480:
+        from ringpop_tpu.ops import farmhash32 as py
+
+        return np.array(
+            [py.hash32(name + str(i).encode()) for i in range(replica_points)],
+            dtype=np.uint32,
+        )
+    out = np.empty(replica_points, dtype=np.uint32)
+    lib.rp_replica_hashes(name, len(name), replica_points, out.ctypes.data)
+    return out

@@ -1,0 +1,1 @@
+"""Utility layer: config store, typed errors, stats, null objects, helpers."""

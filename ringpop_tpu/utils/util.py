@@ -1,0 +1,64 @@
+"""Misc helpers mirroring /root/reference/lib/util.js and lib/nulls.js."""
+
+from __future__ import annotations
+
+import json
+import logging
+import re
+from typing import Any, Iterable, List, Optional
+
+HOST_CAPTURE = re.compile(r"(\d+\.\d+\.\d+\.\d+):\d+")
+HOST_PORT_PATTERN = re.compile(r"^(\d+\.\d+\.\d+\.\d+):\d+$")
+
+
+def capture_host(host_port: str) -> Optional[str]:
+    """Extract the IP from ``ip:port`` — lib/util.js:27-30."""
+    m = HOST_CAPTURE.search(host_port or "")
+    return m.group(1) if m else None
+
+
+def is_empty_array(arr: Any) -> bool:
+    return not isinstance(arr, (list, tuple)) or len(arr) == 0
+
+
+def num_or_default(num: Any, default: Any) -> Any:
+    if isinstance(num, bool) or not isinstance(num, (int, float)):
+        return default
+    if isinstance(num, float) and num != num:  # NaN
+        return default
+    return num
+
+
+def safe_parse(s: Any) -> Any:
+    try:
+        return json.loads(s)
+    except (TypeError, ValueError):
+        return None
+
+
+def map_uniq(items: Iterable[Any], fn) -> List[Any]:
+    seen = {}
+    for item in items:
+        seen[fn(item)] = None
+    return list(seen.keys())
+
+
+class NullStatsd:
+    """No-op statsd client — lib/nulls.js."""
+
+    def increment(self, *a: Any, **kw: Any) -> None:
+        pass
+
+    def gauge(self, *a: Any, **kw: Any) -> None:
+        pass
+
+    def timing(self, *a: Any, **kw: Any) -> None:
+        pass
+
+
+def null_logger() -> logging.Logger:
+    logger = logging.getLogger("ringpop_tpu.null")
+    if not logger.handlers:
+        logger.addHandler(logging.NullHandler())
+    logger.propagate = False
+    return logger

@@ -1,0 +1,99 @@
+"""Cross-implementation FarmHash32 tests.
+
+Every implementation (pure-Python scalar, numpy batch, native C++) must agree
+bit-for-bit on every length class the algorithm branches on: 0-4, 5-12,
+13-24, >24 single-block, and multi-block (>44, >1000).  The strings exercised
+mirror what the reference actually hashes: host:port addresses, replica-point
+strings "addr<i>" (lib/ring/index.js:54-57) and membership checksum strings
+"addr+status+incarnation;..." (lib/membership/index.js:100-123).
+"""
+
+import random
+
+import numpy as np
+import pytest
+
+from ringpop_tpu.ops import farmhash32 as fh
+from ringpop_tpu.ops import native
+
+
+def sample_strings():
+    strs = [
+        b"",
+        b"a",
+        b"ab",
+        b"abc",
+        b"abcd",
+        b"abcde",
+        b"hello world.",
+        b"0123456789abc",
+        b"0123456789abcdefghijklmn",  # 24
+        b"0123456789abcdefghijklmno",  # 25
+        b"127.0.0.1:3000",
+        b"127.0.0.1:30000",
+        b"10.0.0.1:300042",
+        b"127.0.0.1:3000alive1414142122274",
+        b"127.0.0.1:3000alive1414142122274;127.0.0.1:3001alive1414142122275",
+    ]
+    # replica-point strings
+    for i in (0, 1, 7, 42, 99):
+        strs.append(f"127.0.0.1:3000{i}".encode())
+    # random binary strings across length classes
+    rng = random.Random(0xFA12)
+    for n in [3, 4, 5, 11, 12, 13, 20, 24, 25, 30, 44, 45, 64, 100, 1000, 4097]:
+        strs.append(bytes(rng.randrange(256) for _ in range(n)))
+        strs.append(bytes(rng.randrange(32, 127) for _ in range(n)))
+    # long checksum-style string (1k members)
+    member_strs = [
+        f"10.0.{i // 256}.{i % 256}:9000alive{1414142122274 + i}" for i in range(1000)
+    ]
+    strs.append(";".join(sorted(member_strs)).encode())
+    return sorted(set(strs), key=len)
+
+
+STRINGS = sample_strings()
+
+
+def test_scalar_known_length_classes():
+    # sanity: distinct inputs produce distinct hashes (no degenerate paths)
+    hashes = [fh.hash32(s) for s in STRINGS]
+    assert all(0 <= h <= 0xFFFFFFFF for h in hashes)
+    assert len(set(hashes)) == len(hashes)
+
+
+def test_numpy_batch_matches_scalar():
+    mat, lens = fh.encode_rows(STRINGS)
+    got = fh.hash32_batch(mat, lens)
+    want = np.array([fh.hash32(s) for s in STRINGS], dtype=np.uint32)
+    mismatches = [
+        (i, STRINGS[i][:40], int(got[i]), int(want[i]))
+        for i in range(len(STRINGS))
+        if got[i] != want[i]
+    ]
+    assert not mismatches, mismatches[:5]
+
+
+@pytest.mark.skipif(not native.available(), reason="native toolchain unavailable")
+def test_native_matches_scalar():
+    for s in STRINGS:
+        assert native.hash32(s) == fh.hash32(s), s[:60]
+
+
+@pytest.mark.skipif(not native.available(), reason="native toolchain unavailable")
+def test_native_batch_matches_numpy():
+    mat, lens = fh.encode_rows(STRINGS)
+    got = native.hash32_batch(mat, lens)
+    want = fh.hash32_batch(mat, lens)
+    np.testing.assert_array_equal(got, want)
+
+
+@pytest.mark.skipif(not native.available(), reason="native toolchain unavailable")
+def test_native_replica_hashes():
+    name = "127.0.0.1:3000"
+    got = native.replica_hashes(name, 100)
+    want = np.array([fh.hash32(f"{name}{i}") for i in range(100)], dtype=np.uint32)
+    np.testing.assert_array_equal(got, want)
+
+
+def test_str_and_bytes_agree():
+    assert fh.hash32("127.0.0.1:3000") == fh.hash32(b"127.0.0.1:3000")
