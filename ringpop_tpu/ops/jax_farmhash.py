@@ -1,0 +1,213 @@
+"""In-jit FarmHash32 (farmhashmk) over ragged byte rows.
+
+The device twin of :mod:`ringpop_tpu.ops.farmhash32`: hashes each row of a
+padded ``[B, L] uint8`` matrix with per-row lengths, entirely inside the jit
+graph, so membership/ring checksums (lib/membership/index.js:48-75,
+lib/ring/index.js:96-105) can live in the same compiled step as the SWIM
+update rule.
+
+TPU-first design notes:
+
+- All state is ``uint32`` lanes vectorized across the row (batch) axis — the
+  block loop of the long-path hash is sequential *per row* but runs B lanes
+  wide, so a 1k-node cluster computes 1k checksums in lockstep on the VPU.
+- The main 20-byte block loop reads at offsets ``20*i + {0,4,8,12,16}``,
+  all 4-aligned: the byte matrix is pre-packed once into an aligned
+  little-endian ``uint32`` word view, turning 20 byte-gathers per block into
+  5 word-gathers.  Only the five unaligned tail fetches gather bytes.
+- The loop is a ``lax.fori_loop`` with trip count ``(L-1)//20`` (static from
+  the padded width) and per-row active masks — no dynamic shapes.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+# numpy scalars, not jnp: importing this module must stay device-free (the
+# ambient env pins a single-client TPU tunnel; backend init belongs to callers)
+C1 = np.uint32(0xCC9E2D51)
+C2 = np.uint32(0x1B873593)
+FIVE = np.uint32(5)
+MAGIC = np.uint32(0xE6546B64)
+
+
+def _rot(x: jax.Array, r: int) -> jax.Array:
+    if r == 0:
+        return x
+    return (x >> jnp.uint32(r)) | (x << jnp.uint32(32 - r))
+
+
+def _fmix(h: jax.Array) -> jax.Array:
+    h = h ^ (h >> jnp.uint32(16))
+    h = h * jnp.uint32(0x85EBCA6B)
+    h = h ^ (h >> jnp.uint32(13))
+    h = h * jnp.uint32(0xC2B2AE35)
+    h = h ^ (h >> jnp.uint32(16))
+    return h
+
+
+def _mur(a: jax.Array, h: jax.Array) -> jax.Array:
+    a = a * C1
+    a = _rot(a, 17)
+    a = a * C2
+    h = h ^ a
+    h = _rot(h, 19)
+    return h * FIVE + MAGIC
+
+
+def _fetch32(mat: jax.Array, off: jax.Array) -> jax.Array:
+    """Per-row little-endian 4-byte fetch at (possibly unaligned) offsets."""
+    off = jnp.clip(off, 0, mat.shape[1] - 4)
+    idx = off[:, None] + jnp.arange(4)[None, :]
+    b = jnp.take_along_axis(mat, idx, axis=1).astype(jnp.uint32)
+    return b[:, 0] | (b[:, 1] << 8) | (b[:, 2] << 16) | (b[:, 3] << 24)
+
+
+def pack_words(mat: jax.Array) -> jax.Array:
+    """Pack ``[B, L] uint8`` into aligned LE ``[B, ceil(L/4)] uint32`` words."""
+    B, L = mat.shape
+    pad = (-L) % 4
+    if pad:
+        mat = jnp.pad(mat, ((0, 0), (0, pad)))
+    w = mat.reshape(B, -1, 4).astype(jnp.uint32)
+    return w[..., 0] | (w[..., 1] << 8) | (w[..., 2] << 16) | (w[..., 3] << 24)
+
+
+def _hash_0_4(mat: jax.Array, lens: jax.Array) -> jax.Array:
+    n = lens.astype(jnp.uint32)
+    B = mat.shape[0]
+    b = jnp.zeros(B, jnp.uint32)
+    c = jnp.full(B, 9, jnp.uint32)
+    for i in range(4):
+        active = lens > i
+        # signed char semantics: sign-extend bytes >= 0x80
+        v = mat[:, min(i, mat.shape[1] - 1)].astype(jnp.int8).astype(jnp.int32).astype(jnp.uint32)
+        nb = b * C1 + v
+        b = jnp.where(active, nb, b)
+        c = jnp.where(active, c ^ nb, c)
+    return _fmix(_mur(b, _mur(n, c)))
+
+
+def _hash_5_12(mat: jax.Array, lens: jax.Array) -> jax.Array:
+    n = lens.astype(jnp.uint32)
+    zeros = jnp.zeros_like(lens)
+    a = n + _fetch32(mat, zeros)
+    b = n * FIVE + _fetch32(mat, lens - 4)
+    c = jnp.uint32(9) + _fetch32(mat, (lens >> 1) & 4)
+    d = n * FIVE  # seed = 0
+    return _fmix(_mur(c, _mur(b, _mur(a, d))))
+
+
+def _hash_13_24(mat: jax.Array, lens: jax.Array) -> jax.Array:
+    n = lens.astype(jnp.uint32)
+    a = _fetch32(mat, (lens >> 1) - 4)
+    b = _fetch32(mat, jnp.full_like(lens, 4))
+    c = _fetch32(mat, lens - 8)
+    d = _fetch32(mat, lens >> 1)
+    e = _fetch32(mat, jnp.zeros_like(lens))
+    f = _fetch32(mat, lens - 4)
+    h = d * C1 + n  # seed = 0
+    a = _rot(a, 12) + f
+    h = _mur(c, h) + a
+    a = _rot(a, 3) + c
+    h = _mur(e, h) + a
+    a = _rot(a + f, 12) + d
+    h = _mur(b, h) + a  # b ^ seed, seed = 0
+    return _fmix(h)
+
+
+def _hash_long(mat: jax.Array, words: jax.Array, lens: jax.Array) -> jax.Array:
+    n32 = lens.astype(jnp.uint32)
+    h = n32
+    g = C1 * n32
+    f = g
+
+    def tail(off_from_end: int) -> jax.Array:
+        v = _fetch32(mat, lens - off_from_end)
+        return _rot(v * C1, 17) * C2
+
+    a0, a1, a2, a3, a4 = tail(4), tail(8), tail(16), tail(12), tail(20)
+    h = h ^ a0
+    h = _rot(h, 19) * FIVE + MAGIC
+    h = h ^ a2
+    h = _rot(h, 19) * FIVE + MAGIC
+    g = g ^ a1
+    g = _rot(g, 19) * FIVE + MAGIC
+    g = g ^ a3
+    g = _rot(g, 19) * FIVE + MAGIC
+    f = f + a4
+    f = _rot(f, 19) + jnp.uint32(113)
+
+    iters = (lens - 1) // 20
+    max_iters = max((mat.shape[1] - 1) // 20, 1)
+
+    def word_at(i: jax.Array, j: int) -> jax.Array:
+        # byte offset 20*i + 4*j  ==  word index 5*i + j (aligned)
+        idx = jnp.clip(5 * i + j, 0, words.shape[1] - 1)
+        return words[:, idx]
+
+    def body(i, state):
+        h, g, f = state
+        active = i < iters
+        a = word_at(i, 0)
+        b = word_at(i, 1)
+        c = word_at(i, 2)
+        d = word_at(i, 3)
+        e = word_at(i, 4)
+        nh = h + a
+        ng = g + b
+        nf = f + c
+        nh = _mur(d, nh) + e
+        ng = _mur(c, ng) + a
+        nf = _mur(b + e * C1, nf) + d
+        nf = nf + ng
+        ng = ng + nf
+        return (
+            jnp.where(active, nh, h),
+            jnp.where(active, ng, g),
+            jnp.where(active, nf, f),
+        )
+
+    h, g, f = jax.lax.fori_loop(0, max_iters, body, (h, g, f))
+
+    g = _rot(g, 11) * C1
+    g = _rot(g, 17) * C1
+    f = _rot(f, 11) * C1
+    f = _rot(f, 17) * C1
+    h = _rot(h + g, 19)
+    h = h * FIVE + MAGIC
+    h = _rot(h, 17) * C1
+    h = _rot(h + f, 19)
+    h = h * FIVE + MAGIC
+    h = _rot(h, 17) * C1
+    return h
+
+
+def hash32_rows(mat: jax.Array, lens: jax.Array) -> jax.Array:
+    """farmhashmk::Hash32 of each padded row — jit-friendly, ``[B] uint32``.
+
+    ``mat`` must carry >= 4 bytes of zero slack beyond the longest row (use
+    :func:`ringpop_tpu.ops.farmhash32.encode_rows` on host, or allocate the
+    device buffer with slack).
+    """
+    mat = mat.astype(jnp.uint8)
+    lens = lens.astype(jnp.int32) if lens.dtype not in (jnp.int32, jnp.int64) else lens
+    words = pack_words(mat)
+    out = _hash_0_4(mat, lens)
+    out = jnp.where(lens > 4, _hash_5_12(mat, lens), out)
+    out = jnp.where(lens > 12, _hash_13_24(mat, lens), out)
+    out = jnp.where(lens > 24, _hash_long(mat, words, lens), out)
+    return out
+
+
+hash32_rows_jit = jax.jit(hash32_rows)
+
+
+def hash32_strings_device(strings) -> np.ndarray:
+    """Host convenience: encode on host, hash on device (for tests)."""
+    from ringpop_tpu.ops.farmhash32 import encode_rows
+
+    mat, lens = encode_rows(strings)
+    return np.asarray(hash32_rows_jit(jnp.asarray(mat), jnp.asarray(lens)))

@@ -1,15 +1,25 @@
 """Test harness config.
 
 Forces JAX onto a virtual 8-device CPU mesh (the driver validates the real
-multi-chip path separately via __graft_entry__.dryrun_multichip).  Must run
-before jax is imported anywhere in the test process.
+multi-chip path separately via __graft_entry__.dryrun_multichip).
+
+Note: this image's sitecustomize imports jax and registers the single-client
+`axon` TPU tunnel in every interpreter, and jax captures JAX_PLATFORMS at
+import time — so mutating os.environ here is too late for the platform
+selection.  We must update jax.config directly (safe: no backend has been
+initialized yet at conftest time).  XLA_FLAGS, by contrast, is read by XLA at
+backend-init time, so the env mutation works for the device count.
 """
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+os.environ["JAX_PLATFORMS"] = "cpu"  # for any subprocesses tests may spawn
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")

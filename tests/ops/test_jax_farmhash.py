@@ -1,0 +1,69 @@
+"""In-jit JAX FarmHash32 vs the numpy/scalar oracles."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ringpop_tpu.ops import farmhash32 as fh
+from ringpop_tpu.ops import jax_farmhash as jfh
+from tests.ops.test_farmhash32 import STRINGS
+
+
+def test_jax_matches_oracle_all_length_classes():
+    mat, lens = fh.encode_rows(STRINGS)
+    got = jfh.hash32_strings_device(STRINGS)
+    want = fh.hash32_batch(mat, lens)
+    bad = [
+        (i, STRINGS[i][:40], int(got[i]), int(want[i]))
+        for i in range(len(STRINGS))
+        if got[i] != want[i]
+    ]
+    assert not bad, bad[:5]
+
+
+def test_jax_hash_under_outer_jit():
+    # the kernel must compose inside larger jitted programs
+    mat, lens = fh.encode_rows([b"127.0.0.1:%d" % (3000 + i) for i in range(64)])
+
+    @jax.jit
+    def f(m, l):
+        return jfh.hash32_rows(m, l).sum()
+
+    expected = int(fh.hash32_batch(mat, lens).astype(np.uint64).sum() & 0xFFFFFFFFFFFFFFFF)
+    got = int(np.uint64(f(jnp.asarray(mat), jnp.asarray(lens))))
+    assert got == expected
+
+
+def test_jax_hash_under_vmap():
+    # per-node checksum batches vmap over a leading cluster axis
+    groups = [
+        [b"127.0.0.1:3000", b"hello world, hello world, hello!"],
+        [b"127.0.0.1:3001", b"0123456789abcdefghijk"],
+    ]
+    mats, lens = [], []
+    for g in groups:
+        m, l = fh.encode_rows(g, pad_to=40)
+        mats.append(m[:, :40])
+        lens.append(l)
+    mats = jnp.asarray(np.stack(mats))
+    lens = jnp.asarray(np.stack(lens))
+    got = np.asarray(jax.vmap(jfh.hash32_rows)(mats, lens))
+    for gi, g in enumerate(groups):
+        for si, s in enumerate(g):
+            assert int(got[gi, si]) == fh.hash32(s)
+
+
+def test_pack_words_roundtrip():
+    rng = np.random.default_rng(7)
+    mat = rng.integers(0, 256, size=(5, 23), dtype=np.uint8)
+    words = np.asarray(jfh.pack_words(jnp.asarray(mat)))
+    padded = np.pad(mat, ((0, 0), (0, 1)))
+    want = padded.reshape(5, -1, 4).astype(np.uint32)
+    want = want[..., 0] | (want[..., 1] << 8) | (want[..., 2] << 16) | (want[..., 3] << 24)
+    np.testing.assert_array_equal(words, want)
+
+
+def test_empty_row_golden():
+    mat = jnp.zeros((1, 8), jnp.uint8)
+    lens = jnp.zeros((1,), jnp.int32)
+    assert int(jfh.hash32_rows_jit(mat, lens)[0]) == 0xDC56D17A
