@@ -1,0 +1,2 @@
+"""Protocol models: membership state machine, consistent hash ring, gossip
+engine, and the batched cluster simulator."""

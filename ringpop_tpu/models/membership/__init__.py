@@ -1,0 +1,19 @@
+from ringpop_tpu.models.membership.host import (
+    Member,
+    Membership,
+    MembershipIterator,
+    Status,
+    Update,
+    LeaveUpdate,
+    merge_membership_changesets,
+)
+
+__all__ = [
+    "Member",
+    "Membership",
+    "MembershipIterator",
+    "Status",
+    "Update",
+    "LeaveUpdate",
+    "merge_membership_changesets",
+]

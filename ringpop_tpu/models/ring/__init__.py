@@ -1,0 +1,3 @@
+from ringpop_tpu.models.ring.host import HashRing
+
+__all__ = ["HashRing"]

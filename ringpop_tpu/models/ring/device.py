@@ -1,0 +1,110 @@
+"""In-jit consistent hash ring over a static node universe.
+
+The reference rebuilds its rbtree ring by inserting/removing 100 replica
+points per server on every membership change (lib/ring/index.js:50-58,
+135-143).  On TPU the ring is data, not a tree: the universe's replica-point
+hashes are precomputed once ([N, R] uint32, host-side via the native FarmHash
+oracle), and a "rebuild" under churn is a masked sort — active servers'
+points get keys ``(hash << 32) | owner``, inactive ones get the +inf
+sentinel, one ``jnp.sort`` yields the ring table.  ``lookup`` is
+``searchsorted`` (the rbtree's upperBound with wraparound,
+ring/index.js:145-154); ``lookup_n`` is a bounded successor walk collecting
+unique owners (ring/index.js:157-189).
+
+Everything here is shape-static and jit/vmap/shard_map-friendly; the ring
+rebuild for every node's *own view* of the cluster is just a vmap over the
+member mask axis.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ringpop_tpu.ops import native
+
+SENTINEL = jnp.uint64(0xFFFFFFFFFFFFFFFF)
+
+
+def replica_table(addresses, replica_points: int = 100) -> np.ndarray:
+    """Precompute [N, R] uint32 replica-point hashes hash32(addr + str(i))
+    for the static universe (host-side, once per run)."""
+    return np.stack(
+        [native.replica_hashes(a, replica_points) for a in addresses]
+    ).astype(np.uint32)
+
+
+def build_ring(replica_hashes: jax.Array, mask: jax.Array) -> jax.Array:
+    """Sorted uint64 key table of the active ring.
+
+    ``replica_hashes``: [N, R] uint32; ``mask``: [N] bool (server in ring).
+    Returns [N*R] uint64 keys ``(hash << 32) | owner`` with inactive entries
+    pushed to the end as the all-ones sentinel.
+    """
+    n, r = replica_hashes.shape
+    owners = jnp.broadcast_to(jnp.arange(n, dtype=jnp.uint64)[:, None], (n, r))
+    keys = (replica_hashes.astype(jnp.uint64) << jnp.uint64(32)) | owners
+    keys = jnp.where(mask[:, None], keys, SENTINEL)
+    return jnp.sort(keys.reshape(-1))
+
+
+def ring_size(mask: jax.Array, replica_points: int) -> jax.Array:
+    return mask.sum().astype(jnp.int32) * replica_points
+
+
+def _upper_bound(ring: jax.Array, key_hash: jax.Array) -> jax.Array:
+    """First index with point hash >= key_hash.
+
+    The reference rbtree's ``upperBound`` is, despite the name, a lower
+    bound (rbtree.js:235-271); lookups that hit a replica point exactly
+    return that point's owner.
+    """
+    query = key_hash.astype(jnp.uint64) << jnp.uint64(32)
+    return jnp.searchsorted(ring, query).astype(jnp.int32)
+
+
+def lookup(ring: jax.Array, n_points: jax.Array, key_hash: jax.Array) -> jax.Array:
+    """Owner index for ``key_hash`` (int32; -1 when the ring is empty)."""
+    idx = _upper_bound(ring, key_hash)
+    idx = jnp.where(idx >= n_points, 0, idx)  # wraparound to min()
+    owner = (ring[idx] & jnp.uint64(0xFFFFFFFF)).astype(jnp.int32)
+    return jnp.where(n_points > 0, owner, -1)
+
+
+def lookup_n(
+    ring: jax.Array,
+    n_points: jax.Array,
+    key_hash: jax.Array,
+    n: int,
+) -> jax.Array:
+    """Up to ``n`` unique successor owners (int32, -1 padded).
+
+    Exact semantics of the reference's successor walk with full-cycle guard
+    (ring/index.js:157-189): a ``while_loop`` advances until ``n`` unique
+    owners are collected or every ring point has been visited — the trip
+    count is data-dependent but bounded by ``n_points``, which XLA handles
+    natively (no static over/under-estimate, no silent -1 holes).
+    """
+    start = _upper_bound(ring, key_hash)
+    found = jnp.full((n,), -1, jnp.int32)
+
+    def cond(state):
+        _, count, step = state
+        return (count < n) & (step < n_points)
+
+    def body(state):
+        found, count, step = state
+        idx = (start + step) % jnp.maximum(n_points, 1)
+        owner = (ring[idx] & jnp.uint64(0xFFFFFFFF)).astype(jnp.int32)
+        is_new = jnp.all(found != owner)
+        found = jnp.where(
+            is_new, found.at[jnp.clip(count, 0, n - 1)].set(owner), found
+        )
+        count = count + is_new.astype(jnp.int32)
+        return found, count, step + 1
+
+    found, _, _ = jax.lax.while_loop(
+        cond, body, (found, jnp.int32(0), jnp.int32(0))
+    )
+    return jnp.where(n_points > 0, found, -1)

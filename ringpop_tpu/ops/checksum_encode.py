@@ -1,0 +1,257 @@
+"""In-jit checksum-string encoding over a static address universe.
+
+The reference's membership checksum is ``hash32`` of
+``addr+status+incarnation`` joined with ';' over members sorted by address
+(/root/reference/lib/membership/index.js:100-123), and the ring checksum is
+``hash32`` of sorted server names joined with ';'
+(/root/reference/lib/ring/index.js:96-105).  Reproducing those bit-for-bit on
+device requires building the exact byte strings inside the jit graph.
+
+TPU-first design:
+
+- The simulator's node *universe* (every address that can ever appear) is
+  static per run.  Addresses are sorted lexicographically **once on host**
+  (:class:`Universe`), so the device never sorts strings — a member subset in
+  address order is just array order under a presence mask.
+- Per row (= per observing node), segment lengths are computed from the
+  member's status code and incarnation digit count, offsets are an exclusive
+  cumsum, and bytes are scattered into a padded row buffer with out-of-range
+  positions dropped.  Everything is masked arithmetic — no dynamic shapes.
+- Rows are processed in chunks via ``lax.map`` to bound the [chunk, N, S]
+  scatter-index intermediates, keeping peak memory ~chunk/B of the naive
+  layout.  The chunked axis composes with mesh sharding of the row axis.
+
+Status codes are fixed: 0=alive 1=suspect 2=faulty 3=leave (the wire strings
+the reference embeds in checksum strings, member.js:204-209).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+STATUS_ALIVE = 0
+STATUS_SUSPECT = 1
+STATUS_FAULTY = 2
+STATUS_LEAVE = 3
+
+STATUS_STRINGS = ("alive", "suspect", "faulty", "leave")
+_STATUS_W = 7  # len("suspect")
+
+STATUS_BYTES = np.zeros((4, _STATUS_W), dtype=np.uint8)
+STATUS_LEN = np.zeros(4, dtype=np.int32)
+for _i, _s in enumerate(STATUS_STRINGS):
+    STATUS_BYTES[_i, : len(_s)] = np.frombuffer(_s.encode(), dtype=np.uint8)
+    STATUS_LEN[_i] = len(_s)
+
+MAX_DIGITS = 19  # int64 decimal digits
+_POW10 = np.array([10**k for k in range(MAX_DIGITS)], dtype=np.int64)
+
+
+@dataclasses.dataclass(frozen=True)
+class Universe:
+    """Static, lexicographically sorted address universe of a simulation.
+
+    ``addresses[i]`` is node i's identity; all device arrays indexed by node
+    use this order, which equals checksum-string member order (the JS sort at
+    membership/index.js:101-110 over ASCII host:port strings is bytewise).
+    """
+
+    addresses: tuple
+    addr_bytes: np.ndarray  # [N, A] uint8, zero-padded
+    addr_len: np.ndarray  # [N] int32
+
+    @staticmethod
+    def from_addresses(addresses: Sequence[str]) -> "Universe":
+        ordered = sorted(addresses)
+        if len(set(ordered)) != len(ordered):
+            raise ValueError("duplicate addresses in universe")
+        encoded = [a.encode("utf-8") for a in ordered]
+        width = max((len(e) for e in encoded), default=1)
+        mat = np.zeros((len(encoded), width), dtype=np.uint8)
+        lens = np.zeros(len(encoded), dtype=np.int32)
+        for i, e in enumerate(encoded):
+            mat[i, : len(e)] = np.frombuffer(e, dtype=np.uint8)
+            lens[i] = len(e)
+        return Universe(tuple(ordered), mat, lens)
+
+    @property
+    def n(self) -> int:
+        return len(self.addresses)
+
+    @property
+    def addr_width(self) -> int:
+        return self.addr_bytes.shape[1]
+
+    def index_of(self, address: str) -> int:
+        return self.addresses.index(address)
+
+    def member_row_width(self, max_digits: int = MAX_DIGITS) -> int:
+        """Static buffer width for a full-membership checksum string."""
+        return int(self.addr_len.sum()) + self.n * (_STATUS_W + max_digits + 1) + 4
+
+    def ring_row_width(self) -> int:
+        return int(self.addr_len.sum()) + self.n + 4
+
+
+def _ndigits(x: jax.Array) -> jax.Array:
+    """Decimal digit count of non-negative int64 (0 -> 1 digit)."""
+    x = x.astype(jnp.int64)
+    count = jnp.ones(x.shape, jnp.int32)
+    for k in range(1, MAX_DIGITS):
+        count = count + (x >= _POW10[k]).astype(jnp.int32)
+    return count
+
+
+def _digit_bytes(x: jax.Array, dlen: jax.Array, max_digits: int) -> jax.Array:
+    """[..., max_digits] ASCII digits of x, most significant first, left-
+    aligned within dlen (positions >= dlen are garbage, masked by caller)."""
+    x = x.astype(jnp.int64)
+    k = jnp.arange(max_digits)
+    exp = jnp.clip(dlen[..., None] - 1 - k, 0, MAX_DIGITS - 1)
+    pow10 = jnp.asarray(_POW10)[exp]
+    digit = (x[..., None] // pow10) % 10
+    return (digit + ord("0")).astype(jnp.uint8)
+
+
+def _scatter_rows(
+    width: int,
+    positions: jax.Array,  # [N, S] int32 — target position per byte, >= width drops
+    values: jax.Array,  # [N, S] uint8
+) -> jax.Array:
+    buf = jnp.zeros((width,), jnp.uint8)
+    return buf.at[positions.reshape(-1)].set(values.reshape(-1), mode="drop")
+
+
+def membership_rows(
+    universe: Universe,
+    present: jax.Array,  # [B, N] bool
+    status: jax.Array,  # [B, N] int32/int8 codes
+    incarnation: jax.Array,  # [B, N] int64
+    max_digits: int = MAX_DIGITS,
+    width: Optional[int] = None,
+    chunk: int = 64,
+):
+    """Build per-row membership checksum strings; returns (buf [B,W] uint8,
+    lens [B] int32), ready for ops.jax_farmhash.hash32_rows.
+
+    ``max_digits`` defaults to 19 (any int64 encodes exactly).  Lowering it
+    shrinks buffers but is only sound if the caller guarantees every
+    incarnation number has at most that many decimal digits — a wider value
+    would silently corrupt the string (offsets account for the true digit
+    count while bytes past ``max_digits`` are never written)."""
+    width = width or universe.member_row_width(max_digits)
+    A = universe.addr_width
+    addr_bytes = jnp.asarray(universe.addr_bytes)
+    addr_len = jnp.asarray(universe.addr_len)
+    status_bytes = jnp.asarray(STATUS_BYTES)
+    status_len = jnp.asarray(STATUS_LEN)
+
+    def one_row(args):
+        pres, stat, inc = args
+        stat = stat.astype(jnp.int32)
+        pres_i = pres.astype(jnp.int32)
+        slen = status_len[stat]
+        dlen = _ndigits(inc)
+        seg_len = (addr_len + slen + dlen + 1) * pres_i
+        offset = jnp.cumsum(seg_len) - seg_len  # exclusive cumsum
+        total = jnp.maximum(jnp.sum(seg_len) - jnp.int32(1), 0) * (
+            pres_i.sum() > 0
+        ).astype(jnp.int32)
+
+        drop = jnp.int32(width)
+
+        # address part: [N, A]
+        ka = jnp.arange(A)
+        pos_a = offset[:, None] + ka[None, :]
+        ok_a = pres[:, None] & (ka[None, :] < addr_len[:, None])
+        pos_a = jnp.where(ok_a, pos_a, drop)
+
+        # status part: [N, 7]
+        ks = jnp.arange(_STATUS_W)
+        pos_s = offset[:, None] + addr_len[:, None] + ks[None, :]
+        ok_s = pres[:, None] & (ks[None, :] < slen[:, None])
+        pos_s = jnp.where(ok_s, pos_s, drop)
+        val_s = status_bytes[stat]
+
+        # digits part: [N, D]
+        kd = jnp.arange(max_digits)
+        pos_d = offset[:, None] + addr_len[:, None] + slen[:, None] + kd[None, :]
+        ok_d = pres[:, None] & (kd[None, :] < dlen[:, None])
+        pos_d = jnp.where(ok_d, pos_d, drop)
+        val_d = _digit_bytes(inc, dlen, max_digits)
+
+        # separator: [N, 1]
+        pos_sep = (offset + addr_len + slen + dlen)[:, None]
+        pos_sep = jnp.where(pres[:, None], pos_sep, drop)
+        val_sep = jnp.full((universe.n, 1), ord(";"), jnp.uint8)
+
+        positions = jnp.concatenate([pos_a, pos_s, pos_d, pos_sep], axis=1)
+        values = jnp.concatenate(
+            [jnp.broadcast_to(addr_bytes, (universe.n, A)), val_s, val_d, val_sep],
+            axis=1,
+        )
+        return _scatter_rows(width, positions, values), total
+
+    B = present.shape[0]
+    if B <= chunk:
+        bufs, lens = jax.vmap(lambda p, s, i: one_row((p, s, i)))(
+            present, status, incarnation
+        )
+    else:
+        pad = (-B) % chunk
+        p = jnp.pad(present, ((0, pad), (0, 0)))
+        s = jnp.pad(status, ((0, pad), (0, 0)))
+        i = jnp.pad(incarnation, ((0, pad), (0, 0)))
+        p = p.reshape(-1, chunk, universe.n)
+        s = s.reshape(-1, chunk, universe.n)
+        i = i.reshape(-1, chunk, universe.n)
+        bufs, lens = jax.lax.map(
+            lambda args: jax.vmap(lambda pp, ss, ii: one_row((pp, ss, ii)))(*args),
+            (p, s, i),
+        )
+        bufs = bufs.reshape(-1, width)[:B]
+        lens = lens.reshape(-1)[:B]
+    return bufs, lens
+
+
+def ring_rows(
+    universe: Universe,
+    in_ring: jax.Array,  # [B, N] bool — servers currently in each row's ring
+    width: Optional[int] = None,
+):
+    """Build per-row ring checksum strings (sorted names joined ';')."""
+    width = width or universe.ring_row_width()
+    A = universe.addr_width
+    addr_bytes = jnp.asarray(universe.addr_bytes)
+    addr_len = jnp.asarray(universe.addr_len)
+
+    def one_row(pres):
+        pres_i = pres.astype(jnp.int32)
+        seg_len = (addr_len + 1) * pres_i
+        offset = jnp.cumsum(seg_len) - seg_len
+        total = jnp.maximum(jnp.sum(seg_len) - jnp.int32(1), 0) * (
+            pres_i.sum() > 0
+        ).astype(jnp.int32)
+        drop = jnp.int32(width)
+
+        ka = jnp.arange(A)
+        pos_a = offset[:, None] + ka[None, :]
+        ok_a = pres[:, None] & (ka[None, :] < addr_len[:, None])
+        pos_a = jnp.where(ok_a, pos_a, drop)
+
+        pos_sep = (offset + addr_len)[:, None]
+        pos_sep = jnp.where(pres[:, None], pos_sep, drop)
+        val_sep = jnp.full((universe.n, 1), ord(";"), jnp.uint8)
+
+        positions = jnp.concatenate([pos_a, pos_sep], axis=1)
+        values = jnp.concatenate(
+            [jnp.broadcast_to(addr_bytes, (universe.n, A)), val_sep], axis=1
+        )
+        return _scatter_rows(width, positions, values), total
+
+    return jax.vmap(one_row)(in_ring)

@@ -1,0 +1,63 @@
+"""Test fixtures mirroring /root/reference/test/lib/test-ringpop.js:25-68 —
+a real membership stack with no transport, forced ready, local member alive —
+and a deterministic clock so incarnation numbers are reproducible."""
+
+from __future__ import annotations
+
+import random
+from typing import Optional
+
+from ringpop_tpu.models.membership import Membership, MembershipIterator
+from ringpop_tpu.utils.config import Config
+from ringpop_tpu.utils.util import null_logger
+
+
+class FakeClock:
+    """Deterministic Date.now() — starts at a realistic ms epoch and can be
+    advanced manually (the reference uses time-mock timers similarly)."""
+
+    def __init__(self, start_ms: int = 1414142122274):
+        self.ms = start_ms
+
+    def __call__(self) -> int:
+        return self.ms
+
+    def advance(self, ms: int) -> None:
+        self.ms += ms
+
+
+class RingpopFixture:
+    """Minimal ringpop context: config/logger/stat/whoami + membership."""
+
+    def __init__(
+        self,
+        host_port: str = "127.0.0.1:3000",
+        ready: bool = True,
+        seed: Optional[dict] = None,
+        clock: Optional[FakeClock] = None,
+    ):
+        self.host_port = host_port
+        self.is_ready = False
+        self.logger = null_logger()
+        self.config = Config(self, seed)
+        self.clock = clock or FakeClock()
+        self.now = self.clock
+        self.stats = []
+        self.membership = Membership(self, rng=random.Random(0xC0FFEE))
+        if ready:
+            self.membership.make_alive(self.host_port, self.now())
+            self.is_ready = True
+
+    def whoami(self) -> str:
+        return self.host_port
+
+    def stat(self, type_: str, key: str, value=None) -> None:
+        self.stats.append((type_, key, value))
+
+
+def make_ringpop(**kw) -> RingpopFixture:
+    return RingpopFixture(**kw)
+
+
+def make_iterator(rp: RingpopFixture) -> MembershipIterator:
+    return MembershipIterator(rp)

@@ -1,0 +1,222 @@
+"""Member precedence + damping tests mirroring
+/root/reference/test/unit/member_test.js."""
+
+from ringpop_tpu.models.membership import Member, Status, Update
+from tests.lib.fixtures import RingpopFixture
+
+
+def add_second_member(rp, address="127.0.0.1:3001"):
+    rp.membership.update(
+        [{"address": address, "status": Status.alive, "incarnationNumber": 1}]
+    )
+    return rp.membership.find_member_by_address(address)
+
+
+def test_damp_score_initialized():
+    rp = RingpopFixture()
+    m2 = add_second_member(rp)
+    assert m2.damp_score == rp.config.get("dampScoringInitial")
+
+
+def test_penalized_for_update():
+    rp = RingpopFixture()
+    m2 = add_second_member(rp)
+    m2.evaluate_update(
+        {"status": Status.suspect, "incarnationNumber": rp.now() + 1}
+    )
+    assert m2.damp_score != rp.config.get("dampScoringInitial")
+
+
+def test_flaps_until_exceeds_suppress_limit():
+    rp = RingpopFixture()
+    rp.config.set("dampScoringMax", 1000)
+    rp.config.set("dampScoringSuppressLimit", 500)
+    rp.config.set("dampScoringPenalty", 251)  # 2 updates is all it'll take
+    m2 = add_second_member(rp)
+    exceeded = []
+    m2.on("suppressLimitExceeded", lambda: exceeded.append(True))
+    m2.evaluate_update({"status": Status.suspect, "incarnationNumber": rp.now() + 1})
+    m2.evaluate_update({"status": Status.faulty, "incarnationNumber": rp.now() + 2})
+    assert m2.damp_score > rp.config.get("dampScoringSuppressLimit")
+    assert exceeded
+
+
+def test_damp_score_never_exceeds_max():
+    rp = RingpopFixture()
+    rp.config.set("dampScoringMax", 1000)
+    rp.config.set("dampScoringPenalty", 5000)
+    m2 = add_second_member(rp)
+    m2.evaluate_update({"status": Status.suspect, "incarnationNumber": rp.now() + 1})
+    assert m2.damp_score == rp.config.get("dampScoringMax")
+
+
+def test_penalized_in_penalty_increments():
+    rp = RingpopFixture()
+    rp.config.set("dampScoringMax", 1000)
+    rp.config.set("dampScoringPenalty", 100)
+    m2 = add_second_member(rp)
+    for i in range(1, 4):
+        m2.evaluate_update(
+            {"status": Status.suspect, "incarnationNumber": rp.now() + i}
+        )
+        assert m2.damp_score == rp.config.get("dampScoringPenalty") * i
+
+
+def decay_by(rp, member, term_ms):
+    member.now = lambda: rp.clock() + term_ms
+    member.decay_damp_score()
+
+
+def test_decays_by_arbitrary_amount():
+    rp = RingpopFixture()
+    m2 = add_second_member(rp)
+    m2.evaluate_update({"status": Status.suspect, "incarnationNumber": rp.now() + 1})
+    orig = m2.damp_score
+    decay_by(rp, m2, 1000 + 1)
+    assert m2.damp_score < orig
+
+
+def test_decayed_by_half():
+    rp = RingpopFixture()
+    m2 = add_second_member(rp)
+    m2.evaluate_update({"status": Status.suspect, "incarnationNumber": rp.now() + 1})
+    orig = m2.damp_score
+    decay_by(rp, m2, rp.config.get("dampScoringHalfLife") * 1000)
+    assert m2.damp_score == round(orig / 2)
+
+
+def test_never_decays_below_min():
+    rp = RingpopFixture()
+    rp.config.set("dampScoringInitial", 0)
+    rp.config.set("dampScoringPenalty", 100)
+    rp.config.set("dampScoringMin", 100)
+    rp.config.set("dampScoringMax", 1000)
+    m2 = add_second_member(rp)
+    i = 1
+    while m2.damp_score < rp.config.get("dampScoringMax"):
+        m2.evaluate_update(
+            {"status": Status.suspect, "incarnationNumber": rp.now() + i}
+        )
+        i += 1
+    decay_by(rp, m2, rp.config.get("dampScoringHalfLife") * 1000 * 4)
+    assert m2.damp_score == rp.config.get("dampScoringMin")
+
+
+def test_member_id_is_address():
+    rp = RingpopFixture()
+    address = "127.0.0.1:3000"
+    member = Member(rp, Update(address, 1, Status.alive))
+    assert member.id == address
+
+
+def test_update_happens_synchronously_or_not_at_all():
+    rp = RingpopFixture()
+    address = "127.0.0.1:3001"
+    inc = rp.now()
+    member = Member(rp, Update(address, inc, Status.alive))
+    emitted = []
+    member.on("updated", lambda u: emitted.append(u))
+
+    member.evaluate_update(
+        {"address": address, "status": Status.suspect, "incarnationNumber": inc + 1}
+    )
+    assert emitted
+
+    emitted.clear()
+    member.evaluate_update(
+        {"address": address, "status": Status.suspect, "incarnationNumber": inc + 1}
+    )
+    assert not emitted
+
+
+# -- the full precedence table (member.js:171-202), exhaustively -------------
+
+
+def test_precedence_table_exhaustive():
+    statuses = [Status.alive, Status.suspect, Status.faulty, Status.leave]
+
+    def expected(cur_status, cur_inc, upd_status, upd_inc):
+        if upd_status == Status.alive:
+            return upd_inc > cur_inc
+        if upd_status == Status.suspect:
+            if cur_status in (Status.suspect, Status.faulty):
+                return upd_inc > cur_inc
+            if cur_status == Status.alive:
+                return upd_inc >= cur_inc
+            return False  # cur leave
+        if upd_status == Status.faulty:
+            if cur_status == Status.suspect:
+                return upd_inc >= cur_inc
+            if cur_status == Status.faulty:
+                return upd_inc > cur_inc
+            if cur_status == Status.alive:
+                return upd_inc >= cur_inc
+            return False  # cur leave
+        if upd_status == Status.leave:
+            return cur_status != Status.leave and upd_inc >= cur_inc
+        return False
+
+    rp = RingpopFixture()
+    for cur_status in statuses:
+        for upd_status in statuses:
+            for delta in (-1, 0, 1):
+                cur_inc = 1000
+                upd_inc = cur_inc + delta
+                member = Member(
+                    rp, Update("127.0.0.1:3009", cur_inc, cur_status)
+                )
+                applied = member.evaluate_update(
+                    {
+                        "address": "127.0.0.1:3009",
+                        "status": upd_status,
+                        "incarnationNumber": upd_inc,
+                    }
+                )
+                want = expected(cur_status, cur_inc, upd_status, upd_inc)
+                assert applied == want, (cur_status, upd_status, delta)
+                if want:
+                    assert member.status == upd_status
+                    assert member.incarnation_number == upd_inc
+                else:
+                    assert member.status == cur_status
+                    assert member.incarnation_number == cur_inc
+
+
+def test_local_refute_on_suspect_and_faulty():
+    # member.js:76-81,155-169: local member re-asserts alive with fresh
+    # incarnation on suspect/faulty claims about itself
+    for claim in (Status.suspect, Status.faulty):
+        rp = RingpopFixture()
+        local = rp.membership.local_member
+        orig_inc = local.incarnation_number
+        rp.clock.advance(5000)
+        rp.membership.update(
+            [
+                {
+                    "address": rp.whoami(),
+                    "status": claim,
+                    "incarnationNumber": orig_inc,
+                }
+            ]
+        )
+        assert local.status == Status.alive
+        assert local.incarnation_number == rp.now()
+        assert local.incarnation_number > orig_inc
+
+
+def test_local_leave_is_not_refuted():
+    # leave about the local member is applied (higher inc), not refuted —
+    # membership_test.js 'change with higher incarnation number results in
+    # leave override'
+    rp = RingpopFixture()
+    local = rp.membership.local_member
+    rp.membership.update(
+        [
+            {
+                "address": rp.whoami(),
+                "status": Status.leave,
+                "incarnationNumber": local.incarnation_number + 1,
+            }
+        ]
+    )
+    assert local.status == Status.leave

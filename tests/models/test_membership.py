@@ -1,0 +1,180 @@
+"""Membership collection tests mirroring
+/root/reference/test/unit/membership_test.js, membership-changeset-merge,
+membership-iterator, and the checksum-string format."""
+
+from ringpop_tpu.models.membership import (
+    Status,
+    Update,
+    merge_membership_changesets,
+)
+from ringpop_tpu.ops import farmhash32 as fh
+from tests.lib.fixtures import RingpopFixture, make_iterator
+
+
+def test_checksum_changes_on_update():
+    rp = RingpopFixture()
+    rp.membership.make_alive("127.0.0.1:3001", rp.now())
+    prev = rp.membership.checksum
+    rp.membership.make_alive("127.0.0.1:3002", rp.now())
+    assert rp.membership.checksum != prev
+
+
+def test_checksum_string_format_and_hash():
+    rp = RingpopFixture()
+    rp.membership.make_alive("127.0.0.1:3001", 1414142122275)
+    rp.membership.make_suspect("127.0.0.1:3001", 1414142122275)
+    s = rp.membership.generate_checksum_string()
+    local_inc = rp.membership.local_member.incarnation_number
+    assert s == (
+        "127.0.0.1:3000alive%d;127.0.0.1:3001suspect1414142122275" % local_inc
+    )
+    assert rp.membership.checksum == fh.hash32(s)
+
+
+def test_suspect_faulty_update_refutes_local():
+    for status in (Status.suspect, Status.faulty):
+        rp = RingpopFixture()
+        local = rp.membership.local_member
+        prev_inc = local.incarnation_number
+        rp.clock.advance(1)
+        rp.membership.update(
+            [
+                {
+                    "address": local.address,
+                    "status": status,
+                    "incarnationNumber": prev_inc,
+                }
+            ]
+        )
+        assert local.status == Status.alive
+        assert local.incarnation_number > prev_inc
+
+
+def test_alive_to_faulty_without_suspect():
+    rp = RingpopFixture()
+    rp.membership.make_alive("127.0.0.1:3001", rp.now())
+    member = rp.membership.find_member_by_address("127.0.0.1:3001")
+
+    # lower incarnation: no override
+    rp.membership.update(
+        [
+            {
+                "address": member.address,
+                "status": Status.faulty,
+                "incarnationNumber": member.incarnation_number - 1,
+            }
+        ]
+    )
+    assert member.status == Status.alive
+
+    # same incarnation: faulty overrides alive
+    rp.membership.update(
+        [
+            {
+                "address": member.address,
+                "status": Status.faulty,
+                "incarnationNumber": member.incarnation_number,
+            }
+        ]
+    )
+    assert member.status == Status.faulty
+
+
+def test_update_buffered_until_ready():
+    rp = RingpopFixture(ready=False)
+    rp.membership.make_alive(rp.whoami(), rp.now())  # local: applied directly
+
+    # non-local updates stash until set()
+    rp.membership.update(
+        [{"address": "127.0.0.1:3001", "status": Status.alive, "incarnationNumber": 1}]
+    )
+    assert rp.membership.get_member_count() == 1
+    assert len(rp.membership.stashed_updates) == 1
+
+    rp.membership.set()
+    assert rp.membership.get_member_count() == 2
+    assert rp.membership.stashed_updates is None
+    assert rp.membership.checksum is not None
+
+
+def test_set_merges_stashed_changesets():
+    rp = RingpopFixture(ready=False)
+    rp.membership.make_alive(rp.whoami(), rp.now())
+    rp.membership.update(
+        [{"address": "127.0.0.1:3001", "status": Status.alive, "incarnationNumber": 1}]
+    )
+    rp.membership.update(
+        [{"address": "127.0.0.1:3001", "status": Status.faulty, "incarnationNumber": 5}]
+    )
+    rp.membership.set()
+    m = rp.membership.find_member_by_address("127.0.0.1:3001")
+    # highest incarnation wins in the merge (merge.js:39-41)
+    assert m.status == Status.faulty
+    assert m.incarnation_number == 5
+
+
+def test_changeset_merge_skips_local_and_keeps_highest():
+    rp = RingpopFixture()
+    cs1 = [
+        Update("127.0.0.1:3001", 1, Status.alive),
+        Update(rp.whoami(), 99, Status.faulty),
+    ]
+    cs2 = [Update("127.0.0.1:3001", 3, Status.suspect)]
+    merged = merge_membership_changesets(rp, [cs1, cs2])
+    assert len(merged) == 1
+    assert merged[0].incarnation_number == 3
+    assert merged[0].status == Status.suspect
+
+
+def test_get_random_pingable_members_excludes():
+    rp = RingpopFixture()
+    for i in range(1, 6):
+        rp.membership.make_alive("127.0.0.1:300%d" % i, rp.now())
+    got = rp.membership.get_random_pingable_members(10, ["127.0.0.1:3001"])
+    addrs = {m.address for m in got}
+    assert "127.0.0.1:3001" not in addrs
+    assert rp.whoami() not in addrs  # local never pingable
+    assert len(got) == 4
+
+    two = rp.membership.get_random_pingable_members(2, [])
+    assert len(two) == 2
+
+
+def test_iterator_round_robin_visits_all_pingable():
+    rp = RingpopFixture()
+    others = ["127.0.0.1:300%d" % i for i in range(1, 5)]
+    for a in others:
+        rp.membership.make_alive(a, rp.now())
+    it = make_iterator(rp)
+    seen = [it.next().address for _ in range(len(others))]
+    assert sorted(seen) == sorted(others)  # one full round hits each once
+    # second round revisits (reshuffled)
+    seen2 = [it.next().address for _ in range(len(others))]
+    assert sorted(seen2) == sorted(others)
+
+
+def test_iterator_skips_faulty_and_local():
+    rp = RingpopFixture()
+    rp.membership.make_alive("127.0.0.1:3001", rp.now())
+    rp.membership.make_alive("127.0.0.1:3002", rp.now())
+    rp.membership.make_faulty("127.0.0.1:3002", rp.now())
+    it = make_iterator(rp)
+    for _ in range(6):
+        m = it.next()
+        assert m.address == "127.0.0.1:3001"
+
+
+def test_iterator_returns_none_when_no_pingable():
+    rp = RingpopFixture()
+    it = make_iterator(rp)
+    assert it.next() is None  # only the local member exists
+
+
+def test_new_member_inserted_at_join_position():
+    rp = RingpopFixture()
+    for i in range(1, 10):
+        rp.membership.make_alive("127.0.0.1:30%02d" % i, rp.now())
+    # members list isn't (necessarily) in insertion order; address index works
+    assert rp.membership.get_member_count() == 10
+    for i in range(1, 10):
+        assert rp.membership.find_member_by_address("127.0.0.1:30%02d" % i)

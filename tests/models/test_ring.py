@@ -1,0 +1,202 @@
+"""Hash ring tests mirroring /root/reference/test/unit/ring-test.js and
+hashring_test.js, plus device-ring equivalence against the host ring."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from ringpop_tpu.models.ring import HashRing
+from ringpop_tpu.models.ring import device as dring
+from ringpop_tpu.ops import farmhash32 as fh
+
+
+def create_servers(n):
+    return ["127.0.0.1:%d" % (3000 + i) for i in range(n)]
+
+
+def extract_port(server: str) -> int:
+    # the reference's deterministic stub hashFunc (ring-test.js:32-34)
+    return int(str(server)[str(server).rindex(":") + 1 :])
+
+
+SERVERS = create_servers(200)
+
+
+def test_server_count_add_remove():
+    ring = HashRing()
+    ring.add_remove_servers(SERVERS, None)
+    assert ring.get_server_count() == len(SERVERS)
+    ring.add_remove_servers(None, SERVERS)
+    assert ring.get_server_count() == 0
+    ring.add_remove_servers(SERVERS, SERVERS)
+    assert ring.get_server_count() == 0
+
+
+def test_checksum_computed_once_per_bulk_change():
+    ring = HashRing()
+    count = []
+    ring.on("checksumComputed", lambda: count.append(1))
+    ring.add_remove_servers(SERVERS, SERVERS)
+    assert len(count) == 1
+
+
+def test_lookup_own_replica_point():
+    # '1000 lookups' (ring-test.js:65-79): lookup(server + '0') lands exactly
+    # on server's replica-0 point; the rbtree's upperBound is >= (lower bound)
+    ring = HashRing()
+    ring.add_remove_servers(SERVERS, None)
+    for server in SERVERS:
+        assert ring.lookup(server + "0") == server
+
+
+def test_lookup_n_with_port_hash():
+    # '1000 lookupN' (ring-test.js:81-100): with hashFunc=extractPort the
+    # successors are the next servers by port
+    servers = SERVERS[:50]
+    ring = HashRing(hash_func=extract_port)
+    ring.add_remove_servers(servers, None)
+    for i, server in enumerate(servers):
+        expect = [
+            servers[i],
+            servers[(i + 1) % len(servers)],
+            servers[(i + 2) % len(servers)],
+        ]
+        assert ring.lookup_n(server + "0", 3) == expect
+
+
+def test_lookup_n_small_and_empty_ring():
+    ring = HashRing(hash_func=extract_port)
+    server = SERVERS[0]
+    ring.add_remove_servers([server], None)
+    assert ring.lookup_n(server + "0", 3) == [server]
+
+    empty = HashRing(hash_func=extract_port)
+    assert empty.lookup_n(server + "0", 3) == []
+
+
+def test_lookup_n_corrupted_ring():
+    # serverCount out of sync with the point table must not loop forever
+    ring = HashRing(hash_func=extract_port)
+    ring.add_remove_servers([SERVERS[0]], None)
+    ring.servers[SERVERS[1]] = True  # corrupt: claims 2 servers, tree has 1
+    assert ring.lookup_n(SERVERS[0] + "0", 3) == [SERVERS[0]]
+
+    empty = HashRing(hash_func=extract_port)
+    empty.servers[SERVERS[0]] = True
+    assert empty.lookup_n(SERVERS[0] + "0", 3) == []
+
+
+def test_checksum_lifecycle():
+    ring = HashRing()
+    assert ring.checksum is None
+    ring.add_server(SERVERS[0])
+    first = ring.checksum
+    assert first is not None
+    ring.remove_server("127.0.0.1:9999")  # non-existent: no recompute
+    assert ring.checksum == first
+    ring.add_server(SERVERS[1])
+    assert ring.checksum != first
+    ring.remove_server(SERVERS[1])
+    assert ring.checksum == first
+
+
+def test_checksum_order_independent():
+    a = HashRing()
+    b = HashRing()
+    for s in SERVERS[:10]:
+        a.add_server(s)
+    for s in reversed(SERVERS[:10]):
+        b.add_server(s)
+    assert a.checksum == b.checksum
+    # checksum equals hash32 of sorted names joined ';'
+    assert a.checksum == fh.hash32(";".join(sorted(SERVERS[:10])))
+
+
+def test_wraparound_past_max_hash():
+    ring = HashRing()
+    ring.add_remove_servers(SERVERS[:8], None)
+    hashes, owners = ring.table()
+    # a key hashing beyond the max ring point must wrap to the ring minimum
+    max_hash = int(hashes.max())
+    # find a key whose hash exceeds every point (search a few candidates)
+    key = None
+    for i in range(100000):
+        cand = "wrap-%d" % i
+        if fh.hash32(cand) > max_hash:
+            key = cand
+            break
+    if key is None:
+        pytest.skip("no key found beyond max point hash")
+    min_owner = owners[int(np.argmin(hashes))]
+    assert ring.lookup(key) == min_owner
+
+
+# -- device ring equivalence -------------------------------------------------
+
+
+def test_device_ring_matches_host():
+    servers = create_servers(32)
+    universe = sorted(servers)
+    table = dring.replica_table(universe, replica_points=100)
+
+    host = HashRing()
+    host.add_remove_servers(servers, None)
+
+    mask = jnp.ones(len(universe), bool)
+    ring = dring.build_ring(jnp.asarray(table), mask)
+    n_points = dring.ring_size(mask, 100)
+
+    keys = ["key-%d" % i for i in range(300)]
+    key_hashes = jnp.asarray(fh.hash32_strings(keys))
+    owners = np.asarray(
+        jnp.stack([dring.lookup(ring, n_points, h) for h in key_hashes])
+    )
+    for k, o in zip(keys, owners):
+        assert universe[int(o)] == host.lookup(k), k
+
+
+def test_device_ring_masked_rebuild_matches_host_subset():
+    servers = create_servers(24)
+    universe = sorted(servers)
+    table = dring.replica_table(universe, replica_points=100)
+
+    alive = [s for i, s in enumerate(universe) if i % 3 != 0]
+    host = HashRing()
+    host.add_remove_servers(alive, None)
+
+    mask = jnp.asarray([i % 3 != 0 for i in range(len(universe))])
+    ring = dring.build_ring(jnp.asarray(table), mask)
+    n_points = dring.ring_size(mask, 100)
+
+    for k in ["alpha", "beta", "gamma", "host:123", "127.0.0.1:30001"]:
+        h = jnp.asarray(np.uint32(fh.hash32(k)))
+        got = int(dring.lookup(ring, n_points, h))
+        assert universe[got] == host.lookup(k), k
+
+
+def test_device_lookup_n_matches_host():
+    servers = create_servers(16)
+    universe = sorted(servers)
+    table = dring.replica_table(universe, replica_points=100)
+    host = HashRing()
+    host.add_remove_servers(servers, None)
+
+    mask = jnp.ones(len(universe), bool)
+    ring = dring.build_ring(jnp.asarray(table), mask)
+    n_points = dring.ring_size(mask, 100)
+
+    for k in ["a", "bb", "ccc", "127.0.0.1:3005"]:
+        h = jnp.asarray(np.uint32(fh.hash32(k)))
+        got = [int(x) for x in dring.lookup_n(ring, n_points, h, 4)]
+        got_names = [universe[g] for g in got if g >= 0]
+        assert got_names == host.lookup_n(k, 4), k
+
+
+def test_device_empty_ring():
+    table = dring.replica_table(["127.0.0.1:3000"], replica_points=10)
+    mask = jnp.zeros(1, bool)
+    ring = dring.build_ring(jnp.asarray(table), mask)
+    n_points = dring.ring_size(mask, 10)
+    h = jnp.asarray(np.uint32(fh.hash32("x")))
+    assert int(dring.lookup(ring, n_points, h)) == -1
+    assert all(int(x) == -1 for x in dring.lookup_n(ring, n_points, h, 3))

@@ -1,0 +1,74 @@
+"""In-jit checksum-string encoding vs host-built strings."""
+
+import jax.numpy as jnp
+import numpy as np
+
+from ringpop_tpu.ops import checksum_encode as ce
+from ringpop_tpu.ops import farmhash32 as fh
+from ringpop_tpu.ops import jax_farmhash as jfh
+
+STATUS_NAME = ce.STATUS_STRINGS
+
+
+def host_membership_string(members):
+    # the reference's generateChecksumString (membership/index.js:100-123)
+    ordered = sorted(members, key=lambda m: m[0])
+    return ";".join("%s%s%d" % (a, STATUS_NAME[s], i) for a, s, i in ordered)
+
+
+def test_membership_rows_match_host_strings():
+    addrs = ["127.0.0.1:%d" % (3000 + i) for i in range(17)] + ["10.0.0.9:99"]
+    uni = ce.Universe.from_addresses(addrs)
+    n = uni.n
+
+    rng = np.random.default_rng(3)
+    B = 5
+    present = rng.random((B, n)) > 0.3
+    present[0] = True  # full membership row
+    present[1] = False  # empty row
+    status = rng.integers(0, 4, size=(B, n))
+    inc = rng.integers(1, 10**14, size=(B, n))
+    inc[2, :] = 7  # single-digit incarnations
+    inc[3, :5] = 0  # zero incarnation edge ("0" is one digit)
+
+    bufs, lens = ce.membership_rows(
+        uni,
+        jnp.asarray(present),
+        jnp.asarray(status),
+        jnp.asarray(inc),
+        chunk=2,  # force the lax.map chunked path
+    )
+    hashes = np.asarray(jfh.hash32_rows_jit(bufs, lens))
+    bufs = np.asarray(bufs)
+    lens = np.asarray(lens)
+
+    for b in range(B):
+        members = [
+            (uni.addresses[j], int(status[b, j]), int(inc[b, j]))
+            for j in range(n)
+            if present[b, j]
+        ]
+        want = host_membership_string(members)
+        got = bytes(bufs[b, : lens[b]]).decode()
+        assert got == want, (b, got[:80], want[:80])
+        assert int(hashes[b]) == fh.hash32(want)
+
+
+def test_ring_rows_match_host_strings():
+    addrs = ["h%d:%d" % (i, 1000 + i) for i in range(9)]
+    uni = ce.Universe.from_addresses(addrs)
+    rng = np.random.default_rng(11)
+    B = 4
+    in_ring = rng.random((B, uni.n)) > 0.4
+    in_ring[1] = False
+
+    bufs, lens = ce.ring_rows(uni, jnp.asarray(in_ring))
+    bufs = np.asarray(bufs)
+    lens = np.asarray(lens)
+    for b in range(B):
+        want = ";".join(
+            sorted(a for j, a in enumerate(uni.addresses) if in_ring[b, j])
+        )
+        got = bytes(bufs[b, : lens[b]]).decode()
+        assert got == want
+        assert fh.hash32(got) == fh.hash32(want)
