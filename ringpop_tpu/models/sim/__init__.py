@@ -1,0 +1,17 @@
+from ringpop_tpu.models.sim.engine import (
+    SimParams,
+    SimState,
+    TickInputs,
+    init_state,
+    tick,
+    compute_checksums,
+)
+
+__all__ = [
+    "SimParams",
+    "SimState",
+    "TickInputs",
+    "init_state",
+    "tick",
+    "compute_checksums",
+]

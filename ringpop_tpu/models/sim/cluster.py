@@ -1,0 +1,189 @@
+"""SimCluster — host-side driver for the batched SWIM simulator.
+
+Plays the role of the reference's tick-cluster harness
+(/root/reference/scripts/tick-cluster.js): spawn N (simulated) nodes, join
+them, tick the gossip protocol, inject faults (kill/revive/partition), and
+watch convergence via membership-checksum grouping
+(tick-cluster.js:87-114 groups nodes by checksum; the convergence benchmark
+declares convergence when every live node reports the same checksum,
+benchmarks/convergence-time/scenario-runner.js:152-170).
+
+Two stepping modes:
+- ``step()`` — one compiled tick; keeps state on device, events supplied per
+  call (interactive tick-cluster-style use).
+- ``run(ticks)`` — ``lax.scan`` over a precompiled tick with a dense event
+  schedule, the high-throughput path for benchmarks.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Dict, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ringpop_tpu.models.sim import engine
+from ringpop_tpu.ops import checksum_encode as ce
+
+
+def default_addresses(n: int, base_port: int = 3000, host: str = "127.0.0.1") -> List[str]:
+    return ["%s:%d" % (host, base_port + i) for i in range(n)]
+
+
+@dataclasses.dataclass
+class EventSchedule:
+    """Dense per-tick fault-injection plan for ``run()``."""
+
+    ticks: int
+    n: int
+    kill: np.ndarray = None  # [T, N] bool
+    revive: np.ndarray = None
+    join: np.ndarray = None
+    partition: np.ndarray = None  # [T, N] int32
+
+    def __post_init__(self):
+        T, n = self.ticks, self.n
+        if self.kill is None:
+            self.kill = np.zeros((T, n), bool)
+        if self.revive is None:
+            self.revive = np.zeros((T, n), bool)
+        if self.join is None:
+            self.join = np.zeros((T, n), bool)
+        if self.partition is None:
+            self.partition = np.full((T, n), -1, np.int32)  # -1 keeps current
+
+    def as_inputs(self) -> engine.TickInputs:
+        return engine.TickInputs(
+            kill=jnp.asarray(self.kill),
+            revive=jnp.asarray(self.revive),
+            join=jnp.asarray(self.join),
+            partition=jnp.asarray(self.partition),
+        )
+
+
+class SimCluster:
+    def __init__(
+        self,
+        n: Optional[int] = None,
+        addresses: Optional[Sequence[str]] = None,
+        params: Optional[engine.SimParams] = None,
+        seed: int = 0,
+    ):
+        if addresses is None:
+            if n is None:
+                raise ValueError("need n or addresses")
+            addresses = default_addresses(n)
+        self.universe = ce.Universe.from_addresses(addresses)
+        n = self.universe.n
+        self.params = params or engine.SimParams(n=n)
+        if self.params.n != n:
+            self.params = self.params._replace(n=n)
+        self.state = engine.init_state(self.params, seed=seed)
+        self._tick = jax.jit(
+            functools.partial(
+                engine.tick, params=self.params, universe=self.universe
+            )
+        )
+
+        @jax.jit
+        def _scanned(state, inputs):
+            def body(st, inp):
+                st, m = engine.tick(st, inp, self.params, self.universe)
+                return st, m
+
+            return jax.lax.scan(body, state, inputs)
+
+        self._scanned = _scanned  # compiled once; reused by every run()
+
+    # -- lifecycle --------------------------------------------------------
+
+    def bootstrap(self) -> engine.TickMetrics:
+        """Join every node at once (the tick-cluster 'j' command)."""
+        inputs = engine.TickInputs.quiet(self.params.n)._replace(
+            join=jnp.ones(self.params.n, bool)
+        )
+        return self.step(inputs)
+
+    def step(self, inputs: Optional[engine.TickInputs] = None) -> engine.TickMetrics:
+        if inputs is None:
+            inputs = engine.TickInputs.quiet(self.params.n)
+        self.state, metrics = self._tick(self.state, inputs)
+        return jax.tree.map(np.asarray, metrics)
+
+    def run(self, schedule: EventSchedule):
+        """Scan the tick over a dense event schedule; returns stacked
+        per-tick metrics (a TickMetrics of [T]-arrays)."""
+        inputs = schedule.as_inputs()
+        self.state, metrics = self._scanned(self.state, inputs)
+        return jax.tree.map(np.asarray, metrics)
+
+    def run_until_converged(self, max_ticks: int = 200, quiet_after: int = 0) -> int:
+        """Tick until every live+ready node shares one checksum; returns the
+        number of ticks taken (or -1 if not converged within max_ticks)."""
+        for t in range(max_ticks):
+            m = self.step()
+            if t >= quiet_after and bool(m.converged):
+                return t + 1
+        return -1
+
+    # -- fault injection (tick-cluster k/K/l keys) ------------------------
+
+    def kill(self, indices: Sequence[int]) -> engine.TickMetrics:
+        inputs = engine.TickInputs.quiet(self.params.n)
+        kill = np.zeros(self.params.n, bool)
+        kill[list(indices)] = True
+        return self.step(inputs._replace(kill=jnp.asarray(kill)))
+
+    def revive(self, indices: Sequence[int]) -> engine.TickMetrics:
+        inputs = engine.TickInputs.quiet(self.params.n)
+        rv = np.zeros(self.params.n, bool)
+        rv[list(indices)] = True
+        return self.step(inputs._replace(revive=jnp.asarray(rv)))
+
+    def partition(self, groups: Sequence[int]) -> engine.TickMetrics:
+        inputs = engine.TickInputs.quiet(self.params.n)
+        return self.step(
+            inputs._replace(partition=jnp.asarray(np.asarray(groups, np.int32)))
+        )
+
+    # -- inspection -------------------------------------------------------
+
+    def checksums(self) -> np.ndarray:
+        return np.asarray(self.state.checksum)
+
+    def checksum_groups(self) -> Dict[int, List[str]]:
+        """Group live+ready nodes by membership checksum — the tick-cluster
+        convergence view (tick-cluster.js:87-114)."""
+        cs = self.checksums()
+        alive = np.asarray(self.state.proc_alive & self.state.ready)
+        groups: Dict[int, List[str]] = {}
+        for i, a in enumerate(self.universe.addresses):
+            if alive[i]:
+                groups.setdefault(int(cs[i]), []).append(a)
+        return groups
+
+    def membership_of(self, i: int) -> List[dict]:
+        """Node i's member list (sorted by address), host-readable."""
+        known = np.asarray(self.state.known[i])
+        status = np.asarray(self.state.status[i])
+        inc = np.asarray(self.state.inc[i])
+        out = []
+        for j, a in enumerate(self.universe.addresses):
+            if known[j]:
+                out.append(
+                    {
+                        "address": a,
+                        "status": ce.STATUS_STRINGS[int(status[j])],
+                        "incarnationNumber": int(inc[j]),
+                    }
+                )
+        return out
+
+    def checksum_string_of(self, i: int) -> str:
+        return ";".join(
+            "%s%s%d" % (m["address"], m["status"], m["incarnationNumber"])
+            for m in self.membership_of(i)
+        )

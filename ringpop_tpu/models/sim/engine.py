@@ -1,0 +1,666 @@
+"""The batched SWIM protocol period — one gossip tick for N nodes at once.
+
+This is the TPU-native heart of the framework: the reference's event-loop of
+timers, callbacks and RPCs (lib/gossip/index.js tick at :135-192, ping/
+ping-req senders, dissemination, suspicion) becomes ONE pure function
+``tick(state, inputs, params) -> (state, metrics)`` over dense arrays with an
+N-node axis, scanned by ``lax.scan`` and shardable over a device mesh.
+
+State model (full-fidelity mode): node i's *view* of node j is
+``(known, status, incarnation)[i, j]``; the dissemination change table
+(dissemination.js ``this.changes``) is the ``ch_*[i, j]`` arrays; suspicion
+timers are per-(i, j) deadline ticks.  The SWIM member update rules
+(member.js:71-202) are a vectorized precedence gate; conflicting same-tick
+updates from multiple senders are combined with a (incarnation, status-rank)
+key-max before gating — see ``_overrides`` for the exact table.
+
+Discrete-time model and its documented deviation envelope:
+
+- One tick == one protocol period for every live node simultaneously (the
+  reference staggers first ticks by 0..200 ms and adapts period length;
+  under a controlled schedule those only permute message interleavings).
+- Incarnation clock: ``now_ms = epoch_ms + tick_index * period_ms`` replaces
+  ``Date.now()`` so trajectories are exactly reproducible.
+- A failed direct ping triggers ping-req *within the same tick* (the
+  reference's 1.5s/5s timeouts span protocol periods; the sender's gossip
+  loop blocks on the exchange either way, gossip/index.js:61-87).
+- Ping-req probes carry no piggybacked changes (the reference piggybacks on
+  ping-req too); dissemination via ping + response + full-sync dominates.
+- Within a tick, phases apply in a fixed order: join -> ping send ->
+  receiver apply -> responses (incl. full-sync) -> sender apply -> ping-req
+  -> suspicion expiry -> checksums.  The reference's per-message ordering is
+  a race among sockets; any serialization of the same messages is inside its
+  nondeterminism envelope.
+- New members enter iteration order at an effectively random position: the
+  per-node round-robin permutation is drawn over the whole universe up
+  front, unknown members are skipped (the reference inserts new members at
+  a random list position, membership/index.js:285).
+
+Cited reference behavior preserved exactly:
+- piggyback bump-even-on-failed-send (dissemination.js:142-155 TODO quirk),
+  drop at ``count > 15 * ceil(log10(serverCount + 1))`` (dissemination.js:41).
+- receiver filters changes originated by the pinging sender
+  (dissemination.js:91-98); full membership sync when no changes remain and
+  checksums disagree (dissemination.js:101-114).
+- refute: a node seeing itself suspect/faulty re-asserts alive with a fresh
+  incarnation (member.js:76-81) — and the refuted update keeps the original
+  update's source, matching `_.defaults` there.
+- suspect -> 5s (in ticks) -> faulty with the member's *current* incarnation
+  (suspicion.js:65-70); timers restart on re-suspect, stop on non-suspect
+  updates (on_membership_event.js:86-104).
+- ping-req: k=3 random pingable members excluding the target
+  (ping-req-sender.js:293-296); all-responders-say-unreachable => suspect
+  (ping-req-sender.js:249-262); no responders => inconclusive, no-op.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ringpop_tpu.ops import checksum_encode as ce
+from ringpop_tpu.ops import jax_farmhash as jfh
+
+# status codes (== ce.STATUS_*): rank order IS override priority at equal
+# incarnation: alive < suspect < faulty < leave
+ALIVE, SUSPECT, FAULTY, LEAVE = 0, 1, 2, 3
+
+NO_TARGET = jnp.int32(-1)
+
+
+class SimParams(NamedTuple):
+    """Static protocol constants (compile-time)."""
+
+    n: int
+    period_ms: int = 200  # gossip/index.js:194-196
+    epoch_ms: int = 1414142122274
+    suspicion_ticks: int = 25  # 5000 ms / 200 ms — suspicion.js:111-113
+    ping_req_size: int = 3  # index.js:113
+    join_size: int = 3  # join-sender.js:52
+    piggyback_factor: int = 15  # dissemination.js:180
+    max_digits: int = 14  # incarnation digit bound (ms epoch timestamps)
+    packet_loss: float = 0.0
+
+
+class SimState(NamedTuple):
+    """Per-node views + protocol state. All [N]- or [N, N]-shaped."""
+
+    tick_index: jax.Array  # scalar int32
+    # process-level (fault injection plane, not SWIM state)
+    proc_alive: jax.Array  # [N] bool
+    ready: jax.Array  # [N] bool (bootstrapped)
+    gossip_on: jax.Array  # [N] bool
+    partition: jax.Array  # [N] int32 — group id; unequal groups can't talk
+    # membership views
+    known: jax.Array  # [N, N] bool
+    status: jax.Array  # [N, N] int32
+    inc: jax.Array  # [N, N] int64
+    # dissemination change table (per node, keyed by subject)
+    ch_active: jax.Array  # [N, N] bool
+    ch_status: jax.Array  # [N, N] int32
+    ch_inc: jax.Array  # [N, N] int64
+    ch_source: jax.Array  # [N, N] int32
+    ch_source_inc: jax.Array  # [N, N] int64
+    ch_pb: jax.Array  # [N, N] int32 piggyback counts
+    # suspicion deadlines (absolute tick; -1 inactive)
+    susp_deadline: jax.Array  # [N, N] int32
+    # iterator state
+    perm: jax.Array  # [N, N] int32 — per-node member iteration order
+    iter_pos: jax.Array  # [N] int32
+    # per-node PRNG keys
+    rng: jax.Array  # [N, 2] uint32
+    # cached checksums
+    checksum: jax.Array  # [N] uint32
+
+
+class TickInputs(NamedTuple):
+    """Per-tick event-schedule inputs (the fault-injection plane)."""
+
+    kill: jax.Array  # [N] bool — SIGKILL this tick (proc_alive -> False)
+    revive: jax.Array  # [N] bool — restart this tick (fresh state, rejoin)
+    join: jax.Array  # [N] bool — bootstrap/join this tick
+    partition: jax.Array  # [N] int32 — group assignment; -1 keeps current
+
+    @staticmethod
+    def quiet(n: int) -> "TickInputs":
+        return TickInputs(
+            kill=jnp.zeros(n, bool),
+            revive=jnp.zeros(n, bool),
+            join=jnp.zeros(n, bool),
+            partition=jnp.full(n, -1, jnp.int32),
+        )
+
+
+class TickMetrics(NamedTuple):
+    pings_sent: jax.Array
+    pings_delivered: jax.Array
+    ping_reqs: jax.Array
+    full_syncs: jax.Array
+    changes_applied: jax.Array
+    suspects_marked: jax.Array
+    faulties_marked: jax.Array
+    distinct_checksums: jax.Array  # among participating (alive+ready) nodes
+    converged: jax.Array  # bool
+
+
+def _overrides(u_status, u_inc, c_status, c_inc):
+    """The exact SWIM precedence table (member.js:171-202), vectorized."""
+    alive_ov = (u_status == ALIVE) & (u_inc > c_inc)
+    suspect_ov = (u_status == SUSPECT) & (
+        ((c_status == SUSPECT) & (u_inc > c_inc))
+        | ((c_status == FAULTY) & (u_inc > c_inc))
+        | ((c_status == ALIVE) & (u_inc >= c_inc))
+    )
+    faulty_ov = (u_status == FAULTY) & (
+        ((c_status == SUSPECT) & (u_inc >= c_inc))
+        | ((c_status == FAULTY) & (u_inc > c_inc))
+        | ((c_status == ALIVE) & (u_inc >= c_inc))
+    )
+    leave_ov = (u_status == LEAVE) & (c_status != LEAVE) & (u_inc >= c_inc)
+    return alive_ov | suspect_ov | faulty_ov | leave_ov
+
+
+def _pack_key(inc, status):
+    """Winner-combine key: lexicographic (incarnation, status-rank)."""
+    return inc.astype(jnp.int64) * 4 + status.astype(jnp.int64)
+
+
+def _max_piggyback(server_count: jax.Array, factor: int) -> jax.Array:
+    """15 * ceil(log10(n + 1)) via integer digit count (dissemination.js:41)."""
+    count = jnp.zeros(server_count.shape, jnp.int32)
+    for k in range(10):  # server counts < 10^10
+        count = count + (server_count >= 10**k).astype(jnp.int32)
+    return factor * count
+
+
+def _fold(rng: jax.Array, salt: int) -> jax.Array:
+    """Cheap per-node key derivation: [N, 2] uint32 -> new [N, 2] uint32."""
+    k0 = rng[:, 0] * np.uint32(0x9E3779B9) + np.uint32(salt)
+    k1 = rng[:, 1] ^ ((k0 << 13) | (k0 >> 19))
+    k1 = k1 * np.uint32(0x85EBCA6B) + np.uint32(1)
+    return jnp.stack([k1, k0 ^ k1], axis=1)
+
+
+def _uniform(rng: jax.Array, shape, salt: int) -> jax.Array:
+    """[N, ...] uniforms in [0, 1) derived per node (row i from rng[i])."""
+    n = rng.shape[0]
+    cols = int(np.prod(shape)) // n
+    base = rng[:, 0].astype(jnp.uint32)
+    j = jnp.arange(cols, dtype=jnp.uint32)
+    x = base[:, None] + j[None, :] * np.uint32(0x01000193) + np.uint32(salt)
+    x ^= x >> 15
+    x = x * np.uint32(0x2C1B3C6D)
+    x ^= x >> 12
+    x = x * np.uint32(0x297A2D39)
+    x ^= x >> 15
+    return (x.astype(jnp.float32) / np.float32(2**32)).reshape(shape)
+
+
+def init_state(params: SimParams, seed: int = 0) -> SimState:
+    """Every node knows only itself (alive, incarnation = epoch)."""
+    n = params.n
+    eye = np.eye(n, dtype=bool)
+    inc0 = np.where(eye, params.epoch_ms, 0).astype(np.int64)
+    rng = np.random.default_rng(seed)
+    perm = np.stack([rng.permutation(n) for _ in range(n)]).astype(np.int32)
+    keys = rng.integers(1, 2**32 - 1, size=(n, 2), dtype=np.uint32)
+    return SimState(
+        tick_index=jnp.int32(0),
+        proc_alive=jnp.ones(n, bool),
+        ready=jnp.zeros(n, bool),
+        gossip_on=jnp.ones(n, bool),
+        partition=jnp.zeros(n, jnp.int32),
+        known=jnp.asarray(eye),
+        status=jnp.zeros((n, n), jnp.int32),
+        inc=jnp.asarray(inc0),
+        ch_active=jnp.zeros((n, n), bool),
+        ch_status=jnp.zeros((n, n), jnp.int32),
+        ch_inc=jnp.zeros((n, n), jnp.int64),
+        ch_source=jnp.full((n, n), -1, jnp.int32),
+        ch_source_inc=jnp.zeros((n, n), jnp.int64),
+        ch_pb=jnp.zeros((n, n), jnp.int32),
+        susp_deadline=jnp.full((n, n), -1, jnp.int32),
+        perm=jnp.asarray(perm),
+        iter_pos=jnp.zeros(n, jnp.int32),
+        rng=jnp.asarray(keys),
+        checksum=jnp.zeros(n, jnp.uint32),
+    )
+
+
+def compute_checksums(state: SimState, universe: ce.Universe, params: SimParams):
+    bufs, lens = ce.membership_rows(
+        universe,
+        state.known,
+        state.status,
+        state.inc,
+        max_digits=params.max_digits,
+    )
+    return jfh.hash32_rows(bufs, lens)
+
+
+def _connected(partition: jax.Array, a: jax.Array, b: jax.Array) -> jax.Array:
+    return partition[a] == partition[b]
+
+
+def _apply_updates(
+    state: SimState,
+    now_ms: jax.Array,
+    recv_mask: jax.Array,  # [N, N] bool — update for (node, subject)
+    u_status: jax.Array,  # [N, N] int32
+    u_inc: jax.Array,  # [N, N] int64
+    u_source: jax.Array,  # [N, N] int32
+    u_source_inc: jax.Array,  # [N, N] int64
+):
+    """Vectorized Member.evaluateUpdate over (observer, subject) pairs.
+
+    Returns (state', applied [N,N] bool, applied_status, applied_inc).
+    """
+    n = state.known.shape[0]
+    node = jnp.arange(n)[:, None]
+    subject = jnp.arange(n)[None, :]
+    is_self = node == subject
+
+    # local override (refute): self claimed suspect/faulty -> alive, fresh inc
+    refute = recv_mask & is_self & ((u_status == SUSPECT) | (u_status == FAULTY))
+    eff_status = jnp.where(refute, ALIVE, u_status)
+    eff_inc = jnp.where(refute, now_ms, u_inc)
+
+    new_member = recv_mask & ~state.known
+    gate = recv_mask & (
+        refute
+        | new_member
+        | _overrides(eff_status, eff_inc, state.status, state.inc)
+    )
+
+    status = jnp.where(gate, eff_status, state.status)
+    inc = jnp.where(gate, eff_inc, state.inc)
+    known = state.known | new_member
+
+    # record applied changes for dissemination (on_membership_event.js:58,
+    # membership.update -> dissemination.recordChange)
+    ch_active = state.ch_active | gate
+    ch_status = jnp.where(gate, status, state.ch_status)
+    ch_inc = jnp.where(gate, inc, state.ch_inc)
+    ch_source = jnp.where(gate, u_source, state.ch_source)
+    ch_source_inc = jnp.where(gate, u_source_inc, state.ch_source_inc)
+    ch_pb = jnp.where(gate, 0, state.ch_pb)
+
+    # suspicion timers (never for self): stops applied here, starts are
+    # returned for the caller to stamp with tick + suspicion_ticks
+    start_t = gate & (status == SUSPECT) & ~is_self
+    stop_t = gate & (status != SUSPECT)
+    susp = jnp.where(stop_t, -1, state.susp_deadline)
+
+    new_state = state._replace(
+        known=known,
+        status=status,
+        inc=inc,
+        ch_active=ch_active,
+        ch_status=ch_status,
+        ch_inc=ch_inc,
+        ch_source=ch_source,
+        ch_source_inc=ch_source_inc,
+        ch_pb=ch_pb,
+        susp_deadline=susp,
+    )
+    return new_state, gate, start_t, stop_t
+
+
+def tick(
+    state: SimState,
+    inputs: TickInputs,
+    params: SimParams,
+    universe: ce.Universe,
+) -> tuple[SimState, TickMetrics]:
+    n = params.n
+    now_ms = (
+        jnp.int64(params.epoch_ms)
+        + (state.tick_index.astype(jnp.int64) + 1) * params.period_ms
+    )
+    node = jnp.arange(n)[:, None]
+    subject = jnp.arange(n)[None, :]
+    is_self = node == subject
+    tick_next = state.tick_index + 1
+
+    # ---- phase 0: fault-injection plane -------------------------------
+    proc_alive = (state.proc_alive & ~inputs.kill) | inputs.revive
+    partition = jnp.where(inputs.partition >= 0, inputs.partition, state.partition)
+    # revive resets a node to fresh state (process restart)
+    rv = inputs.revive & ~state.proc_alive
+    fresh_known = is_self
+    known = jnp.where(rv[:, None], fresh_known, state.known)
+    status = jnp.where(rv[:, None], ALIVE, state.status)
+    inc = jnp.where(rv[:, None] & is_self, now_ms, jnp.where(rv[:, None], 0, state.inc))
+    ready = jnp.where(rv, False, state.ready)
+    ch_active = jnp.where(rv[:, None], False, state.ch_active)
+    susp_deadline = jnp.where(rv[:, None], -1, state.susp_deadline)
+
+    state = state._replace(
+        proc_alive=proc_alive,
+        partition=partition,
+        known=known,
+        status=status,
+        inc=inc,
+        ready=ready,
+        ch_active=ch_active,
+        susp_deadline=susp_deadline,
+        tick_index=tick_next,
+    )
+
+    # ---- phase 1: join/bootstrap --------------------------------------
+    # Joiners (join input, or revived nodes) contact join_size ready nodes,
+    # merge their full views (join-sender.js + join-response-merge), and the
+    # contacted nodes makeAlive(joiner) (server/protocol/join.js:126).
+    joiner = (inputs.join | rv) & state.proc_alive & ~state.ready
+    # any live process answers /protocol/join — including nodes that are
+    # themselves mid-bootstrap (the reference's simultaneous tick-cluster
+    # bootstrap relies on this; handleJoin never checks readiness)
+    join_candidates = state.proc_alive
+    can_join_mask = (
+        joiner[:, None]
+        & join_candidates[None, :]
+        & ~is_self
+        & _connected(partition, node, subject)
+    )
+    jrand = _uniform(state.rng, (n, n), salt=101)
+    jscore = jnp.where(can_join_mask, jrand, 2.0)
+    # take up to join_size targets per joiner
+    jorder = jnp.argsort(jscore, axis=1)[:, : params.join_size]
+    jvalid = jnp.take_along_axis(jscore, jorder, axis=1) < 1.5  # real candidates
+
+    # merge targets' views into joiner via key-max over targets
+    def merge_joins(carry, k):
+        known_j, status_j, inc_j = carry
+        tgt = jorder[:, k]
+        ok = jvalid[:, k] & joiner
+        t_known = state.known[tgt]
+        t_status = state.status[tgt]
+        t_inc = state.inc[tgt]
+        take = ok[:, None] & t_known
+        better = take & (
+            ~known_j | (_pack_key(t_inc, t_status) > _pack_key(inc_j, status_j))
+        )
+        return (
+            (known_j | take, jnp.where(better, t_status, status_j), jnp.where(better, t_inc, inc_j)),
+            None,
+        )
+
+    (jk, js, ji), _ = jax.lax.scan(
+        merge_joins,
+        (state.known, state.status, state.inc),
+        jnp.arange(params.join_size),
+    )
+    joined = joiner & jnp.any(jvalid, axis=1)
+    # don't let merged views downgrade the joiner's own liveness
+    keep_self = is_self & joined[:, None]
+    merged_known = jnp.where(joined[:, None], jk, state.known)
+    merged_status = jnp.where(keep_self, ALIVE, jnp.where(joined[:, None], js, state.status))
+    merged_inc = jnp.where(keep_self, state.inc, jnp.where(joined[:, None], ji, state.inc))
+    # joiner records every learned member as a change (set handler,
+    # on_membership_event.js:58)
+    learned = joined[:, None] & merged_known & ~is_self
+    state = state._replace(
+        known=merged_known,
+        status=merged_status,
+        inc=merged_inc,
+        ready=state.ready | joined,
+        ch_active=state.ch_active | learned,
+        ch_status=jnp.where(learned, merged_status, state.ch_status),
+        ch_inc=jnp.where(learned, merged_inc, state.ch_inc),
+        ch_source=jnp.where(learned, node, state.ch_source),
+        ch_source_inc=jnp.where(
+            learned, merged_inc[jnp.arange(n), jnp.arange(n)][:, None], state.ch_source_inc
+        ),
+        ch_pb=jnp.where(learned, 0, state.ch_pb),
+    )
+
+    # contacted nodes makeAlive(joiner): scatter alive(joiner) into targets
+    ja_mask = jnp.zeros((n, n), bool)
+
+    def scatter_join_alive(k, m):
+        tgt = jorder[:, k]
+        ok = jvalid[:, k] & joined
+        upd = jnp.zeros((n, n), bool).at[tgt, jnp.arange(n)].set(ok, mode="drop")
+        return m | upd
+
+    ja_mask = jax.lax.fori_loop(0, params.join_size, scatter_join_alive, ja_mask)
+    self_inc = state.inc[jnp.arange(n), jnp.arange(n)]
+    state, ja_applied, _, _ = _apply_updates(
+        state,
+        now_ms,
+        ja_mask,
+        jnp.full((n, n), ALIVE, jnp.int32),
+        jnp.broadcast_to(self_inc[None, :], (n, n)),
+        jnp.broadcast_to(subject, (n, n)).astype(jnp.int32),  # source = joiner
+        jnp.broadcast_to(self_inc[None, :], (n, n)),
+    )
+
+    # checksum each sender advertises in its ping body this tick — its value
+    # as of the end of the previous tick (ping-sender.js:70-76 reads it at
+    # message-build time, before any same-period receives land)
+    advertised_checksum = state.checksum
+
+    # ---- phase 2: target selection (round-robin iterator) -------------
+    participating = state.proc_alive & state.ready & state.gossip_on
+    pingable = (
+        state.known
+        & ((state.status == ALIVE) | (state.status == SUSPECT))
+        & ~is_self
+    )
+    # walk perm starting at iter_pos, pick first pingable
+    k = jnp.arange(n)[None, :]
+    pos = (state.iter_pos[:, None] + k) % n
+    cand = jnp.take_along_axis(state.perm, pos, axis=1)  # [N, N] member order
+    cand_pingable = jnp.take_along_axis(pingable, cand, axis=1)
+    first_k = jnp.argmax(cand_pingable, axis=1).astype(jnp.int32)
+    has_target = jnp.any(cand_pingable, axis=1)
+    target = jnp.take_along_axis(cand, first_k[:, None], axis=1)[:, 0]
+    target = jnp.where(participating & has_target, target, NO_TARGET)
+    wrapped = (state.iter_pos + first_k) >= n
+    iter_pos = jnp.where(
+        participating & has_target, (state.iter_pos + first_k + 1) % n, state.iter_pos
+    )
+    # reshuffle permutation on wrap (membership/iterator.js:38-41)
+    shuf_rand = _uniform(state.rng, (n, n), salt=7)
+    new_perm = jnp.argsort(shuf_rand, axis=1).astype(jnp.int32)
+    perm = jnp.where((wrapped & participating)[:, None], new_perm, state.perm)
+    state = state._replace(perm=perm, iter_pos=iter_pos)
+
+    valid_send = target >= 0
+
+    # ---- phase 3: sender piggyback selection (issueAsSender) ----------
+    server_count = jnp.sum(
+        state.known & ((state.status == ALIVE) | (state.status == SUSPECT)),
+        axis=1,
+    ).astype(jnp.int32)
+    max_pb = _max_piggyback(server_count, params.piggyback_factor)  # [N]
+    bump = valid_send[:, None] & state.ch_active
+    ch_pb = state.ch_pb + bump.astype(jnp.int32)
+    over = state.ch_active & (ch_pb > max_pb[:, None])
+    ch_active = state.ch_active & ~over
+    sendable = bump & ~over  # message content mask [sender, subject]
+    state = state._replace(ch_pb=ch_pb, ch_active=ch_active)
+
+    # ---- phase 4: delivery mask ---------------------------------------
+    loss = _uniform(state.rng, (n,), salt=13) < params.packet_loss
+    tgt_ok = jnp.where(target >= 0, state.proc_alive[target], False)
+    conn = jnp.where(
+        target >= 0, partition == partition[jnp.clip(target, 0, n - 1)], False
+    )
+    delivered = valid_send & tgt_ok & conn & ~loss
+
+    # ---- phase 5: receivers apply ping changes ------------------------
+    seg = jnp.where(delivered, target, n)  # undelivered -> dropped segment
+    keys = jnp.where(
+        sendable & delivered[:, None],
+        _pack_key(state.ch_inc, state.ch_status),
+        jnp.int64(-1),
+    )
+    recv_key = jax.ops.segment_max(
+        keys, seg, num_segments=n + 1, indices_are_sorted=False
+    )[:n]
+    recv_mask = recv_key >= 0
+    # winning sender (lowest index among ties) to recover source fields
+    is_winner = (keys == recv_key[jnp.clip(target, 0, n - 1)]) & sendable & delivered[:, None]
+    sender_ids = jnp.broadcast_to(node, (n, n))
+    winner_sender = jax.ops.segment_min(
+        jnp.where(is_winner, sender_ids, n), seg, num_segments=n + 1
+    )[:n]
+    ws = jnp.clip(winner_sender, 0, n - 1)
+    u_status = (recv_key % 4).astype(jnp.int32)
+    u_inc = recv_key // 4
+    u_source = state.ch_source[ws, subject]
+    u_source_inc = state.ch_source_inc[ws, subject]
+    state, applied_ping, started, _ = _apply_updates(
+        state, now_ms, recv_mask, u_status, u_inc, u_source, u_source_inc
+    )
+    state = state._replace(
+        susp_deadline=jnp.where(
+            started, tick_next + params.suspicion_ticks, state.susp_deadline
+        )
+    )
+
+    # receiver-side piggyback bump: one issueAsReceiver per delivered ping
+    nrecv = jax.ops.segment_sum(
+        delivered.astype(jnp.int32), seg, num_segments=n + 1
+    )[:n]
+    bump_r = (nrecv[:, None] > 0) & state.ch_active
+    ch_pb = state.ch_pb + jnp.where(bump_r, nrecv[:, None], 0)
+    over_r = state.ch_active & (ch_pb > max_pb[:, None])
+    respondable = bump_r & ~over_r
+    state = state._replace(ch_pb=ch_pb, ch_active=state.ch_active & ~over_r)
+
+    # mid-tick checksums (receivers respond with post-update checksums)
+    mid_checksum = compute_checksums(state, universe, params)
+
+    # ---- phase 6: responses (issueAsReceiver + full-sync) -------------
+    tgt = jnp.clip(target, 0, n - 1)
+    # filter: drop changes the sender itself originated (dissemination.js:91-98)
+    sender_self_inc = state.inc[jnp.arange(n), jnp.arange(n)]
+    resp_filter = (
+        (state.ch_source[tgt] == node)
+        & (state.ch_source_inc[tgt] == sender_self_inc[:, None])
+    )
+    resp_mask = delivered[:, None] & respondable[tgt] & ~resp_filter
+    any_resp_change = jnp.any(resp_mask, axis=1)
+    # full-sync: no changes to send back AND checksums differ
+    # (sender's checksum rides in the ping body, ping-sender.js:70-76)
+    full_sync = delivered & ~any_resp_change & (
+        mid_checksum[tgt] != advertised_checksum
+    )
+    fs_mask = full_sync[:, None] & state.known[tgt]
+    r_status = jnp.where(fs_mask, state.status[tgt], state.ch_status[tgt])
+    r_inc = jnp.where(fs_mask, state.inc[tgt], state.ch_inc[tgt])
+    r_source = jnp.where(
+        fs_mask, jnp.broadcast_to(target[:, None], (n, n)), state.ch_source[tgt]
+    )
+    r_source_inc = jnp.where(
+        fs_mask, state.inc[tgt, tgt][:, None], state.ch_source_inc[tgt]
+    )
+    apply_resp = resp_mask | fs_mask
+    state, applied_resp, started_r, _ = _apply_updates(
+        state, now_ms, apply_resp, r_status, r_inc, r_source, r_source_inc
+    )
+    state = state._replace(
+        susp_deadline=jnp.where(
+            started_r, tick_next + params.suspicion_ticks, state.susp_deadline
+        )
+    )
+
+    # ---- phase 7: ping-req (indirect probe) ---------------------------
+    need_pr = valid_send & ~delivered
+    pr_rand = _uniform(state.rng, (n, n), salt=29)
+    pr_ok = (
+        pingable
+        & (subject != target[:, None])
+        & need_pr[:, None]
+    )
+    pr_score = jnp.where(pr_ok, pr_rand, 2.0)
+    pr_sel = jnp.argsort(pr_score, axis=1)[:, : params.ping_req_size]
+    pr_valid = jnp.take_along_axis(pr_score, pr_sel, axis=1) < 1.5
+
+    m_alive = state.proc_alive[pr_sel]
+    m_conn = partition[pr_sel] == partition[:, None]
+    loss1 = _uniform(state.rng, (n, params.ping_req_size), salt=31) < params.packet_loss
+    responder = pr_valid & m_alive & m_conn & ~loss1  # intermediary reachable
+    t_alive = jnp.where(need_pr, state.proc_alive[tgt], False)
+    t_conn = partition[pr_sel] == partition[tgt][:, None]
+    loss2 = _uniform(state.rng, (n, params.ping_req_size), salt=37) < params.packet_loss
+    reached = responder & t_alive[:, None] & t_conn & ~loss2
+
+    any_responded = jnp.any(responder, axis=1)
+    target_reached = jnp.any(reached, axis=1)
+    mark_suspect = need_pr & any_responded & ~target_reached
+    ping_req_count = jnp.sum(
+        jnp.where(need_pr[:, None], pr_valid, False).astype(jnp.int32)
+    )
+
+    sus_mask = jnp.zeros((n, n), bool).at[jnp.arange(n), tgt].set(mark_suspect)
+    sus_inc = state.inc[jnp.arange(n), tgt]  # member's current incarnation
+    state, applied_sus, started_s, _ = _apply_updates(
+        state,
+        now_ms,
+        sus_mask,
+        jnp.full((n, n), SUSPECT, jnp.int32),
+        jnp.broadcast_to(sus_inc[:, None], (n, n)),
+        jnp.broadcast_to(node, (n, n)).astype(jnp.int32),
+        jnp.broadcast_to(sender_self_inc[:, None], (n, n)),
+    )
+    state = state._replace(
+        susp_deadline=jnp.where(
+            started_s, tick_next + params.suspicion_ticks, state.susp_deadline
+        )
+    )
+
+    # ---- phase 8: suspicion expiry ------------------------------------
+    expired = (
+        (state.susp_deadline >= 0)
+        & (state.susp_deadline <= tick_next)
+        & participating[:, None]
+    )
+    state = state._replace(susp_deadline=jnp.where(expired, -1, state.susp_deadline))
+    state, applied_faulty, _, _ = _apply_updates(
+        state,
+        now_ms,
+        expired,
+        jnp.full((n, n), FAULTY, jnp.int32),
+        state.inc,  # member's current incarnation (suspicion.js:67-70)
+        jnp.broadcast_to(node, (n, n)).astype(jnp.int32),
+        jnp.broadcast_to(sender_self_inc[:, None], (n, n)),
+    )
+
+    # ---- phase 9: checksums + metrics ---------------------------------
+    checksum = compute_checksums(state, universe, params)
+    state = state._replace(checksum=checksum)
+
+    part = state.proc_alive & state.ready
+    # count distinct checksums among participants: sort, count boundaries
+    cs = jnp.where(part, checksum, jnp.uint32(0xFFFFFFFF))
+    cs_sorted = jnp.sort(cs)
+    distinct = (
+        jnp.sum(
+            (cs_sorted[1:] != cs_sorted[:-1])
+            & (cs_sorted[1:] != jnp.uint32(0xFFFFFFFF))
+        )
+        + (cs_sorted[0] != jnp.uint32(0xFFFFFFFF)).astype(jnp.int32)
+    ).astype(jnp.int32)
+
+    metrics = TickMetrics(
+        pings_sent=jnp.sum(valid_send.astype(jnp.int32)),
+        pings_delivered=jnp.sum(delivered.astype(jnp.int32)),
+        ping_reqs=ping_req_count,
+        full_syncs=jnp.sum(full_sync.astype(jnp.int32)),
+        changes_applied=jnp.sum(
+            (applied_ping | applied_resp | ja_applied).astype(jnp.int32)
+        ),
+        suspects_marked=jnp.sum(applied_sus.astype(jnp.int32)),
+        faulties_marked=jnp.sum(applied_faulty.astype(jnp.int32)),
+        distinct_checksums=distinct,
+        converged=distinct <= 1,
+    )
+
+    state = state._replace(rng=_fold(state.rng, 0x5EED))
+    return state, metrics

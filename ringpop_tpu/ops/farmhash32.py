@@ -39,9 +39,12 @@ MASK = 0xFFFFFFFF
 # ---------------------------------------------------------------------------
 
 def _rot32(x: int, r: int) -> int:
-    """Right-rotate, matching FarmHash's Rotate32."""
+    """Right-rotate, matching FarmHash's Rotate32.  Masks the input first:
+    callers pass sums that may exceed 32 bits and the carry must not leak
+    into the right-shift."""
+    x &= MASK
     if r == 0:
-        return x & MASK
+        return x
     return ((x >> r) | (x << (32 - r))) & MASK
 
 

@@ -107,6 +107,11 @@ def hash32_batch(mat: np.ndarray, lens: np.ndarray) -> np.ndarray:
         return py.hash32_batch(mat, lens)
     mat = np.ascontiguousarray(mat, dtype=np.uint8)
     lens64 = np.ascontiguousarray(lens, dtype=np.uint64)
+    max_len = int(lens64.max(initial=0))
+    if max_len > mat.shape[1]:
+        raise ValueError(
+            "lens exceed matrix width (%d > %d)" % (max_len, mat.shape[1])
+        )
     out = np.empty(mat.shape[0], dtype=np.uint32)
     lib.rp_farmhash32_batch(
         mat.ctypes.data,

@@ -1,0 +1,138 @@
+"""End-to-end simulator tests: the 'minimum end-to-end slice' — an N-node
+simulated cluster joins, gossips to convergence, suffers a kill, and
+re-converges with the victim marked faulty (SURVEY.md §7)."""
+
+import numpy as np
+
+from ringpop_tpu.models.sim import engine
+from ringpop_tpu.models.sim.cluster import EventSchedule, SimCluster
+from ringpop_tpu.ops import farmhash32 as fh
+
+
+def make_cluster(n=5, **params):
+    p = engine.SimParams(n=n, suspicion_ticks=3, **params)
+    return SimCluster(n=n, params=p, seed=42)
+
+
+def test_join_and_converge():
+    c = make_cluster(5)
+    c.bootstrap()
+    took = c.run_until_converged(max_ticks=50)
+    assert took >= 0, "cluster did not converge"
+    groups = c.checksum_groups()
+    assert len(groups) == 1
+    # every node sees all 5 members alive
+    for i in range(5):
+        members = c.membership_of(i)
+        assert len(members) == 5
+        assert all(m["status"] == "alive" for m in members)
+
+
+def test_checksum_matches_host_farmhash():
+    c = make_cluster(4)
+    c.bootstrap()
+    c.run_until_converged(max_ticks=50)
+    for i in range(4):
+        want = fh.hash32(c.checksum_string_of(i))
+        assert int(c.checksums()[i]) == want
+
+
+def run_until(c, pred, max_ticks=150):
+    for t in range(max_ticks):
+        m = c.step()
+        if pred(c, m):
+            return t + 1
+    return -1
+
+
+def test_kill_leads_to_faulty_and_reconvergence():
+    c = make_cluster(5)
+    c.bootstrap()
+    assert c.run_until_converged(max_ticks=50) >= 0
+
+    c.kill([2])
+    victim = c.universe.addresses[2]
+
+    def victim_faulty_everywhere(c, m):
+        if not bool(m.converged):
+            return False
+        for i in range(5):
+            if i == 2:
+                continue
+            statuses = {x["address"]: x["status"] for x in c.membership_of(i)}
+            if statuses.get(victim) != "faulty":
+                return False
+        return True
+
+    # a transient all-suspect convergence is legitimate (the checksums agree
+    # before suspicion timers fire); wait for the faulty wave to settle
+    assert run_until(c, victim_faulty_everywhere) >= 0
+
+
+def test_refute_suspect_comes_back_alive():
+    # a suspected-but-alive node refutes with a higher incarnation
+    c = make_cluster(4)
+    c.bootstrap()
+    assert c.run_until_converged(max_ticks=50) >= 0
+
+    # partition node 3 away so it gets suspected...
+    part = np.zeros(4, np.int32)
+    part[3] = 1
+    c.partition(part)
+    for _ in range(4):  # long enough for suspects, shorter than faulty+full propagation
+        c.step()
+    suspected = any(
+        m["address"] == c.universe.addresses[3] and m["status"] == "suspect"
+        for i in range(3)
+        for m in c.membership_of(i)
+    )
+    assert suspected, "partitioned node was never suspected"
+
+    # ...then heal the partition before/after faulty: node 3 refutes
+    c.partition(np.zeros(4, np.int32))
+    took = c.run_until_converged(max_ticks=100)
+    assert took >= 0
+    for i in range(4):
+        statuses = {m["address"]: m["status"] for m in c.membership_of(i)}
+        assert statuses[c.universe.addresses[3]] == "alive", (i, statuses)
+
+
+def test_scan_run_matches_step_loop():
+    # the lax.scan path and the step() loop must produce identical states
+    ca = make_cluster(4)
+    cb = make_cluster(4)
+    ca.bootstrap()
+    cb.bootstrap()
+
+    T = 10
+    sched = EventSchedule(ticks=T, n=4)
+    ms = ca.run(sched)
+    for _ in range(T):
+        cb.step()
+    np.testing.assert_array_equal(ca.checksums(), cb.checksums())
+    np.testing.assert_array_equal(
+        np.asarray(ca.state.inc), np.asarray(cb.state.inc)
+    )
+    assert ms.converged.shape == (T,)
+
+
+def test_packet_loss_still_converges():
+    c = make_cluster(6, packet_loss=0.3)
+    c.bootstrap()
+    took = c.run_until_converged(max_ticks=200)
+    assert took >= 0, "lossy cluster did not converge"
+
+
+def test_revive_rejoins():
+    c = make_cluster(4)
+    c.bootstrap()
+    assert c.run_until_converged(max_ticks=50) >= 0
+    c.kill([1])
+    assert c.run_until_converged(max_ticks=100) >= 0
+    c.revive([1])
+    took = c.run_until_converged(max_ticks=150)
+    assert took >= 0, "revived node did not reconverge"
+    victim = c.universe.addresses[1]
+    for i in range(4):
+        statuses = {m["address"]: m["status"] for m in c.membership_of(i)}
+        assert statuses[victim] == "alive", (i, statuses)

@@ -97,3 +97,30 @@ def test_native_replica_hashes():
 
 def test_str_and_bytes_agree():
     assert fh.hash32("127.0.0.1:3000") == fh.hash32(b"127.0.0.1:3000")
+
+
+def test_property_sweep_scalar_vs_batch_and_native():
+    # dense random sweep across every length class — the 13-24 path in
+    # particular has a 25%-probability carry-overflow in rot(a + f, 12) that
+    # sparse fixtures can miss (caught by review; keep this sweep dense)
+    rng = random.Random(0xBEEF)
+    strs = []
+    for n in range(0, 64):
+        for _ in range(40):
+            strs.append(bytes(rng.randrange(256) for _ in range(n)))
+    strs += [bytes([0xFF]) * n for n in range(1, 64)]  # all-carry patterns
+    mat, lens = fh.encode_rows(strs)
+    batch = fh.hash32_batch(mat, lens)
+    for i, s in enumerate(strs):
+        assert fh.hash32(s) == int(batch[i]), (len(s), s[:24])
+    if native.available():
+        nat = native.hash32_batch(mat, lens)
+        np.testing.assert_array_equal(nat, batch)
+
+
+def test_native_batch_rejects_bad_lens():
+    if not native.available():
+        pytest.skip("native toolchain unavailable")
+    mat = np.zeros((2, 8), np.uint8)
+    with pytest.raises(ValueError):
+        native.hash32_batch(mat, np.array([4, 9]))
