@@ -1,0 +1,67 @@
+"""Headline benchmark: batched SWIM gossip throughput at 1k nodes.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+Metric: simulated node-protocol-periods per second for a 1k-node cluster
+running the full SWIM tick (target selection, piggyback dissemination,
+ping/ping-req delivery, suspicion, per-node membership checksums) as a
+single compiled lax.scan.  Checksums use the fast commutative record-hash
+mode (checksum_mode="fast"), which has the same equality semantics as the
+reference's FarmHash32 string checksum but not its bit pattern; bit-exact
+FarmHash32 checksums are the parity mode (checksum_mode="farmhash"),
+exercised by the parity tests, at roughly 15x the per-tick cost.
+
+Baseline: the reference (ringpop-node) runs clusters in real time with a
+200 ms minimum protocol period (lib/gossip/index.js:194-196), i.e. a 1k-node
+cluster advances at most 1000 x 5 = 5000 node-protocol-periods per second of
+wall clock, using 1k OS processes.  ``vs_baseline`` is our rate divided by
+that real-time rate on a single TPU chip.
+"""
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+
+def main() -> None:
+    n = int(os.environ.get("BENCH_N", "1024"))
+    ticks = int(os.environ.get("BENCH_TICKS", "32"))
+
+    from ringpop_tpu.models.sim import engine
+    from ringpop_tpu.models.sim.cluster import EventSchedule, SimCluster
+
+    sim = SimCluster(n=n, params=engine.SimParams(n=n, checksum_mode="fast"))
+    sim.bootstrap()
+
+    sched = EventSchedule(ticks=ticks, n=n)
+    sim.run(sched)  # compile + warm
+    import jax
+
+    jax.block_until_ready(sim.state)
+
+    t0 = time.perf_counter()
+    metrics = sim.run(sched)
+    jax.block_until_ready(sim.state)
+    elapsed = time.perf_counter() - t0
+
+    node_ticks_per_sec = n * ticks / elapsed
+    baseline = n * 5.0  # real-time reference: 5 protocol periods/s/node
+    result = {
+        "metric": "swim_node_protocol_periods_per_sec_1k",
+        "value": round(node_ticks_per_sec, 1),
+        "unit": "node-ticks/s",
+        "vs_baseline": round(node_ticks_per_sec / baseline, 2),
+        "n_nodes": n,
+        "ticks": ticks,
+        "elapsed_s": round(elapsed, 3),
+        "converged": bool(np.asarray(metrics.converged)[-1]),
+        "platform": jax.devices()[0].platform,
+    }
+    print(json.dumps(result))
+
+
+if __name__ == "__main__":
+    sys.exit(main())

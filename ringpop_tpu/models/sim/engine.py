@@ -63,6 +63,7 @@ import numpy as np
 
 from ringpop_tpu.ops import checksum_encode as ce
 from ringpop_tpu.ops import jax_farmhash as jfh
+from ringpop_tpu.ops.record_mix import record_mix
 
 # status codes (== ce.STATUS_*): rank order IS override priority at equal
 # incarnation: alive < suspect < faulty < leave
@@ -83,6 +84,12 @@ class SimParams(NamedTuple):
     piggyback_factor: int = 15  # dissemination.js:180
     max_digits: int = 14  # incarnation digit bound (ms epoch timestamps)
     packet_loss: float = 0.0
+    # "farmhash": bit-exact reference checksum (membership/index.js:48-75) —
+    # required for parity runs.  "fast": commutative per-record hash sum with
+    # identical equality semantics (equal views <=> equal sums, w.h.p.) —
+    # the throughput mode; the serial 20-byte FarmHash block walk over a
+    # ~40KB string per node per tick is the single hottest op otherwise.
+    checksum_mode: str = "farmhash"
 
 
 class SimState(NamedTuple):
@@ -231,6 +238,13 @@ def init_state(params: SimParams, seed: int = 0) -> SimState:
 
 
 def compute_checksums(state: SimState, universe: ce.Universe, params: SimParams):
+    if params.checksum_mode == "fast":
+        n = state.known.shape[0]
+        subject = jnp.arange(n, dtype=jnp.int32)[None, :]
+        rec = record_mix(subject, state.status, state.inc)
+        return jnp.sum(
+            jnp.where(state.known, rec, 0), axis=1, dtype=jnp.uint32
+        )
     bufs, lens = ce.membership_rows(
         universe,
         state.known,
@@ -367,9 +381,9 @@ def tick(
     )
     jrand = _uniform(state.rng, (n, n), salt=101)
     jscore = jnp.where(can_join_mask, jrand, 2.0)
-    # take up to join_size targets per joiner
-    jorder = jnp.argsort(jscore, axis=1)[:, : params.join_size]
-    jvalid = jnp.take_along_axis(jscore, jorder, axis=1) < 1.5  # real candidates
+    # take up to join_size targets per joiner (top-k, not a full sort)
+    neg_jtop, jorder = jax.lax.top_k(-jscore, params.join_size)
+    jvalid = -neg_jtop < 1.5  # real candidates
 
     # merge targets' views into joiner via key-max over targets
     def merge_joins(carry, k):
@@ -579,8 +593,8 @@ def tick(
         & need_pr[:, None]
     )
     pr_score = jnp.where(pr_ok, pr_rand, 2.0)
-    pr_sel = jnp.argsort(pr_score, axis=1)[:, : params.ping_req_size]
-    pr_valid = jnp.take_along_axis(pr_score, pr_sel, axis=1) < 1.5
+    neg_prtop, pr_sel = jax.lax.top_k(-pr_score, params.ping_req_size)
+    pr_valid = -neg_prtop < 1.5
 
     m_alive = state.proc_alive[pr_sel]
     m_conn = partition[pr_sel] == partition[:, None]

@@ -1,0 +1,24 @@
+"""Commutative per-member record hash for fast (non-parity) checksums.
+
+Both simulator engines need a cheap uint32 hash of a member record
+``(subject, status, incarnation)`` whose per-node SUM discriminates
+membership views (the fast twin of the reference's order-sensitive
+FarmHash32-of-joined-string checksum, lib/membership/index.js:48-75).
+One definition lives here so the two engines cannot drift.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def record_mix(subject, status, inc):
+    """[...]-shaped int arrays -> uint32 record hash (elementwise)."""
+    x = subject.astype(jnp.uint32) * jnp.uint32(0x9E3779B1)
+    x ^= status.astype(jnp.uint32) * jnp.uint32(0x85EBCA77)
+    x ^= (inc & 0xFFFFFFFF).astype(jnp.uint32) * jnp.uint32(0xC2B2AE3D)
+    x ^= ((inc >> 32) & 0xFFFFFFFF).astype(jnp.uint32) * jnp.uint32(0x27D4EB2F)
+    x ^= x >> 15
+    x *= jnp.uint32(0x2C1B3C6D)
+    x ^= x >> 13
+    return x

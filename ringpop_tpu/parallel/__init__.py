@@ -1,0 +1,19 @@
+"""Device-mesh parallelism: sharding the N-node axis over TPU chips."""
+
+from ringpop_tpu.parallel.mesh import (
+    make_mesh,
+    state_shardings,
+    inputs_shardings,
+    shard_state,
+    make_sharded_tick,
+    ShardedSim,
+)
+
+__all__ = [
+    "make_mesh",
+    "state_shardings",
+    "inputs_shardings",
+    "shard_state",
+    "make_sharded_tick",
+    "ShardedSim",
+]

@@ -1,0 +1,189 @@
+"""Sharding the SWIM simulator over a ``jax.sharding.Mesh``.
+
+The reference scales by spawning one OS process per node and wiring them with
+TChannel RPC (scripts/tick-cluster.js:472-479 spawns N processes;
+docs/architecture_design.md's deployment model is one ringpop per service
+instance).  The TPU-native analog: the N-node axis of the batched simulator
+is **sharded over the device mesh**, and the gossip exchange — gathers along
+the target axis, segment-reductions onto receivers — lowers to XLA
+collectives (all-gather / reduce-scatter / all-to-all) that ride ICI between
+chips of a slice and DCN between hosts.
+
+Design:
+
+- One logical mesh axis, ``"nodes"``, shards the *observer* dimension: every
+  ``[N]`` array is ``P("nodes")`` and every ``[N, N]`` view/change table is
+  ``P("nodes", None)`` — node i's whole view lives on one chip, so the SWIM
+  update rule (a per-(observer, subject) elementwise gate) is entirely local.
+  Cross-chip traffic is exactly the protocol's message plane: delivering
+  piggybacked changes to ping targets (a segment-reduce over the target
+  index) and reading target/ping-req peer liveness (gathers along the
+  observer axis).  That is the same locality structure the reference has —
+  per-node state local, pings on the wire — mapped onto the mesh.
+- The mesh can be any shape; multi-host meshes (ICI within a slice, DCN
+  across slices) work unchanged because GSPMD partitions the same program.
+  Per the scaling-book recipe: pick the mesh, annotate shardings on inputs
+  and outputs, let XLA insert the collectives.
+- ``jax.jit`` with explicit in/out shardings compiles ONE SPMD program; no
+  per-node Python, no host round-trips inside a protocol period.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ringpop_tpu.models.sim import engine
+from ringpop_tpu.ops import checksum_encode as ce
+
+AXIS = "nodes"
+
+
+def make_mesh(
+    n_devices: Optional[int] = None,
+    devices: Optional[Sequence] = None,
+    axis: str = AXIS,
+) -> Mesh:
+    """A 1-D mesh over ``n_devices`` (default: all available devices)."""
+    if devices is None:
+        devices = jax.devices()
+    if n_devices is not None:
+        devices = devices[:n_devices]
+    return Mesh(np.asarray(devices), (axis,))
+
+
+def _spec_for(x, axis: str) -> P:
+    """Shard the leading (observer/node) axis; replicate scalars."""
+    if getattr(x, "ndim", 0) == 0:
+        return P()
+    return P(axis, *([None] * (x.ndim - 1)))
+
+
+def state_shardings(mesh: Mesh, state: engine.SimState):
+    """NamedSharding pytree for a SimState: node axis sharded, rest local."""
+    axis = mesh.axis_names[0]
+    return jax.tree.map(
+        lambda x: NamedSharding(mesh, _spec_for(x, axis)), state
+    )
+
+
+def inputs_shardings(mesh: Mesh, inputs: engine.TickInputs):
+    axis = mesh.axis_names[0]
+    return jax.tree.map(
+        lambda x: NamedSharding(mesh, _spec_for(x, axis)), inputs
+    )
+
+
+def shard_state(state: engine.SimState, mesh: Mesh) -> engine.SimState:
+    """Place a SimState onto the mesh with the node axis distributed."""
+    return jax.device_put(state, state_shardings(mesh, state))
+
+
+def _abstract_state(params: engine.SimParams):
+    """Shape-only SimState (no arrays built) for deriving shardings."""
+    return jax.eval_shape(lambda: engine.init_state(params))
+
+
+def make_sharded_tick(
+    params: engine.SimParams, universe: ce.Universe, mesh: Mesh
+):
+    """Compile ``engine.tick`` as one SPMD program over the mesh.
+
+    Returns ``f(state, inputs) -> (state, metrics)`` with state kept
+    device-resident and node-sharded across ticks.
+    """
+    st_sh = state_shardings(mesh, _abstract_state(params))
+    in_sh = inputs_shardings(mesh, engine.TickInputs.quiet(params.n))
+    metrics_sh = jax.tree.map(
+        lambda _: NamedSharding(mesh, P()), engine.TickMetrics(*[0] * 9)
+    )
+    fn = functools.partial(engine.tick, params=params, universe=universe)
+    return jax.jit(
+        fn, in_shardings=(st_sh, in_sh), out_shardings=(st_sh, metrics_sh)
+    )
+
+
+def make_sharded_scan(
+    params: engine.SimParams, universe: ce.Universe, mesh: Mesh
+):
+    """Compile a ``lax.scan`` of the tick over a [T, N] event schedule."""
+    st_sh = state_shardings(mesh, _abstract_state(params))
+    axis = mesh.axis_names[0]
+    sched_sh = jax.tree.map(
+        lambda x: NamedSharding(mesh, P(None, axis)),
+        engine.TickInputs.quiet(params.n),
+    )
+    metrics_sh = jax.tree.map(
+        lambda _: NamedSharding(mesh, P()), engine.TickMetrics(*[0] * 9)
+    )
+
+    def scanned(state, inputs):
+        def body(st, inp):
+            return engine.tick(st, inp, params, universe)
+
+        return jax.lax.scan(body, state, inputs)
+
+    return jax.jit(
+        scanned,
+        in_shardings=(st_sh, sched_sh),
+        out_shardings=(st_sh, metrics_sh),
+    )
+
+
+class ShardedSim:
+    """A SimCluster-shaped driver whose state lives sharded on the mesh.
+
+    The multi-chip twin of :class:`ringpop_tpu.models.sim.cluster.SimCluster`:
+    same bootstrap/step/run surface, but every array carries a NamedSharding
+    and the compiled tick is one SPMD program across all devices.
+    """
+
+    def __init__(
+        self,
+        n: int,
+        mesh: Optional[Mesh] = None,
+        params: Optional[engine.SimParams] = None,
+        addresses: Optional[Sequence[str]] = None,
+        seed: int = 0,
+    ):
+        from ringpop_tpu.models.sim.cluster import default_addresses
+
+        self.mesh = mesh if mesh is not None else make_mesh()
+        if addresses is None:
+            addresses = default_addresses(n)
+        self.universe = ce.Universe.from_addresses(addresses)
+        self.params = params or engine.SimParams(n=self.universe.n)
+        if self.params.n % self.mesh.devices.size:
+            raise ValueError(
+                "n=%d not divisible by mesh size %d"
+                % (self.params.n, self.mesh.devices.size)
+            )
+        self.state = shard_state(
+            engine.init_state(self.params, seed=seed), self.mesh
+        )
+        self._tick = make_sharded_tick(self.params, self.universe, self.mesh)
+        self._scan = make_sharded_scan(self.params, self.universe, self.mesh)
+
+    def bootstrap(self):
+        inputs = engine.TickInputs.quiet(self.params.n)._replace(
+            join=jnp.ones(self.params.n, bool)
+        )
+        return self.step(inputs)
+
+    def step(self, inputs: Optional[engine.TickInputs] = None):
+        if inputs is None:
+            inputs = engine.TickInputs.quiet(self.params.n)
+        self.state, metrics = self._tick(self.state, inputs)
+        return jax.tree.map(np.asarray, metrics)
+
+    def run(self, schedule) -> engine.TickMetrics:
+        self.state, metrics = self._scan(self.state, schedule.as_inputs())
+        return jax.tree.map(np.asarray, metrics)
+
+    def checksums(self) -> np.ndarray:
+        return np.asarray(self.state.checksum)

@@ -1,0 +1,139 @@
+"""Scalable (rumor-table) engine: publish/propagate/expire semantics.
+
+Small-N functional tests of the O(N·U) large-scale mode — the engine behind
+the 100k epidemic-broadcast / 1M churn-storm configs.
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from ringpop_tpu.models.sim import engine_scalable as es
+
+
+def make(n=16, **kw):
+    params = es.ScalableParams(n=n, u=64, **kw)
+    state = es.init_state(params, seed=7)
+    step = jax.jit(functools.partial(es.tick, params=params))
+    return params, state, step
+
+
+def run_ticks(state, step, t, n):
+    ms = []
+    for _ in range(t):
+        state, m = step(state, es.ChurnInputs.quiet(n))
+        ms.append(m)
+    return state, ms
+
+
+def test_quiet_cluster_stays_converged():
+    params, state, step = make(n=16)
+    state, ms = run_ticks(state, step, 5, 16)
+    m = ms[-1]
+    assert int(m.live_nodes) == 16
+    assert int(m.active_rumors) == 0
+    assert int(m.distinct_checksums) == 1
+    assert bool(m.full_coverage)
+
+
+def test_kill_publishes_suspect_then_faulty_rumor():
+    params, state, step = make(n=16, suspicion_ticks=3)
+    kill = jnp.zeros(16, bool).at[5].set(True)
+    state, m = step(state, es.ChurnInputs(kill=kill, revive=jnp.zeros(16, bool)))
+    total_susp = int(m.suspects_published)
+    total_faulty = 0
+    for _ in range(12):
+        state, m = step(state, es.ChurnInputs.quiet(16))
+        total_susp += int(m.suspects_published)
+        total_faulty += int(m.faulties_published)
+    assert total_susp >= 1
+    assert total_faulty >= 1
+    assert int(state.truth_status[5]) == es.FAULTY
+    # the faulty rumor disseminates: all live nodes eventually share checksum
+    state, ms = run_ticks(state, step, 10, 16)
+    assert int(ms[-1].distinct_checksums) == 1
+
+
+def test_rumors_reach_full_coverage():
+    params, state, step = make(n=32, suspicion_ticks=50)  # long suspicion
+    kill = jnp.zeros(32, bool).at[3].set(True)
+    state, _ = step(state, es.ChurnInputs(kill=kill, revive=jnp.zeros(32, bool)))
+    # after O(log N) push-pull rounds every live node heard the suspect rumor
+    state, ms = run_ticks(state, step, 12, 32)
+    assert bool(ms[-1].full_coverage)
+    assert float(ms[-1].mean_heard_frac) == 1.0
+
+
+def test_checksums_discriminate_views():
+    params, state, step = make(n=16, packet_loss=0.9, suspicion_ticks=100)
+    kill = jnp.zeros(16, bool).at[2].set(True)
+    state, m = step(state, es.ChurnInputs(kill=kill, revive=jnp.zeros(16, bool)))
+    # with heavy loss, right after the suspect rumor is born only some nodes
+    # heard it -> more than one distinct checksum among live nodes
+    state, m = step(state, es.ChurnInputs.quiet(16))
+    if int(m.active_rumors) > 0 and float(m.mean_heard_frac) < 1.0:
+        assert int(m.distinct_checksums) > 1
+
+
+def test_revive_resets_heard_and_publishes_alive():
+    params, state, step = make(n=16, suspicion_ticks=2)
+    kill = jnp.zeros(16, bool).at[4].set(True)
+    state, _ = step(state, es.ChurnInputs(kill=kill, revive=jnp.zeros(16, bool)))
+    state, ms = run_ticks(state, step, 8, 16)
+    assert int(state.truth_status[4]) == es.FAULTY
+    inc_before = int(state.truth_inc[4])
+    rv = jnp.zeros(16, bool).at[4].set(True)
+    state, m = step(state, es.ChurnInputs(kill=jnp.zeros(16, bool), revive=rv))
+    # revived node: fresh incarnation alive rumor, heard reset to just-own
+    assert int(state.truth_status[4]) == es.ALIVE
+    assert int(state.truth_inc[4]) > inc_before
+    state, ms = run_ticks(state, step, 12, 16)
+    assert int(ms[-1].distinct_checksums) == 1
+    assert bool(ms[-1].full_coverage)
+
+
+def test_publish_slot_allocation_no_clobber():
+    """Two simultaneous publishers must land in two distinct slots."""
+    params = es.ScalableParams(n=8, u=64)
+    state = es.init_state(params, seed=1)
+    want = jnp.zeros(8, bool).at[1].set(True).at[6].set(True)
+    subj = jnp.arange(8, dtype=jnp.int32)
+    state2 = es._publish(
+        state,
+        want,
+        subj,
+        jnp.full(8, es.SUSPECT, jnp.int32),
+        state.truth_inc,
+        jnp.int32(1),
+    )
+    active = np.asarray(state2.r_active)
+    subjects = np.asarray(state2.r_subject)[active]
+    assert active.sum() == 2
+    assert set(subjects.tolist()) == {1, 6}
+    # each publisher heard its own rumor
+    heard = np.asarray(state2.heard)
+    slots = np.nonzero(active)[0]
+    for s, node in zip(sorted(slots), [1, 6]):
+        by = subjects_to_node = np.asarray(state2.r_subject)[s]
+        w, b = s // 32, s % 32
+        assert (heard[by, w] >> b) & 1
+
+
+def test_rumor_expiry_drops_active():
+    params, state, step = make(n=8, suspicion_ticks=1000, age_slack=0)
+    kill = jnp.zeros(8, bool).at[2].set(True)
+    state, _ = step(state, es.ChurnInputs(kill=kill, revive=jnp.zeros(8, bool)))
+    assert int(jnp.sum(state.r_active)) >= 1
+    # max age = 15 * digits(live=7 -> 1) + 0 = 15 ticks
+    state, ms = run_ticks(state, step, 20, 8)
+    assert int(ms[-1].active_rumors) == 0
+
+
+def test_epoch_respected_in_checksums():
+    params = es.ScalableParams(n=8, u=64, epoch=999_000)
+    state = es.init_state(params, seed=0)
+    cs = es.compute_checksums(state, params)
+    assert np.unique(np.asarray(cs)).size == 1
