@@ -3,51 +3,63 @@
 The full-fidelity engine (:mod:`ringpop_tpu.models.sim.engine`) keeps every
 node's complete view — ``[N, N]`` arrays — which is exact but caps N at a few
 thousand.  This engine is the large-scale mode behind the 100k epidemic-
-broadcast and 1M churn-storm configs (BASELINE.md north-star table): it
-replaces per-node views with
+broadcast and 1M churn-storm configs (BASELINE.md north-star table).  It
+replaces per-node views with three pieces:
 
 - **global truth** arrays ``[N]`` — each member's current status and
-  incarnation as asserted by the cluster's most recent update about it, and
-- a bounded **rumor table** of the U most recent membership update events
-  (the circulating dissemination set — the union of all nodes' piggyback
-  change tables in the reference, lib/gossip/dissemination.js), and
+  incarnation as most recently asserted,
+- a bounded table of **batch rumors**: one rumor per (tick, event class)
+  covering the whole *set* of subjects that class touched this tick —
+  suspect detections, suspicion expiries (faulty), and revive/rejoin
+  (alive).  A rumor stores no member list: because the per-node checksum is
+  an additive combine over member records, a rumor only needs the scalar
+  **checksum delta** of its whole subject set, precomputed at publish time
+  against the then-current truth, and
 - per-node **heard bitmasks**, bit r of ``heard[i]`` = node i has received
   rumor r, packed 32 rumors per uint32 lane: ``[N, U/32] uint32``.
 
-Node i's implied membership view = (base snapshot) + (its heard rumors,
-reduced per subject by the SWIM precedence key).  Per-node checksums use a
-**commutative combine** (sum mod 2^32 of per-member record hashes) instead of
-the reference's order-sensitive hash-of-joined-string — bit-exact checksum
-parity is the job of the full-fidelity engine at <=1k nodes; at 100k+ the
-checksum only needs to *discriminate views*, and a sum-combine does, while
-costing O(U) per node instead of O(N).
+A node's checksum is ``base_sum + Σ_{heard ∩ active} r_delta[r]`` — equal
+heard-sets give equal checksums, different heard-sets differ w.h.p., which is
+exactly the discrimination the convergence views need (tick-cluster groups
+nodes by checksum, scripts/tick-cluster.js:87-114; the convergence benchmark
+declares convergence when all live checksums agree, benchmarks/convergence-
+time/scenario-runner.js:152-170).  Bit-exact FarmHash string-checksum parity
+is the full-fidelity engine's job at <=1k nodes.
+
+Chained deltas compose: a suspect rumor's delta is taken against alive
+truth, the follow-up faulty rumor's delta against suspect truth, so a node
+that heard both holds exactly the faulty record's contribution regardless of
+arrival order (the sum is commutative).  When a rumor ages out — the batched
+analog of dropping a change once its piggyback count exceeds
+``15·ceil(log10(n+1))`` (lib/gossip/dissemination.js:41) — its delta is
+folded into ``base_sum``: by then dissemination has completed (age >>
+O(log N) convergence), so every live node's checksum is unchanged by the
+fold.  Slot allocation is deterministic round-robin, 3 slots per tick, so a
+10% churn storm at 1M nodes costs the same table space as one lost ping.
 
 Gossip exchange is **push-pull over random pairings**: each tick every live
-node draws K partner permutations; pushes its heard-set to partner 0 (the
-direct ping, dissemination piggyback) and pulls the partner's set back (the
-ack's issueAsReceiver changes).  A failed direct ping (dead/partitioned/lossy
-partner) falls back to K-1 indirect partners (the ping-req fanout, k=3,
-ping-req-sender.js:293-296).  Permutation pairing keeps the exchange a dense
-gather + bitwise-OR — no scatter conflicts, no segment reductions — which is
-exactly the memory-bandwidth-bound shape TPUs like.  SWIM's randomized
-round-robin probe order has the same pairing distribution; the deviation
-envelope is documented in SURVEY.md §7 ("hard parts" 4 and 6).
+node draws K partner permutations; it pushes its heard-set to partner 0 (the
+direct ping's piggyback, ping-sender.js:70-76) and pulls the partner's set
+back (the ack's issueAsReceiver changes, server/protocol/ping.js:46-49).  A
+failed direct ping (dead or lossy partner) falls back to the K-1 indirect
+partners (the ping-req fanout, k=3, ping-req-sender.js:293-296).
+Permutation pairing keeps the exchange a dense gather + bitwise-OR — no
+scatter conflicts, no segment reductions — the memory-bandwidth-bound shape
+TPUs like.  Deviation envelope vs the reference's per-node round-robin
+iterator is documented in SURVEY.md §7 (hard parts 4 and 6).
 
-Rumor lifecycle mirrors piggyback aging: a rumor is dropped once its age
-exceeds ``15 * ceil(log10(n+1))`` ticks plus slack — at one ping per node per
-tick, per-node piggyback count is bounded by ticks-since-heard, so global age
-upper-bounds the reference's per-node drop rule (dissemination.js:41).
-Failure detection: a node whose direct ping and all indirect probes fail to
-reach a dead partner publishes a *suspect* rumor; after ``suspicion_ticks``
-the suspect's surviving rumor escalates to *faulty* (suspicion.js:67-70).
-Revived nodes publish an alive rumor with a fresh incarnation (the refute
-path, member.js:76-81).
+Failure detection: a node whose direct partner's process is down publishes
+(joins) this tick's suspect batch and starts a suspicion clock; after
+``suspicion_ticks`` (5s at 200ms periods, suspicion.js:111-113) a
+still-suspect subject joins the faulty batch.  Revived nodes publish alive
+with a fresh incarnation — the refute/rejoin path (member.js:76-81,
+server/admin/member.js:44-51) — and restart with empty state (the reference
+rebuilds a restarted node entirely via join, server/protocol/join.js:131).
 """
 
 from __future__ import annotations
 
-import functools
-from typing import NamedTuple, Optional
+from typing import NamedTuple
 
 import jax
 import jax.numpy as jnp
@@ -58,44 +70,40 @@ from ringpop_tpu.ops.record_mix import record_mix
 ALIVE, SUSPECT, FAULTY, LEAVE = 0, 1, 2, 3
 
 WORD = 32
+SLOTS_PER_TICK = 3  # suspect batch, faulty batch, alive batch
 
 
 class ScalableParams(NamedTuple):
     n: int
-    u: int = 512  # rumor table capacity (power of 32 multiple)
+    u: int = 512  # rumor table capacity; must cover SLOTS_PER_TICK * max_age
     ping_req_size: int = 3  # index.js:113
-    suspicion_ticks: int = 25  # 5000ms / 200ms
+    suspicion_ticks: int = 25  # 5000 ms / 200 ms — suspicion.js:111-113
     piggyback_factor: int = 15  # dissemination.js:41
     age_slack: int = 8  # extra ticks beyond max piggyback before drop
     packet_loss: float = 0.0
     epoch: int = 1414142122274
-    # checksums every tick cost O(N*U); storms at 1M nodes can compute them
-    # on demand (compute_checksums) instead
+    # checksums every tick cost O(N*U) bandwidth; 1M-node storms can compute
+    # them on demand (compute_checksums) instead
     checksum_in_tick: bool = True
 
 
 class ScalableState(NamedTuple):
     tick_index: jax.Array  # scalar int32
-    # fault plane + truth
-    proc_alive: jax.Array  # [N] bool — process up
+    proc_alive: jax.Array  # [N] bool — process up (fault plane)
     truth_status: jax.Array  # [N] int32 — latest asserted status
     truth_inc: jax.Array  # [N] int64 — latest asserted incarnation
-    # rumor table (global, bounded)
+    # batch-rumor table
     r_active: jax.Array  # [U] bool
-    r_subject: jax.Array  # [U] int32
-    r_status: jax.Array  # [U] int32
-    r_inc: jax.Array  # [U] int64
-    r_birth: jax.Array  # [U] int32 — tick the rumor was published
-    r_hash: jax.Array  # [U] uint32 — record hash of (subject,status,inc)
-    # per-node reception
-    heard: jax.Array  # [N, U/32] uint32 bit-packed
-    # per-node detection state: tick at which node started suspecting its
-    # (single) currently-probed dead partner, -1 if none
-    susp_subject: jax.Array  # [N] int32 — -1 or suspected node
+    r_delta: jax.Array  # [U] uint32 — checksum delta of the subject set
+    r_birth: jax.Array  # [U] int32 — tick published
+    # per-node reception bitmask
+    heard: jax.Array  # [N, U/32] uint32
+    # per-node failure-detection state (single in-flight suspicion per node)
+    susp_subject: jax.Array  # [N] int32 — -1 or the suspected node
     susp_since: jax.Array  # [N] int32
-    # base (pre-rumor) commutative checksum common to all nodes
+    # commutative checksum base shared by all fully-caught-up nodes
     base_sum: jax.Array  # scalar uint32
-    rng: jax.Array  # [2] uint32 — global fold key
+    rng: jax.Array  # [2] uint32
     checksum: jax.Array  # [N] uint32
 
 
@@ -103,20 +111,22 @@ class ScalableMetrics(NamedTuple):
     live_nodes: jax.Array
     active_rumors: jax.Array
     mean_heard_frac: jax.Array  # mean fraction of active rumors heard
-    full_coverage: jax.Array  # bool — every live node heard every rumor
+    full_coverage: jax.Array  # every live node heard every active rumor
     distinct_checksums: jax.Array
-    suspects_published: jax.Array
+    suspects_published: jax.Array  # subjects newly suspected this tick
     faulties_published: jax.Array
 
 
-# the commutative record hash shared with the full-fidelity engine's fast
-# checksum mode (not FarmHash — at scale the checksum's job is view
-# discrimination, not string parity; see module docstring)
-_record_hash = record_mix
+class ChurnInputs(NamedTuple):
+    kill: jax.Array  # [N] bool
+    revive: jax.Array  # [N] bool
+
+    @staticmethod
+    def quiet(n: int) -> "ChurnInputs":
+        return ChurnInputs(kill=jnp.zeros(n, bool), revive=jnp.zeros(n, bool))
 
 
 def _rand_u32(key: jax.Array, shape, salt: int) -> jax.Array:
-    """Counter-based uniform uint32 stream from the global fold key."""
     size = int(np.prod(shape))
     i = jnp.arange(size, dtype=jnp.uint32)
     x = key[0] + i * jnp.uint32(0x01000193) + jnp.uint32(salt)
@@ -140,29 +150,56 @@ def _fold(key: jax.Array, salt: int) -> jax.Array:
 
 
 def _perm(key: jax.Array, n: int, salt: int) -> jax.Array:
-    """Random permutation of [0, n) via sort of random keys (device-side)."""
+    """Random permutation of [0, n) via sort of per-index random keys."""
     r = _rand_u32(key, (n,), salt)
-    return jnp.argsort(r.astype(jnp.uint32) ^ jnp.arange(n, dtype=jnp.uint32))
+    return jnp.argsort(
+        r.astype(jnp.uint32) ^ jnp.arange(n, dtype=jnp.uint32)
+    ).astype(jnp.int32)
+
+
+def _pack_mask(bits: jax.Array) -> jax.Array:
+    """[U] bool -> [U/32] uint32, bit r of word r//32 = bits[r]."""
+    u = bits.shape[0]
+    w = bits.reshape(u // WORD, WORD)
+    weights = (jnp.uint32(1) << jnp.arange(WORD, dtype=jnp.uint32))[None, :]
+    return jnp.sum(jnp.where(w, weights, 0), axis=1, dtype=jnp.uint32)
+
+
+def _popcount(x: jax.Array) -> jax.Array:
+    x = x - ((x >> 1) & jnp.uint32(0x55555555))
+    x = (x & jnp.uint32(0x33333333)) + ((x >> 2) & jnp.uint32(0x33333333))
+    x = (x + (x >> 4)) & jnp.uint32(0x0F0F0F0F)
+    return (x * jnp.uint32(0x01010101)) >> 24
+
+
+def max_rumor_age(params: ScalableParams) -> int:
+    """Worst-case rumor lifetime in ticks (at full live count)."""
+    digits = len(str(params.n))
+    return params.piggyback_factor * digits + params.age_slack
 
 
 def init_state(params: ScalableParams, seed: int = 0) -> ScalableState:
     n, u = params.n, params.u
     assert u % WORD == 0, "rumor capacity must be a multiple of 32"
+    need = SLOTS_PER_TICK * (max_rumor_age(params) + 2)
+    if u < need:
+        raise ValueError(
+            "rumor table u=%d can recycle a slot before its rumor ages out "
+            "(need u >= %d for n=%d): an undisseminated delta would fold "
+            "into base_sum and erase real divergence" % (u, need, n)
+        )
     rng = np.random.default_rng(seed)
-    inc0 = np.full(n, params.epoch, np.int64)
+    inc0 = jnp.full(n, params.epoch, jnp.int64)
     subj = jnp.arange(n, dtype=jnp.int32)
-    base = _record_hash(subj, jnp.zeros(n, jnp.int32), jnp.asarray(inc0))
+    base = record_mix(subj, jnp.zeros(n, jnp.int32), inc0)
     return ScalableState(
         tick_index=jnp.int32(0),
         proc_alive=jnp.ones(n, bool),
         truth_status=jnp.zeros(n, jnp.int32),
-        truth_inc=jnp.asarray(inc0),
+        truth_inc=inc0,
         r_active=jnp.zeros(u, bool),
-        r_subject=jnp.zeros(u, jnp.int32),
-        r_status=jnp.zeros(u, jnp.int32),
-        r_inc=jnp.zeros(u, jnp.int64),
+        r_delta=jnp.zeros(u, jnp.uint32),
         r_birth=jnp.zeros(u, jnp.int32),
-        r_hash=jnp.zeros(u, jnp.uint32),
         heard=jnp.zeros((n, u // WORD), jnp.uint32),
         susp_subject=jnp.full(n, -1, jnp.int32),
         susp_since=jnp.full(n, -1, jnp.int32),
@@ -172,162 +209,66 @@ def init_state(params: ScalableParams, seed: int = 0) -> ScalableState:
     )
 
 
-class ChurnInputs(NamedTuple):
-    """Per-tick fault plane for the scalable engine."""
-
-    kill: jax.Array  # [N] bool
-    revive: jax.Array  # [N] bool
-
-    @staticmethod
-    def quiet(n: int) -> "ChurnInputs":
-        return ChurnInputs(kill=jnp.zeros(n, bool), revive=jnp.zeros(n, bool))
-
-
-def _publish(state: ScalableState, want: jax.Array, subject, status, inc, tick):
-    """Allocate rumor slots for `want` events (one per node slot, [N] bool).
-
-    Slot policy: overwrite the stalest slots (inactive first, then oldest
-    birth).  Returns updated state.  Publishing nodes immediately hear their
-    own rumor."""
-    n = state.heard.shape[0]
-    u = state.r_active.shape[0]
-    # rank free/stale slots: inactive -> key 0..; active -> key by birth
-    slot_key = jnp.where(
-        state.r_active, state.r_birth.astype(jnp.int64) + (1 << 32), jnp.int64(0)
+def _publish_batch(
+    state: ScalableState,
+    slot: jax.Array,  # scalar int32 — pre-cleared slot for this tick
+    subj_mask: jax.Array,  # [N] bool — members this event touches
+    new_status: jax.Array,  # [N] int32 (per subject)
+    new_inc: jax.Array,  # [N] int64 (per subject)
+    hearer_mask: jax.Array,  # [N] bool — nodes that know at publish time
+    tick: jax.Array,
+) -> ScalableState:
+    """One batch rumor: scalar delta vs current truth, truth advance, and
+    initial heard bits for the publishing nodes."""
+    n = state.proc_alive.shape[0]
+    ids = jnp.arange(n, dtype=jnp.int32)
+    prev_h = record_mix(ids, state.truth_status, state.truth_inc)
+    new_h = record_mix(ids, new_status, new_inc)
+    delta = jnp.sum(
+        jnp.where(subj_mask, new_h - prev_h, 0), dtype=jnp.uint32
     )
-    slot_order = jnp.argsort(slot_key)  # stalest first
-    # rank events: which of the [N] want-flags get slots (at most u)
-    ev_rank = jnp.cumsum(want.astype(jnp.int32)) - 1  # position among wanted
-    has_slot = want & (ev_rank < u)
-    slot_of_ev = slot_order[jnp.clip(ev_rank, 0, u - 1)]  # [N]
-    # scatter index: non-publishers go out of bounds so mode='drop' discards
-    # them (a clipped index would make every non-publisher write the OLD
-    # value onto slot_order[0], clobbering real publishes)
-    slot_idx = jnp.where(has_slot, slot_of_ev, u)
-
-    new_hash = _record_hash(subject, status, inc)
-
-    def upd(arr, val):
-        return arr.at[slot_idx].set(val, mode="drop")
-
-    r_active = upd(state.r_active, True)
-    r_subject = upd(state.r_subject, subject)
-    r_status = upd(state.r_status, status)
-    r_inc = upd(state.r_inc, inc)
-    r_birth = upd(state.r_birth, jnp.broadcast_to(tick, (n,)))
-    r_hash = upd(state.r_hash, new_hash)
-
-    # truth advances to the newest assertion (indexed by SUBJECT; concurrent
-    # publishers about the same subject resolve arbitrarily, like racing
-    # gossip messages)
-    subj_idx = jnp.where(has_slot, subject, n)
-    truth_status = state.truth_status.at[subj_idx].set(status, mode="drop")
-    truth_inc = state.truth_inc.at[subj_idx].set(inc, mode="drop")
-
-    # freshly (re)allocated slots must be cleared from every node's heard
-    # mask (the old rumor that lived in the slot is gone), then each
-    # publisher hears its own rumor
-    reused = jnp.zeros(u, bool).at[slot_idx].set(True, mode="drop")
-    clear_words = _pack_mask(reused)  # [U/32]
-    heard = state.heard & ~clear_words[None, :]
-    heard = _rehear_own(heard, slot_of_ev, has_slot, n)
+    any_ev = jnp.any(subj_mask)
     return state._replace(
-        r_active=r_active,
-        r_subject=r_subject,
-        r_status=r_status,
-        r_inc=r_inc,
-        r_birth=r_birth,
-        r_hash=r_hash,
-        truth_status=truth_status,
-        truth_inc=truth_inc,
-        heard=heard,
-    )
-
-
-def _pack_mask(bits: jax.Array) -> jax.Array:
-    """[U] bool -> [U/32] uint32 with bit r of word r//32 = bits[r]."""
-    u = bits.shape[0]
-    w = bits.reshape(u // WORD, WORD)
-    weights = (jnp.uint32(1) << jnp.arange(WORD, dtype=jnp.uint32))[None, :]
-    return jnp.sum(jnp.where(w, weights, 0), axis=1, dtype=jnp.uint32)
-
-
-def _rehear_own(heard, slot_of_ev, has_slot, n):
-    word = slot_of_ev // WORD
-    bit = (slot_of_ev % WORD).astype(jnp.uint32)
-    rows = jnp.arange(n)
-    cur = heard[rows, word]
-    return heard.at[rows, word].set(
-        jnp.where(has_slot, cur | (jnp.uint32(1) << bit), cur)
+        r_active=state.r_active.at[slot].set(any_ev),
+        r_delta=state.r_delta.at[slot].set(delta),
+        r_birth=state.r_birth.at[slot].set(tick),
+        truth_status=jnp.where(subj_mask, new_status, state.truth_status),
+        truth_inc=jnp.where(subj_mask, new_inc, state.truth_inc),
+        heard=jnp.where(
+            (hearer_mask & any_ev)[:, None],
+            state.heard.at[:, slot // WORD].set(
+                state.heard[:, slot // WORD]
+                | (jnp.uint32(1) << (slot % WORD).astype(jnp.uint32))
+            ),
+            state.heard,
+        ),
     )
 
 
 def compute_checksums(state: ScalableState, params: ScalableParams) -> jax.Array:
-    """Per-node commutative view checksum, O(U) per node.
+    """checksum(i) = base_sum + Σ over active rumors i heard of r_delta."""
+    u = params.u
+    active_words = _pack_mask(state.r_active)
+    delta_w = state.r_delta.reshape(u // WORD, WORD)  # [W, 32]
+    bit_ids = jnp.arange(WORD, dtype=jnp.uint32)[None, None, :]
 
-    checksum(i) = base_sum + sum over *effective* heard rumors of
-    (new_hash - prev_hash).  "Effective": among heard rumors sharing a
-    subject, only the one with the highest (inc, status-rank) key counts,
-    and its prev_hash chain collapses to the subject's base record — so we
-    sum (winner_hash - base_hash(subject)) per heard subject.  Implemented
-    as a per-node segment-max over the U rumor slots grouped by subject.
-    """
-    u = state.r_active.shape[0]
-    key = jnp.where(
-        state.r_active,
-        state.r_inc * 4 + state.r_status,
-        jnp.int64(-1),
-    )  # [U] — SWIM precedence key per rumor
-
-    # remap subjects to dense group ids within the table: gid[r] = first slot
-    # holding r's subject ([U, U] once — U is small, e.g. 512)
-    same = (state.r_subject[None, :] == state.r_subject[:, None]) & (
-        state.r_active[None, :] & state.r_active[:, None]
-    )
-    slot_ids = jnp.arange(u)
-    gid = jnp.min(jnp.where(same, slot_ids[None, :], u), axis=1)  # [U]
-    gid = jnp.where(state.r_active, gid, u)  # inactive -> dropped segment
-
-    base_h = _record_hash(
-        state.r_subject,
-        jnp.zeros(u, jnp.int32),
-        jnp.full(u, params.epoch, jnp.int64),
-    )
-    delta = (state.r_hash - base_h).astype(jnp.uint32)
-    weights = (jnp.uint32(1) << jnp.arange(WORD, dtype=jnp.uint32))[None, None, :]
-
-    def row_delta(hk_row):
-        # hk_row: [U] precedence keys of rumors this node heard (-1 = not)
-        gmax = jax.ops.segment_max(hk_row, gid, num_segments=u + 1)[:u]
-        gfirst = jax.ops.segment_min(
-            jnp.where(hk_row == gmax[jnp.clip(gid, 0, u - 1)], slot_ids, u),
-            gid,
-            num_segments=u + 1,
-        )[:u]
-        winner = (
-            (hk_row >= 0)
-            & (hk_row == gmax[jnp.clip(gid, 0, u - 1)])
-            & (slot_ids == gfirst[jnp.clip(gid, 0, u - 1)])
+    def per_chunk(h):  # [C, W] uint32 -> [C] uint32
+        hw = h & active_words[None, :]
+        bits = (hw[:, :, None] >> bit_ids) & jnp.uint32(1)  # [C, W, 32]
+        return jnp.sum(
+            bits * delta_w[None, :, :], axis=(1, 2), dtype=jnp.uint32
         )
-        return jnp.sum(jnp.where(winner, delta, 0), dtype=jnp.uint32)
-
-    def per_chunk(heard_rows):
-        # [C, U/32] uint32 -> [C, U] heard bools -> per-row winner delta sum
-        h = (heard_rows[:, :, None] & weights) != 0
-        hb = h.reshape(heard_rows.shape[0], u)
-        hk = jnp.where(hb & state.r_active[None, :], key[None, :], jnp.int64(-1))
-        return jax.vmap(row_delta)(hk)
 
     n = state.heard.shape[0]
-    chunk = max(1, min(n, 8192))
-    pads = (-n) % chunk
+    chunk = max(1, min(n, 65536))
+    pad = (-n) % chunk
     rows = state.heard
-    if pads:
-        rows = jnp.pad(rows, ((0, pads), (0, 0)))
-    deltas = jax.lax.map(
+    if pad:
+        rows = jnp.pad(rows, ((0, pad), (0, 0)))
+    out = jax.lax.map(
         per_chunk, rows.reshape(-1, chunk, rows.shape[1])
     ).reshape(-1)[:n]
-    return state.base_sum + deltas
+    return state.base_sum + out
 
 
 def tick(
@@ -337,14 +278,13 @@ def tick(
     t = state.tick_index + 1
     now = jnp.int64(params.epoch) + t.astype(jnp.int64) * 200
     rng = state.rng
+    ids = jnp.arange(n, dtype=jnp.int32)
 
     # ---- fault plane ---------------------------------------------------
     revived = inputs.revive & ~state.proc_alive
     proc_alive = (state.proc_alive & ~inputs.kill) | inputs.revive
     # a restarted process loses all pre-crash state (the reference rebuilds
-    # entirely via join full-sync, server/protocol/join.js:131): zero its
-    # heard set and detection state, then publish its fresh-incarnation
-    # alive rumor (the refute/rejoin path)
+    # entirely via join full-sync, server/protocol/join.js:131)
     state = state._replace(
         proc_alive=proc_alive,
         tick_index=t,
@@ -352,48 +292,51 @@ def tick(
         susp_subject=jnp.where(revived, -1, state.susp_subject),
         susp_since=jnp.where(revived, -1, state.susp_since),
     )
-    subj_ids = jnp.arange(n, dtype=jnp.int32)
-    state = _publish(
-        state,
-        revived,
-        subj_ids,
-        jnp.full(n, ALIVE, jnp.int32),
-        jnp.full(n, now, jnp.int64),
-        t,
-    )
 
-    # ---- rumor aging (piggyback drop rule upper bound) -----------------
+    # ---- rumor aging + slot recycling ----------------------------------
+    # aging: the batched analog of the per-change piggyback drop rule
     live_count = jnp.sum(proc_alive.astype(jnp.int32))
     digits = jnp.sum(
         live_count >= 10 ** jnp.arange(10, dtype=jnp.int64), dtype=jnp.int32
     )
     max_age = params.piggyback_factor * digits + params.age_slack
-    expired = state.r_active & (t - state.r_birth > max_age)
-    state = state._replace(r_active=state.r_active & ~expired)
-    # expired rumors' bits stay set in heard; they're masked out by r_active
-    # everywhere they're read.
+    aged = state.r_active & (t - state.r_birth > max_age)
+    # this tick's three deterministic slots are recycled regardless of age
+    slots = (SLOTS_PER_TICK * (t - 1) + jnp.arange(SLOTS_PER_TICK)) % u
+    recycled = jnp.zeros(u, bool).at[slots].set(True)
+    retired = aged | (state.r_active & recycled)
+    # fold retired deltas into the shared base (dissemination has long
+    # completed by retirement age; every live node already counts them)
+    base_sum = state.base_sum + jnp.sum(
+        jnp.where(retired, state.r_delta, 0), dtype=jnp.uint32
+    )
+    # recycled slots' stale heard bits must vanish before reuse
+    clear_words = _pack_mask(recycled)
+    state = state._replace(
+        r_active=state.r_active & ~retired,
+        base_sum=base_sum,
+        heard=state.heard & ~clear_words[None, :],
+    )
 
     # ---- gossip exchange: push-pull over K random pairings -------------
     k_total = 1 + params.ping_req_size
-    heard = state.heard
-    live_f = proc_alive
     active_words = _pack_mask(state.r_active)
-
-    new_heard = heard
+    new_heard = state.heard
     direct_ok = jnp.zeros(n, bool)
+    partner0 = _perm(rng, n, salt=0xA11CE)
     for k in range(k_total):
-        partner = _perm(rng, n, salt=0xA11CE + 7 * k)
+        partner = partner0 if k == 0 else _perm(rng, n, salt=0xA11CE + 7 * k)
         loss = _uniform(rng, (n,), salt=0xB0B0 + k) < params.packet_loss
-        ok = live_f & live_f[partner] & ~loss
+        ok = proc_alive & proc_alive[partner] & ~loss
         if k == 0:
             direct_ok = ok
             use = ok
         else:
-            # indirect probes only fire for nodes whose direct ping failed
-            use = live_f & ~direct_ok & live_f[partner] & ~loss
-        # pull: i ORs partner's heard set; push: partner ORs i's set.
-        # The push scatter i -> partner[i] is a gather by the inverse
-        # permutation (partner is a permutation, so no write conflicts).
+            # indirect exchange only for nodes whose direct ping failed
+            use = proc_alive & ~direct_ok & proc_alive[partner] & ~loss
+        # pull: i ORs partner's heard set; push: partner ORs i's set.  The
+        # push scatter i -> partner[i] is a gather by the inverse
+        # permutation (partner is a permutation: no write conflicts).
         pulled = jnp.where(use[:, None], new_heard[partner], 0)
         inv = jnp.argsort(partner)
         pushed = jnp.where(use[inv][:, None], new_heard[inv], 0)
@@ -402,73 +345,110 @@ def tick(
         )
     state = state._replace(heard=new_heard)
 
-    # ---- failure detection --------------------------------------------
-    # nodes whose direct partner was dead and no indirect path reached it:
-    # with the partner dead, no probe reaches it by construction; publish
-    # suspect if not already suspected by us
-    partner0 = _perm(rng, n, salt=0xA11CE)
-    tgt_dead = live_f & ~proc_alive[partner0]
+    # ---- failure detection: suspect batch ------------------------------
+    # cancel suspicion clocks whose subject is no longer suspect in truth —
+    # refuted alive (reference stops timers on non-suspect updates,
+    # on_membership_event.js:86-104) or already escalated faulty
+    csubj = jnp.clip(state.susp_subject, 0, n - 1)
+    cancel = (state.susp_subject >= 0) & (
+        state.truth_status[csubj] != SUSPECT
+    )
+    state = state._replace(
+        susp_subject=jnp.where(cancel, -1, state.susp_subject),
+        susp_since=jnp.where(cancel, -1, state.susp_since),
+    )
+    tgt_dead = proc_alive & ~proc_alive[partner0]
     start_susp = tgt_dead & (state.susp_subject != partner0)
-    susp_subject = jnp.where(start_susp, partner0, state.susp_subject)
-    susp_since = jnp.where(start_susp, t, state.susp_since)
-    # target already faulty in truth? then don't re-publish
+    state = state._replace(
+        susp_subject=jnp.where(start_susp, partner0, state.susp_subject),
+        susp_since=jnp.where(start_susp, t, state.susp_since),
+    )
     already_down = state.truth_status[jnp.clip(partner0, 0, n - 1)] >= SUSPECT
-    publish_suspect = start_susp & ~already_down
-    n_susp = jnp.sum(publish_suspect.astype(jnp.int32))
-    state = state._replace(susp_subject=susp_subject, susp_since=susp_since)
-    state = _publish(
+    detector = start_susp & ~already_down
+    # subjects of this tick's suspect batch (dedup via boolean scatter)
+    subj_idx = jnp.where(detector, partner0, n)
+    suspect_subjects = jnp.zeros(n, bool).at[subj_idx].set(True, mode="drop")
+    n_susp = jnp.sum(suspect_subjects.astype(jnp.int32))
+    state = _publish_batch(
         state,
-        publish_suspect,
-        partner0.astype(jnp.int32),
+        slots[0],
+        suspect_subjects,
         jnp.full(n, SUSPECT, jnp.int32),
-        state.truth_inc[jnp.clip(partner0, 0, n - 1)],
+        state.truth_inc,  # suspect keeps the member's incarnation
+        detector,
         t,
     )
 
-    # suspicion expiry -> faulty rumor (by the original suspector)
+    # ---- suspicion expiry: faulty batch --------------------------------
     expire = (
         (state.susp_since >= 0)
         & (t - state.susp_since >= params.suspicion_ticks)
-        & live_f
+        & proc_alive
     )
-    subj = jnp.clip(state.susp_subject, 0, n - 1)
-    still_suspect = state.truth_status[subj] == SUSPECT
-    publish_faulty = expire & still_suspect & (state.susp_subject >= 0)
-    n_faulty = jnp.sum(publish_faulty.astype(jnp.int32))
+    esubj = jnp.clip(state.susp_subject, 0, n - 1)
+    still_suspect = state.truth_status[esubj] == SUSPECT
+    expirer = expire & still_suspect & (state.susp_subject >= 0)
+    fs_idx = jnp.where(expirer, state.susp_subject, n)
+    faulty_subjects = jnp.zeros(n, bool).at[fs_idx].set(True, mode="drop")
+    n_faulty = jnp.sum(faulty_subjects.astype(jnp.int32))
     state = state._replace(
         susp_subject=jnp.where(expire, -1, state.susp_subject),
         susp_since=jnp.where(expire, -1, state.susp_since),
     )
-    state = _publish(
+    state = _publish_batch(
         state,
-        publish_faulty,
-        subj.astype(jnp.int32),
+        slots[1],
+        faulty_subjects,
         jnp.full(n, FAULTY, jnp.int32),
-        state.truth_inc[subj],
+        state.truth_inc,  # faulty with current incarnation (suspicion.js:67-70)
+        expirer,
+        t,
+    )
+
+    # ---- rejoin: alive batch -------------------------------------------
+    state = _publish_batch(
+        state,
+        slots[2],
+        revived,
+        jnp.full(n, ALIVE, jnp.int32),
+        jnp.full(n, now, jnp.int64),  # fresh incarnation (member.js:78-81)
+        revived,
         t,
     )
 
     # ---- checksums + metrics ------------------------------------------
     if params.checksum_in_tick:
         checksum = compute_checksums(state, params)
+        view_sig = checksum
     else:
+        # membership checksums deferred to compute_checksums() on demand;
+        # the distinct-view metric still needs a per-node view fingerprint,
+        # which the active heard-set provides at O(N*U/32) cost
         checksum = state.checksum
+        aw = _pack_mask(state.r_active)
+        hw = state.heard & aw[None, :]
+        pos = jnp.arange(hw.shape[1], dtype=jnp.uint32)[None, :]
+        m = hw * jnp.uint32(0x9E3779B1) + pos * jnp.uint32(0x85EBCA77)
+        m ^= m >> 15
+        view_sig = jnp.sum(m * jnp.uint32(0x2C1B3C6D), axis=1, dtype=jnp.uint32)
     state = state._replace(checksum=checksum, rng=_fold(rng, 0x5CA1E))
 
     active_words2 = _pack_mask(state.r_active)
     n_active = jnp.sum(state.r_active.astype(jnp.int32))
     heard_counts = jnp.sum(
         _popcount(state.heard & active_words2[None, :]), axis=1
-    )  # [N]
+    )
     frac = jnp.where(
         n_active > 0,
         heard_counts.astype(jnp.float32) / jnp.maximum(n_active, 1),
         1.0,
     )
-    live_frac = jnp.where(live_f, frac, 1.0)
-    full_cov = jnp.all(jnp.where(live_f, heard_counts == n_active, True))
+    live_frac = jnp.where(proc_alive, frac, 1.0)
+    full_cov = jnp.all(
+        jnp.where(proc_alive, heard_counts == n_active, True)
+    )
 
-    cs = jnp.where(live_f, checksum, jnp.uint32(0xFFFFFFFF))
+    cs = jnp.where(proc_alive, view_sig, jnp.uint32(0xFFFFFFFF))
     cs_sorted = jnp.sort(cs)
     distinct = (
         jnp.sum(
@@ -479,7 +459,7 @@ def tick(
     ).astype(jnp.int32)
 
     metrics = ScalableMetrics(
-        live_nodes=jnp.sum(live_f.astype(jnp.int32)),
+        live_nodes=jnp.sum(proc_alive.astype(jnp.int32)),
         active_rumors=n_active,
         mean_heard_frac=jnp.mean(live_frac),
         full_coverage=full_cov,
@@ -488,10 +468,3 @@ def tick(
         faulties_published=n_faulty,
     )
     return state, metrics
-
-
-def _popcount(x: jax.Array) -> jax.Array:
-    x = x - ((x >> 1) & jnp.uint32(0x55555555))
-    x = (x & jnp.uint32(0x33333333)) + ((x >> 2) & jnp.uint32(0x33333333))
-    x = (x + (x >> 4)) & jnp.uint32(0x0F0F0F0F)
-    return (x * jnp.uint32(0x01010101)) >> 24

@@ -1,0 +1,157 @@
+"""ScalableCluster — driver for the O(N·U) engine at 100k-1M nodes.
+
+The large-scale twin of :class:`ringpop_tpu.models.sim.cluster.SimCluster`,
+covering BASELINE.md's last two north-star configs:
+
+- 100k-node SWIM epidemic broadcast (k=3 ping-req fanout, packet loss),
+- 1M-node churn storm: 10% fail/rejoin with ring rebalance + checksum.
+
+No address-string universe at this scale: node identity is the integer index
+and checksums/ring points use the commutative record-hash — string-parity
+belongs to the full-fidelity engine at <=1k nodes (SURVEY.md §7 hard part 6:
+per-node views are kept only as divergence digests, not N x N state).
+
+The consistent-hash ring at scale is the same masked-sort design as
+models/ring/device.py (replica points -> sorted (hash, owner) table,
+lookup = searchsorted; lib/ring/index.js:50-58,145-154) but with replica
+hashes generated on device from the integer node id instead of host-hashed
+`addr + i` strings.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ringpop_tpu.models.sim import engine_scalable as es
+from ringpop_tpu.ops.record_mix import record_mix
+
+
+@dataclasses.dataclass
+class StormSchedule:
+    """Dense [T, N] churn plan."""
+
+    ticks: int
+    n: int
+    kill: np.ndarray = None
+    revive: np.ndarray = None
+
+    def __post_init__(self):
+        if self.kill is None:
+            self.kill = np.zeros((self.ticks, self.n), bool)
+        if self.revive is None:
+            self.revive = np.zeros((self.ticks, self.n), bool)
+
+    def as_inputs(self) -> es.ChurnInputs:
+        return es.ChurnInputs(
+            kill=jnp.asarray(self.kill), revive=jnp.asarray(self.revive)
+        )
+
+    @staticmethod
+    def churn_storm(
+        ticks: int,
+        n: int,
+        fraction: float = 0.1,
+        fail_tick: int = 1,
+        rejoin_tick: Optional[int] = None,
+        seed: int = 0,
+    ) -> "StormSchedule":
+        """Kill ``fraction`` of nodes at ``fail_tick``, revive them at
+        ``rejoin_tick`` (default: halfway) — the 1M churn-storm config."""
+        if rejoin_tick is None:
+            rejoin_tick = ticks // 2
+        rng = np.random.default_rng(seed)
+        victims = rng.choice(n, size=max(1, int(n * fraction)), replace=False)
+        sched = StormSchedule(ticks=ticks, n=n)
+        sched.kill[fail_tick, victims] = True
+        sched.revive[rejoin_tick, victims] = True
+        return sched
+
+
+def device_replica_hashes(n: int, replica_points: int) -> jax.Array:
+    """[N, R] uint32 replica-point hashes from integer node ids (in-jit)."""
+    ids = jnp.arange(n, dtype=jnp.int32)[:, None]
+    reps = jnp.arange(replica_points, dtype=jnp.int32)[None, :]
+    return record_mix(ids, reps, jnp.int64(0x5EED))
+
+
+def build_ring(replica_hashes: jax.Array, mask: jax.Array) -> jax.Array:
+    """Masked-sort ring table: [N*R] uint64 (hash<<32 | owner), inactive
+    replica points pushed past the end as the all-ones sentinel."""
+    n, r = replica_hashes.shape
+    owners = jnp.broadcast_to(jnp.arange(n, dtype=jnp.uint64)[:, None], (n, r))
+    keys = (replica_hashes.astype(jnp.uint64) << jnp.uint64(32)) | owners
+    keys = jnp.where(mask[:, None], keys, jnp.uint64(0xFFFFFFFFFFFFFFFF))
+    return jnp.sort(keys.reshape(-1))
+
+
+def ring_checksum(ring: jax.Array) -> jax.Array:
+    """Order-sensitive uint32 digest of the ring table (the scale analog of
+    hash32 over sorted server names, lib/ring/index.js:96-105)."""
+    x = (ring & jnp.uint64(0xFFFFFFFF)).astype(jnp.uint32)
+    y = (ring >> jnp.uint64(32)).astype(jnp.uint32)
+    pos = jnp.arange(ring.shape[0], dtype=jnp.uint32)
+    mixed = record_mix(pos, x, y.astype(jnp.int64))
+    return jnp.sum(mixed, dtype=jnp.uint32)
+
+
+class ScalableCluster:
+    def __init__(
+        self,
+        n: int,
+        params: Optional[es.ScalableParams] = None,
+        replica_points: int = 16,
+        seed: int = 0,
+    ):
+        self.params = params or es.ScalableParams(n=n)
+        if self.params.n != n:
+            self.params = self.params._replace(n=n)
+        self.replica_points = replica_points
+        self.state = es.init_state(self.params, seed=seed)
+        self._tick = jax.jit(functools.partial(es.tick, params=self.params))
+
+        @jax.jit
+        def _scanned(state, inputs):
+            def body(st, inp):
+                return es.tick(st, inp, self.params)
+
+            return jax.lax.scan(body, state, inputs)
+
+        self._scanned = _scanned
+
+        @jax.jit
+        def _ring_and_checksum(truth_status, proc_alive):
+            # alive + suspect members stay in the ring
+            # (on_membership_event.js:106-134 keeps alive/suspect servers)
+            in_ring = proc_alive & (truth_status <= es.SUSPECT)
+            reps = device_replica_hashes(self.params.n, self.replica_points)
+            ring = build_ring(reps, in_ring)
+            return ring_checksum(ring)
+
+        self._ring_checksum = _ring_and_checksum
+
+    def step(self, inputs: Optional[es.ChurnInputs] = None):
+        if inputs is None:
+            inputs = es.ChurnInputs.quiet(self.params.n)
+        self.state, m = self._tick(self.state, inputs)
+        return jax.tree.map(np.asarray, m)
+
+    def run(self, schedule: StormSchedule):
+        self.state, ms = self._scanned(self.state, schedule.as_inputs())
+        return jax.tree.map(np.asarray, ms)
+
+    def checksums(self) -> np.ndarray:
+        if not bool(self.params.checksum_in_tick):
+            return np.asarray(
+                es.compute_checksums(self.state, self.params)
+            )
+        return np.asarray(self.state.checksum)
+
+    def ring_checksum(self) -> int:
+        """Rebuild the ring from current truth, return its digest."""
+        return int(self._ring_checksum(self.state.truth_status, self.state.proc_alive))

@@ -89,6 +89,13 @@ def _abstract_state(params: engine.SimParams):
     return jax.eval_shape(lambda: engine.init_state(params))
 
 
+def _replicated_metrics(mesh: Mesh):
+    fields = len(engine.TickMetrics._fields)
+    return jax.tree.map(
+        lambda _: NamedSharding(mesh, P()), engine.TickMetrics(*[0] * fields)
+    )
+
+
 def make_sharded_tick(
     params: engine.SimParams, universe: ce.Universe, mesh: Mesh
 ):
@@ -99,9 +106,7 @@ def make_sharded_tick(
     """
     st_sh = state_shardings(mesh, _abstract_state(params))
     in_sh = inputs_shardings(mesh, engine.TickInputs.quiet(params.n))
-    metrics_sh = jax.tree.map(
-        lambda _: NamedSharding(mesh, P()), engine.TickMetrics(*[0] * 9)
-    )
+    metrics_sh = _replicated_metrics(mesh)
     fn = functools.partial(engine.tick, params=params, universe=universe)
     return jax.jit(
         fn, in_shardings=(st_sh, in_sh), out_shardings=(st_sh, metrics_sh)
@@ -118,9 +123,7 @@ def make_sharded_scan(
         lambda x: NamedSharding(mesh, P(None, axis)),
         engine.TickInputs.quiet(params.n),
     )
-    metrics_sh = jax.tree.map(
-        lambda _: NamedSharding(mesh, P()), engine.TickMetrics(*[0] * 9)
-    )
+    metrics_sh = _replicated_metrics(mesh)
 
     def scanned(state, inputs):
         def body(st, inp):

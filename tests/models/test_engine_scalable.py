@@ -15,7 +15,7 @@ from ringpop_tpu.models.sim import engine_scalable as es
 
 
 def make(n=16, **kw):
-    params = es.ScalableParams(n=n, u=64, **kw)
+    params = es.ScalableParams(n=n, u=160, **kw)
     state = es.init_state(params, seed=7)
     step = jax.jit(functools.partial(es.tick, params=params))
     return params, state, step
@@ -95,31 +95,56 @@ def test_revive_resets_heard_and_publishes_alive():
     assert bool(ms[-1].full_coverage)
 
 
-def test_publish_slot_allocation_no_clobber():
-    """Two simultaneous publishers must land in two distinct slots."""
-    params = es.ScalableParams(n=8, u=64)
+def test_batch_publish_delta_and_hearers():
+    """One batch rumor covers a whole subject set with one scalar delta."""
+    params = es.ScalableParams(n=8, u=96)
     state = es.init_state(params, seed=1)
-    want = jnp.zeros(8, bool).at[1].set(True).at[6].set(True)
-    subj = jnp.arange(8, dtype=jnp.int32)
-    state2 = es._publish(
-        state,
-        want,
-        subj,
-        jnp.full(8, es.SUSPECT, jnp.int32),
-        state.truth_inc,
-        jnp.int32(1),
+    subj_mask = jnp.zeros(8, bool).at[1].set(True).at[6].set(True)
+    hearers = jnp.zeros(8, bool).at[0].set(True)
+    new_status = jnp.full(8, es.SUSPECT, jnp.int32)
+    state2 = es._publish_batch(
+        state, jnp.int32(5), subj_mask, new_status, state.truth_inc,
+        hearers, jnp.int32(1),
     )
-    active = np.asarray(state2.r_active)
-    subjects = np.asarray(state2.r_subject)[active]
-    assert active.sum() == 2
-    assert set(subjects.tolist()) == {1, 6}
-    # each publisher heard its own rumor
+    assert bool(state2.r_active[5])
+    # truth advanced only for the subjects
+    ts = np.asarray(state2.truth_status)
+    assert ts[1] == es.SUSPECT and ts[6] == es.SUSPECT
+    assert ts[0] == es.ALIVE and ts[7] == es.ALIVE
+    # delta equals the summed record-hash movement of the two subjects
+    from ringpop_tpu.ops.record_mix import record_mix
+    ids = jnp.arange(8, dtype=jnp.int32)
+    prev = record_mix(ids, state.truth_status, state.truth_inc)
+    new = record_mix(ids, new_status, state.truth_inc)
+    want = np.uint32(
+        (int(new[1] - prev[1]) + int(new[6] - prev[6])) & 0xFFFFFFFF
+    )
+    assert np.uint32(state2.r_delta[5]) == want
+    # only the hearer has the bit; checksum of hearer = base + delta
     heard = np.asarray(state2.heard)
-    slots = np.nonzero(active)[0]
-    for s, node in zip(sorted(slots), [1, 6]):
-        by = subjects_to_node = np.asarray(state2.r_subject)[s]
-        w, b = s // 32, s % 32
-        assert (heard[by, w] >> b) & 1
+    assert (heard[0, 0] >> 5) & 1 and not (heard[3, 0] >> 5) & 1
+    cs = np.asarray(es.compute_checksums(state2, params))
+    assert cs[0] == np.uint32((int(state2.base_sum) + int(want)) & 0xFFFFFFFF)
+    assert cs[3] == np.uint32(state2.base_sum)
+
+
+def test_mass_churn_does_not_overflow_table():
+    """10%% simultaneous churn costs 1 rumor slot, not one per victim."""
+    n = 64
+    params = es.ScalableParams(n=n, u=128, suspicion_ticks=3)
+    state = es.init_state(params, seed=2)
+    step = jax.jit(functools.partial(es.tick, params=params))
+    kill = jnp.zeros(n, bool).at[jnp.arange(6)].set(True)
+    state, m = step(state, es.ChurnInputs(kill=kill, revive=jnp.zeros(n, bool)))
+    for _ in range(10):
+        state, m = step(state, es.ChurnInputs.quiet(n))
+        assert int(m.active_rumors) <= 3 * 11  # <= SLOTS_PER_TICK per tick
+    rv = kill
+    state, m = step(state, es.ChurnInputs(kill=jnp.zeros(n, bool), revive=rv))
+    for _ in range(15):
+        state, m = step(state, es.ChurnInputs.quiet(n))
+    assert int(m.live_nodes) == n
+    assert int(m.distinct_checksums) == 1
 
 
 def test_rumor_expiry_drops_active():
@@ -133,7 +158,7 @@ def test_rumor_expiry_drops_active():
 
 
 def test_epoch_respected_in_checksums():
-    params = es.ScalableParams(n=8, u=64, epoch=999_000)
+    params = es.ScalableParams(n=8, u=96, epoch=999_000)
     state = es.init_state(params, seed=0)
     cs = es.compute_checksums(state, params)
     assert np.unique(np.asarray(cs)).size == 1
