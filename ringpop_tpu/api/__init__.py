@@ -1,0 +1,6 @@
+"""Public API: the Ringpop facade, request proxy, sharding handler, server
+endpoints, admin client, and CLI/tick-cluster tooling."""
+
+from ringpop_tpu.api.ringpop import Ringpop
+
+__all__ = ["Ringpop"]

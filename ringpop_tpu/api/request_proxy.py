@@ -1,0 +1,167 @@
+"""Request forwarding (lib/request-proxy/ rebuilt).
+
+Client side: serialize a request's routing envelope — url, method, headers,
+keys, and the local membership checksum — and send it to the key's owner
+over ``/proxy/req`` (lib/request-proxy/send.js:230-307, util.js:22-35).
+Failures retry on the reference's schedule (0 s, 1 s, 3.5 s,
+send.js:49) after **re-looking-up the keys**: if the ring moved, the retry
+reroutes to the new owner (send.js:181-208); if the keys now map to more
+than one owner, the retry aborts with a keys-diverged error
+(send.js:91-104); if the new owner is the local node, the request is
+handled in-process (send.js:190-198).
+
+Server side: rebuild the request, reject on membership-checksum mismatch
+when ``enforceConsistency`` (lib/request-proxy/index.js:168-229), and emit
+``request`` to the application.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Dict, List, Optional
+
+from ringpop_tpu.net.channel import ChannelError, RemoteError
+from ringpop_tpu.utils import errors
+
+RETRY_SCHEDULE_S = [0.0, 1.0, 3.5]  # send.js:49
+DEFAULT_MAX_RETRIES = 3
+
+
+class LocalResponse:
+    """Response collector handed to 'request' handlers: call ``end(body)``
+    (optionally ``status``) exactly once."""
+
+    def __init__(self):
+        self._event = threading.Event()
+        self.status = 200
+        self.body = None
+        self.headers: Dict[str, Any] = {}
+
+    def end(self, body: Any = None, status: int = 200, headers=None) -> None:
+        self.body = body
+        self.status = status
+        if headers:
+            self.headers = dict(headers)
+        self._event.set()
+
+    def wait(self, timeout_s: float):
+        if not self._event.wait(timeout_s):
+            raise ChannelError("request handler timed out", "ringpop-tpu.timeout")
+        return {"statusCode": self.status, "headers": self.headers, "body": self.body}
+
+
+class RequestProxy:
+    def __init__(self, ringpop: Any, opts: Optional[Dict[str, Any]] = None):
+        opts = opts or {}
+        self.ringpop = ringpop
+        self.retry_schedule_s = opts.get("retrySchedule", RETRY_SCHEDULE_S)
+        self.max_retries = opts.get("maxRetries", DEFAULT_MAX_RETRIES)
+        self.enforce_consistency = opts.get("enforceConsistency", True)
+        self.destroyed = False
+
+    # -- client side ------------------------------------------------------
+
+    def proxy_req(self, opts: Dict[str, Any]) -> Dict[str, Any]:
+        """opts: {keys, dest, req: {url, method, headers, body}, timeout?,
+        maxRetries?, endpoint?}.  Returns the remote response dict."""
+        if self.destroyed:
+            raise errors.RequestProxyDestroyedError()
+        keys: List[str] = list(opts["keys"])
+        dest: str = opts["dest"]
+        req = dict(opts.get("req") or {})
+        timeout_s = (opts.get("timeout") or self.ringpop.proxy_req_timeout_ms) / 1000.0
+        max_retries = opts.get("maxRetries", self.max_retries)
+        endpoint = opts.get("endpoint", "/proxy/req")
+
+        self.ringpop.stat("increment", "requestProxy.requests.outgoing")
+        attempt = 0
+        while True:
+            head = {
+                "url": req.get("url"),
+                "method": req.get("method", "GET"),
+                "headers": req.get("headers") or {},
+                "httpVersion": req.get("httpVersion", "1.1"),
+                "ringpopChecksum": self.ringpop.membership.checksum,
+                "ringpopKeys": keys,
+            }
+            try:
+                _, res = self.ringpop.channel.request(
+                    dest, endpoint, head=head, body=req.get("body"),
+                    timeout_s=timeout_s,
+                )
+                return res
+            except (ChannelError, RemoteError) as e:
+                if isinstance(e, RemoteError):
+                    payload = e.payload or {}
+                    # checksum mismatches are retryable (ring may converge);
+                    # other application errors are not
+                    if payload.get("type") != errors.InvalidCheckSumError.type:
+                        raise
+                if attempt >= max_retries:
+                    self.ringpop.stat(
+                        "increment", "requestProxy.retry.failed"
+                    )
+                    raise errors.MaxRetriesExceededError(maxRetries=max_retries)
+                delay = self.retry_schedule_s[
+                    min(attempt, len(self.retry_schedule_s) - 1)
+                ]
+                self.ringpop.stat("increment", "requestProxy.retry.attempted")
+                self.ringpop.timers.sleep(delay)
+                attempt += 1
+                dest = self._relookup(keys, dest)
+                if dest == self.ringpop.whoami():
+                    # reroute local (send.js:190-198)
+                    self.ringpop.stat(
+                        "increment", "requestProxy.retry.reroute.local"
+                    )
+                    return self._handle_locally(head, req.get("body"))
+                self.ringpop.stat(
+                    "increment", "requestProxy.retry.reroute.remote"
+                )
+
+    def _relookup(self, keys: List[str], orig_dest: str) -> str:
+        dests = {self.ringpop.lookup(k) for k in keys}
+        if len(dests) > 1:
+            self.ringpop.stat("increment", "requestProxy.retry.aborted")
+            raise errors.KeysDivergedError(
+                keys=keys, origDestination=orig_dest,
+                newDestinations=sorted(dests),
+            )
+        return next(iter(dests))
+
+    def _handle_locally(self, head: Dict[str, Any], body: Any) -> Dict[str, Any]:
+        req = {
+            "url": head.get("url"),
+            "method": head.get("method"),
+            "headers": head.get("headers"),
+            "httpVersion": head.get("httpVersion"),
+            "body": body,
+            "ringpopKeys": head.get("ringpopKeys"),
+        }
+        res = LocalResponse()
+        self.ringpop.emit("request", req, res, head)
+        return res.wait(self.ringpop.proxy_req_timeout_ms / 1000.0)
+
+    # -- server side ------------------------------------------------------
+
+    def handle_request(self, head: Dict[str, Any], body: Any) -> Dict[str, Any]:
+        """The ``/proxy/req`` receive path (request-proxy/index.js:168-229)."""
+        self.ringpop.stat("increment", "requestProxy.requests.incoming")
+        expected = head.get("ringpopChecksum")
+        if self.enforce_consistency and expected != self.ringpop.membership.checksum:
+            self.ringpop.stat("increment", "requestProxy.checksumsDiffer")
+            self.ringpop.logger.warning(
+                "ringpop request proxy checksums differ",
+                extra={
+                    "local": self.ringpop.whoami(),
+                    "expected": expected,
+                    "actual": self.ringpop.membership.checksum,
+                },
+            )
+            raise errors.InvalidCheckSumError(
+                expected=expected, actual=self.ringpop.membership.checksum
+            )
+        return self._handle_locally(head, body)
+
+    def destroy(self) -> None:
+        self.destroyed = True

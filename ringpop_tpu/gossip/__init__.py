@@ -1,0 +1,9 @@
+"""Host-side SWIM gossip engine: protocol-period loop, piggyback
+dissemination, suspicion subprotocol, and the ping / ping-req / join senders
+over the framed JSON channel."""
+
+from ringpop_tpu.gossip.dissemination import Dissemination
+from ringpop_tpu.gossip.gossip import Gossip
+from ringpop_tpu.gossip.suspicion import Suspicion
+
+__all__ = ["Dissemination", "Gossip", "Suspicion"]

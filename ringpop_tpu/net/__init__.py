@@ -1,0 +1,8 @@
+"""Host-side networking: the framed JSON-over-TCP channel (the framework's
+transport layer, playing the role TChannel plays for the reference) and the
+timer service behind gossip/suspicion/proxy scheduling."""
+
+from ringpop_tpu.net.channel import Channel, ChannelError, RemoteError
+from ringpop_tpu.net.timers import FakeTimers, Timers
+
+__all__ = ["Channel", "ChannelError", "RemoteError", "Timers", "FakeTimers"]

@@ -1,0 +1,123 @@
+"""Framed JSON channel: request/response, errors, timeouts, concurrency."""
+
+import threading
+import time
+
+import pytest
+
+from ringpop_tpu.net import Channel, ChannelError, RemoteError
+from ringpop_tpu.net.timers import FakeTimers
+
+
+@pytest.fixture
+def pair():
+    a, b = Channel("127.0.0.1:0"), Channel("127.0.0.1:0")
+    a.listen()
+    b.listen()
+    yield a, b
+    a.destroy()
+    b.destroy()
+
+
+def test_request_response(pair):
+    a, b = pair
+    b.register("/echo", lambda head, body: ({"h": head}, {"b": body}))
+    head, body = a.request(b.host_port, "/echo", "hi", [1, 2], timeout_s=2)
+    assert head == {"h": "hi"}
+    assert body == {"b": [1, 2]}
+
+
+def test_remote_error(pair):
+    a, b = pair
+
+    def boom(head, body):
+        raise RemoteError({"type": "ringpop-tpu.test", "message": "nope"})
+
+    b.register("/boom", boom)
+    with pytest.raises(RemoteError) as e:
+        a.request(b.host_port, "/boom", timeout_s=2)
+    assert e.value.payload["type"] == "ringpop-tpu.test"
+
+
+def test_unknown_endpoint(pair):
+    a, b = pair
+    with pytest.raises(RemoteError) as e:
+        a.request(b.host_port, "/nope", timeout_s=2)
+    assert e.value.payload["type"] == "ringpop-tpu.bad-endpoint"
+
+
+def test_connect_failure():
+    a = Channel("127.0.0.1:0")
+    a.listen()
+    try:
+        with pytest.raises(ChannelError):
+            a.request("127.0.0.1:1", "/x", timeout_s=2)
+    finally:
+        a.destroy()
+
+
+def test_timeout(pair):
+    a, b = pair
+    release = threading.Event()
+
+    def slow(head, body):
+        release.wait(5)
+        return None, None
+
+    b.register("/slow", slow)
+    t0 = time.time()
+    with pytest.raises(ChannelError) as e:
+        a.request(b.host_port, "/slow", timeout_s=0.2)
+    assert e.value.type == "ringpop-tpu.timeout"
+    assert time.time() - t0 < 2
+    release.set()
+
+
+def test_concurrent_out_of_order(pair):
+    a, b = pair
+    gate = threading.Event()
+
+    def first(head, body):
+        gate.wait(5)
+        return None, "first"
+
+    def second(head, body):
+        return None, "second"
+
+    b.register("/first", first)
+    b.register("/second", second)
+    results = {}
+
+    def call(ep):
+        results[ep] = a.request(b.host_port, ep, timeout_s=5)[1]
+
+    t1 = threading.Thread(target=call, args=("/first",))
+    t1.start()
+    time.sleep(0.05)
+    call("/second")  # completes while /first is parked
+    assert results == {"/second": "second"}
+    gate.set()
+    t1.join(5)
+    assert results["/first"] == "first"
+
+
+def test_bidirectional_over_shared_socket(pair):
+    a, b = pair
+    a.register("/ping-back", lambda h, body: (None, body + 1))
+    b.register("/fwd", lambda h, body: (None, body * 2))
+    assert a.request(b.host_port, "/fwd", None, 21, timeout_s=2)[1] == 42
+    assert b.request(a.host_port, "/ping-back", None, 1, timeout_s=2)[1] == 2
+
+
+def test_fake_timers_ordering():
+    ft = FakeTimers()
+    fired = []
+    ft.set_timeout(lambda: fired.append("b"), 2.0)
+    h = ft.set_timeout(lambda: fired.append("a"), 1.0)
+    ft.set_timeout(lambda: fired.append("c"), 3.0)
+    ft.clear_timeout(h)
+    assert ft.advance(2.5) == 1
+    assert fired == ["b"]
+    ft.advance(1.0)
+    assert fired == ["b", "c"]
+    assert ft.now_ms() > 1414142122274
