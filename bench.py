@@ -9,26 +9,50 @@ single compiled lax.scan.  Checksums use the fast commutative record-hash
 mode (checksum_mode="fast"), which has the same equality semantics as the
 reference's FarmHash32 string checksum but not its bit pattern; bit-exact
 FarmHash32 checksums are the parity mode (checksum_mode="farmhash"),
-exercised by the parity tests, at roughly 15x the per-tick cost.
+exercised by the parity tests.
 
 Baseline: the reference (ringpop-node) runs clusters in real time with a
 200 ms minimum protocol period (lib/gossip/index.js:194-196), i.e. a 1k-node
 cluster advances at most 1000 x 5 = 5000 node-protocol-periods per second of
 wall clock, using 1k OS processes.  ``vs_baseline`` is our rate divided by
 that real-time rate on a single TPU chip.
+
+Robustness: the TPU tunnel in this image is occasionally held by another
+client at backend-init time (round-1 failure: rc=1, "Unable to initialize
+backend 'axon'").  The bench retries backend init / first compile with
+backoff before giving up, and always emits a structured JSON line — with an
+"error" field on terminal failure — so the round artifact is parseable
+either way.
 """
 
 import json
 import os
 import sys
 import time
+import traceback
 
 import numpy as np
 
+RETRIES = int(os.environ.get("BENCH_RETRIES", "10"))
+RETRY_SLEEP_S = float(os.environ.get("BENCH_RETRY_SLEEP_S", "30"))
 
-def main() -> None:
-    n = int(os.environ.get("BENCH_N", "1024"))
-    ticks = int(os.environ.get("BENCH_TICKS", "32"))
+# Transient TPU-tunnel / backend failures worth retrying; anything else
+# (shape errors, engine bugs) fails fast.
+_TRANSIENT_MARKERS = (
+    "Unable to initialize backend",
+    "UNAVAILABLE",
+    "DEADLINE_EXCEEDED",
+    "RESOURCE_EXHAUSTED",
+    "ABORTED",
+)
+
+
+def _is_transient(exc: BaseException) -> bool:
+    return any(m in str(exc) for m in _TRANSIENT_MARKERS)
+
+
+def _measure(n: int, ticks: int) -> dict:
+    import jax
 
     from ringpop_tpu.models.sim import engine
     from ringpop_tpu.models.sim.cluster import EventSchedule, SimCluster
@@ -38,8 +62,6 @@ def main() -> None:
 
     sched = EventSchedule(ticks=ticks, n=n)
     sim.run(sched)  # compile + warm
-    import jax
-
     jax.block_until_ready(sim.state)
 
     t0 = time.perf_counter()
@@ -49,7 +71,7 @@ def main() -> None:
 
     node_ticks_per_sec = n * ticks / elapsed
     baseline = n * 5.0  # real-time reference: 5 protocol periods/s/node
-    result = {
+    return {
         "metric": "swim_node_protocol_periods_per_sec_1k",
         "value": round(node_ticks_per_sec, 1),
         "unit": "node-ticks/s",
@@ -60,7 +82,51 @@ def main() -> None:
         "converged": bool(np.asarray(metrics.converged)[-1]),
         "platform": jax.devices()[0].platform,
     }
-    print(json.dumps(result))
+
+
+def _clear_backends() -> None:
+    try:
+        from jax.extend import backend as jeb
+
+        jeb.clear_backends()
+    except Exception:
+        pass
+
+
+def main() -> int:
+    n = int(os.environ.get("BENCH_N", "1024"))
+    ticks = int(os.environ.get("BENCH_TICKS", "32"))
+
+    last_err = None
+    for attempt in range(RETRIES):
+        try:
+            result = _measure(n, ticks)
+            result["attempts"] = attempt + 1
+            print(json.dumps(result))
+            return 0
+        except Exception as exc:  # backend init / transient compile errors
+            last_err = exc
+            if not _is_transient(exc):
+                break
+            _clear_backends()
+            if attempt + 1 < RETRIES:
+                time.sleep(RETRY_SLEEP_S)
+
+    print(
+        json.dumps(
+            {
+                "metric": "swim_node_protocol_periods_per_sec_1k",
+                "value": 0.0,
+                "unit": "node-ticks/s",
+                "vs_baseline": 0.0,
+                "error": "%s: %s"
+                % (type(last_err).__name__, str(last_err)[:400]),
+                "attempts": RETRIES,
+            }
+        )
+    )
+    traceback.print_exception(last_err, file=sys.stderr)
+    return 1
 
 
 if __name__ == "__main__":
