@@ -85,12 +85,9 @@ def _measure(n: int, ticks: int) -> dict:
 
 
 def _clear_backends() -> None:
-    try:
-        from jax.extend import backend as jeb
+    from ringpop_tpu.utils.util import clear_jax_backends
 
-        jeb.clear_backends()
-    except Exception:
-        pass
+    clear_jax_backends()
 
 
 def main() -> int:
@@ -98,10 +95,12 @@ def main() -> int:
     ticks = int(os.environ.get("BENCH_TICKS", "32"))
 
     last_err = None
-    for attempt in range(RETRIES):
+    attempts_made = 0
+    for attempt in range(max(1, RETRIES)):
+        attempts_made = attempt + 1
         try:
             result = _measure(n, ticks)
-            result["attempts"] = attempt + 1
+            result["attempts"] = attempts_made
             print(json.dumps(result))
             return 0
         except Exception as exc:  # backend init / transient compile errors
@@ -121,7 +120,7 @@ def main() -> int:
                 "vs_baseline": 0.0,
                 "error": "%s: %s"
                 % (type(last_err).__name__, str(last_err)[:400]),
-                "attempts": RETRIES,
+                "attempts": attempts_made,
             }
         )
     )

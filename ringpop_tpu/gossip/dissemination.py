@@ -84,10 +84,15 @@ class Dissemination(EventEmitter):
         differ.  Returns (changes, did_full_sync)."""
 
         def keep(change: Dict[str, Any]) -> bool:
-            # filter changes the requester originated (dissemination.js:91-98)
+            # filter changes the requester originated; all four fields must
+            # be truthy before the comparison fires (dissemination.js:90-97)
             return not (
-                change.get("source") == sender_addr
+                sender_addr
+                and sender_incarnation_number
+                and change.get("source")
                 and change.get("sourceIncarnationNumber")
+                and change["source"] == sender_addr
+                and change["sourceIncarnationNumber"]
                 == sender_incarnation_number
             )
 
@@ -114,13 +119,16 @@ class Dissemination(EventEmitter):
         issued = []
         for address in list(self.changes.keys()):
             change = self.changes[address]
+            # receiver-origin filter runs BEFORE the piggyback bump, so
+            # filtered changes don't burn budget (dissemination.js:147-160)
+            if keep is not None and not keep(change):
+                self.ringpop.stat("increment", "filtered-change")
+                continue
             # bump regardless of eventual send success (reference TODO quirk,
             # dissemination.js:142-155)
             change["piggybackCount"] += 1
             if change["piggybackCount"] > self.max_piggyback_count:
                 del self.changes[address]
-                continue
-            if keep is not None and not keep(change):
                 continue
             issued.append(
                 {

@@ -35,17 +35,19 @@ class Suspicion:
         if address in self.timers:
             self.stop(member)
 
+        # capture the incarnation from the update that started this suspect
+        # period; a concurrently-bumped incarnation must ride out a fresh
+        # period before escalation (suspicion.js:67-70 closure semantics)
+        if isinstance(member, dict):
+            inc = member.get("incarnationNumber")
+        else:
+            inc = getattr(member, "incarnation_number", None)
+
         def expire():
             self.timers.pop(address, None)
             self.ringpop.logger.info(
                 "ringpop member declares member faulty",
                 extra={"local": self.ringpop.whoami(), "faulty": address},
-            )
-            current = self.ringpop.membership.find_member_by_address(address)
-            inc = (
-                current.incarnation_number
-                if current is not None
-                else getattr(member, "incarnation_number", None)
             )
             self.ringpop.membership.make_faulty(address, inc)
 

@@ -62,3 +62,19 @@ def null_logger() -> logging.Logger:
         logger.addHandler(logging.NullHandler())
     logger.propagate = False
     return logger
+
+
+def clear_jax_backends() -> None:
+    """Drop any live JAX backends so platform config can be re-pinned.
+
+    Shared by the driver entry points (bench retry after transient TPU-tunnel
+    failures; multichip dryrun re-pinning onto virtual CPU devices after this
+    image's sitecustomize pre-registers the ``axon`` TPU platform).  The
+    except-guard tolerates the API moving across jax versions.
+    """
+    try:
+        from jax.extend import backend as jeb
+
+        jeb.clear_backends()
+    except Exception:
+        pass

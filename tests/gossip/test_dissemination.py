@@ -1,0 +1,137 @@
+"""Dissemination piggyback-buffer semantics (vs lib/gossip/dissemination.js).
+
+Regression focus: the receiver-origin filter must run BEFORE the piggyback
+bump (dissemination.js:147-160) so changes the requester originated don't
+burn dissemination budget, and the filter only fires when all four of
+sender address/incarnation and change source/sourceIncarnationNumber are
+truthy (dissemination.js:90-97).
+"""
+
+from ringpop_tpu.gossip.dissemination import Dissemination
+
+LOCAL = "127.0.0.1:3000"
+PEER = "127.0.0.1:3001"
+
+
+class StubRing:
+    def __init__(self, count=3):
+        self.count = count
+
+    def get_server_count(self):
+        return self.count
+
+
+class StubMembership:
+    def __init__(self):
+        self.checksum = 12345
+        self.members = []
+
+
+class StubRingpop:
+    def __init__(self):
+        self.ring = StubRing()
+        self.membership = StubMembership()
+        self.stats = []
+
+        class _Log:
+            def info(self, *a, **k):
+                pass
+
+            debug = warning = error = info
+
+        self.logger = _Log()
+
+    def whoami(self):
+        return LOCAL
+
+    def stat(self, type_, key, value=None):
+        self.stats.append((type_, key, value))
+
+
+def change(addr=PEER, source=LOCAL, source_inc=1414142122274):
+    return {
+        "id": "id-1",
+        "source": source,
+        "sourceIncarnationNumber": source_inc,
+        "address": addr,
+        "status": "alive",
+        "incarnationNumber": 1414142122274,
+    }
+
+
+def test_issue_as_sender_bumps_and_expires():
+    d = Dissemination(StubRingpop())
+    d.max_piggyback_count = 2
+    d.record_change(change())
+    assert len(d.issue_as_sender()) == 1
+    assert len(d.issue_as_sender()) == 1
+    # third issue exceeds the max: dropped from the buffer, not issued
+    assert d.issue_as_sender() == []
+    assert d.get_change_count() == 0
+
+
+def test_receiver_origin_filter_does_not_consume_budget():
+    d = Dissemination(StubRingpop())
+    d.max_piggyback_count = 2
+    origin_inc = 999
+    d.record_change(change(source=PEER, source_inc=origin_inc))
+    # the originating peer pings us many times: always filtered, and the
+    # filtered issues must not bump piggybackCount toward expiry
+    for _ in range(10):
+        changes, full_sync = d.issue_as_receiver(PEER, origin_inc, 12345)
+        assert changes == []
+        assert not full_sync
+    assert d.get_change_count() == 1
+    # a different receiver still gets the change afterwards
+    changes, _ = d.issue_as_receiver("127.0.0.1:3002", 5, 12345)
+    assert [c["address"] for c in changes] == [PEER]
+
+
+def test_filtered_change_stat_incremented():
+    rp = StubRingpop()
+    d = Dissemination(rp)
+    d.record_change(change(source=PEER, source_inc=7))
+    d.issue_as_receiver(PEER, 7, rp.membership.checksum)
+    assert ("increment", "filtered-change", None) in rp.stats
+
+
+def test_filter_requires_all_fields_truthy():
+    # sourceIncarnationNumber None/0 on both sides must NOT trigger the
+    # filter (reference truthiness guard, dissemination.js:90-97)
+    d = Dissemination(StubRingpop())
+    d.record_change(change(source=PEER, source_inc=None))
+    changes, _ = d.issue_as_receiver(PEER, None, 12345)
+    assert len(changes) == 1
+
+    d2 = Dissemination(StubRingpop())
+    d2.record_change(change(source=PEER, source_inc=7))
+    # sender matches on address but not incarnation: issued
+    changes, _ = d2.issue_as_receiver(PEER, 8, 12345)
+    assert len(changes) == 1
+
+
+def test_full_sync_on_checksum_mismatch_when_empty():
+    rp = StubRingpop()
+
+    class M:
+        address = PEER
+        status = "alive"
+        incarnation_number = 1
+
+    rp.membership.members = [M()]
+    d = Dissemination(rp)
+    changes, full_sync = d.issue_as_receiver(PEER, 1, rp.membership.checksum + 1)
+    assert full_sync and len(changes) == 1
+    changes, full_sync = d.issue_as_receiver(PEER, 1, rp.membership.checksum)
+    assert changes == [] and not full_sync
+
+
+def test_max_piggyback_scales_with_server_count():
+    rp = StubRingpop()
+    d = Dissemination(rp)
+    rp.ring.count = 9  # ceil(log10(10)) = 1
+    d.adjust_max_piggyback_count()
+    assert d.max_piggyback_count == 15
+    rp.ring.count = 1000  # ceil(log10(1001)) = 4... log10(1001)≈3.0004 → 4
+    d.adjust_max_piggyback_count()
+    assert d.max_piggyback_count == 60

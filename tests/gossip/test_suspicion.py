@@ -1,0 +1,98 @@
+"""Suspicion subprotocol timers (vs lib/gossip/suspicion.js).
+
+Regression focus: the faulty declaration at expiry must use the incarnation
+captured from the update that STARTED the suspect period (suspicion.js:67-70
+closure semantics) — a concurrently bumped incarnation must survive and ride
+out a fresh period.
+"""
+
+from ringpop_tpu.gossip.suspicion import Suspicion
+from ringpop_tpu.net.timers import FakeTimers
+
+LOCAL = "127.0.0.1:3000"
+SUSPECT = "127.0.0.1:3001"
+
+
+class StubMembership:
+    def __init__(self):
+        self.faulty_calls = []
+
+    def make_faulty(self, address, incarnation_number):
+        self.faulty_calls.append((address, incarnation_number))
+
+
+class StubRingpop:
+    def __init__(self):
+        self.membership = StubMembership()
+        self.timers = FakeTimers()
+
+        class _Log:
+            def info(self, *a, **k):
+                pass
+
+            debug = warning = error = info
+
+        self.logger = _Log()
+
+    def whoami(self):
+        return LOCAL
+
+
+def update(addr=SUSPECT, inc=100):
+    return {"address": addr, "status": "suspect", "incarnationNumber": inc}
+
+
+def test_expiry_declares_faulty_with_started_incarnation():
+    rp = StubRingpop()
+    s = Suspicion(rp)
+    s.start(update(inc=100))
+    rp.timers.advance(5.0)
+    assert rp.membership.faulty_calls == [(SUSPECT, 100)]
+
+
+def test_restart_uses_fresh_incarnation_and_resets_clock():
+    rp = StubRingpop()
+    s = Suspicion(rp)
+    s.start(update(inc=100))
+    rp.timers.advance(3.0)
+    # refuted-then-resuspected with a newer incarnation: old timer cancelled,
+    # a full fresh period must elapse before faulty, with the new incarnation
+    s.start(update(inc=200))
+    rp.timers.advance(3.0)  # 6s since first start, 3s since restart
+    assert rp.membership.faulty_calls == []
+    rp.timers.advance(2.5)
+    assert rp.membership.faulty_calls == [(SUSPECT, 200)]
+
+
+def test_never_for_local_member():
+    rp = StubRingpop()
+    s = Suspicion(rp)
+    s.start(update(addr=LOCAL))
+    rp.timers.advance(10.0)
+    assert rp.membership.faulty_calls == []
+
+
+def test_stop_all_and_reenable():
+    rp = StubRingpop()
+    s = Suspicion(rp)
+    s.start(update())
+    s.stop_all()
+    rp.timers.advance(10.0)
+    assert rp.membership.faulty_calls == []
+    # while stopped, new periods cannot start
+    s.start(update())
+    rp.timers.advance(10.0)
+    assert rp.membership.faulty_calls == []
+    s.reenable()
+    s.start(update(inc=300))
+    rp.timers.advance(5.0)
+    assert rp.membership.faulty_calls == [(SUSPECT, 300)]
+
+
+def test_stop_single_member():
+    rp = StubRingpop()
+    s = Suspicion(rp)
+    s.start(update())
+    s.stop(update())
+    rp.timers.advance(10.0)
+    assert rp.membership.faulty_calls == []

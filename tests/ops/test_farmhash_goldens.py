@@ -1,0 +1,148 @@
+"""Externally sourced FarmHash32 golden vectors.
+
+Round-1 weakness: all four in-repo FarmHash implementations were written by
+the same hand from the same reading of the algorithm, so a shared
+misreading would pass every cross-check.  These goldens break that cycle:
+each ``(input, hash)`` pair below was produced by Google's own compiled
+``farmhashmk::Hash32`` (the symbol ``_ZN10farmhashmk6Hash32EPKcm`` exported
+by tensorflow's bundled ``libtensorflow_framework.so``, built from the
+upstream https://github.com/google/farmhash source) — the same farmhashmk
+algorithm the npm ``farmhash@0.2`` addon dispatches to on machines without
+SSE4.1/AESNI, i.e. the hash the reference calls at lib/ring/index.js:21 and
+lib/membership/index.js:24.
+
+When the tensorflow library is present we additionally fuzz live against it
+(1k random strings across every length class); when absent, the hardcoded
+vectors still pin every branch of the algorithm (0-4, 5-12, 13-24, one
+block, multi-block, >255, >1024).
+"""
+
+import ctypes
+import glob
+import random
+
+import numpy as np
+import pytest
+
+from ringpop_tpu.ops import farmhash32 as fh
+from ringpop_tpu.ops import native
+
+# (input bytes, farmhashmk::Hash32) — generated once from Google's compiled
+# library; see module docstring.  Inputs cover every length-class branch and
+# the address / checksum-string shapes ringpop actually hashes.
+GOLDENS = [
+    (b"", 0xDC56D17A),
+    (b"a", 0x3C973D4D),
+    (b"ab", 0x417330FD),
+    (b"abc", 0x2F635EC7),
+    (b"abcd", 0x98B51E95),
+    (b"abcde", 0xA3F366AC),
+    (b"hello world", 0x19A7581A),
+    (b"127.0.0.1:3000", 0x38F33445),
+    (b"127.0.0.1:300000", 0x27D3A8AD),
+    (b"10.30.8.26:20600", 0x9DD564C9),
+    (b"127.0.0.1:3000;alive;1470000000000", 0xF59B50DB),
+    (
+        b"10.0.0.1:3000;suspect;1470000000001;"
+        b"10.0.0.2:3000;alive;1470000000002",
+        0x8F288648,
+    ),
+    (bytes(range(25)), 0x2B1014AD),
+    (bytes(range(48)), 0x40B54C18),
+    (bytes(range(97)), 0x23C004E8),
+    (b"x" * 13, 0xA4128D93),
+    (b"x" * 24, 0x90B1E609),
+    (b"x" * 64, 0x6CC6B60B),
+    (b"q" * 255, 0x2AB28F77),
+    (b"m" * 1024, 0x7E656A8D),
+    (b'X. ', 0xF45214D9),
+    (b'+j$ux*,', 0x45B013D2),
+    (b'M>"#"Lro]n[', 0xBED68CE6),
+    (b'3+7{.!`^?(ue[(l', 0xED160416),
+    (b'v+aj%Bg(rF]MB?s9Zcu', 0x43D55ED7),
+    (b'"a) J2z\\tP5&)k_4)g;2#L.', 0x4C0194A2),
+    (b'c2uGZ%UCt%6B3F3[%hQL_Kj[\\%\\', 0x14A33C88),
+    (b'l5X}bXEC/7UW/c-^Pt@r8L-yy4jB3|I', 0x849E41F0),
+    (b"Y|)*R;&D$<`+yHGZ(j@)xV9,R8zZ`>N:ayU6j:F'", 0x0DD27E93),
+    (
+        b"Md3_f\\J10&o52e({I5 uv'q+2;%WR~I:vPCdpFVHwi3d+ACTShCc.yP",
+        0x2463174E,
+    ),
+    (
+        b'C;F{kR&LX=^5PG )]RFVw]7Sp]4DkOslL:5bhZu\\t#|[t-#N\\(1kJLEFwwjJhEh8'
+        b'aC)dxm:KaJIZB*ck',
+        0x6EF24F78,
+    ),
+    (
+        b'jf/?@O1#R$u%:u3HbMWa(GAy^j<L`*s"wjJh=4]_wv1doo(2d?x5``xRI0zghdnl'
+        b'Y%O(OvT%mn)H=o9LbxPk_&#Y*EVK2^vs>x#~MkOU6)q";9mof}2`0v@s&l[Nl}OD'
+        b'R',
+        0x98AC21E6,
+    ),
+]
+
+
+def _tf_farmhashmk():
+    """ctypes handle to Google's compiled farmhashmk::Hash32, if present."""
+    pats = [
+        "/opt/venv/lib/python*/site-packages/tensorflow/"
+        "libtensorflow_framework.so*",
+        "/usr/lib/python*/site-packages/tensorflow/"
+        "libtensorflow_framework.so*",
+    ]
+    for pat in pats:
+        for path in sorted(glob.glob(pat)):
+            try:
+                lib = ctypes.CDLL(path)
+                fn = getattr(lib, "_ZN10farmhashmk6Hash32EPKcm")
+                fn.restype = ctypes.c_uint32
+                fn.argtypes = [ctypes.c_char_p, ctypes.c_size_t]
+                if fn(b"", 0) == 0xDC56D17A:
+                    return fn
+            except (OSError, AttributeError):
+                continue
+    return None
+
+
+def test_scalar_matches_goldens():
+    for s, want in GOLDENS:
+        assert fh.hash32(s) == want, (s[:40], hex(fh.hash32(s)), hex(want))
+
+
+def test_numpy_batch_matches_goldens():
+    strs = [s for s, _ in GOLDENS]
+    mat, lens = fh.encode_rows(strs)
+    got = fh.hash32_batch(mat, lens)
+    want = np.array([h for _, h in GOLDENS], dtype=np.uint32)
+    np.testing.assert_array_equal(got, want)
+
+
+@pytest.mark.skipif(not native.available(), reason="native toolchain unavailable")
+def test_native_matches_goldens():
+    for s, want in GOLDENS:
+        assert native.hash32(s) == want, s[:40]
+
+
+def test_jax_matches_goldens():
+    from ringpop_tpu.ops import jax_farmhash
+
+    got = jax_farmhash.hash32_strings_device([s for s, _ in GOLDENS])
+    want = np.array([h for _, h in GOLDENS], dtype=np.uint32)
+    np.testing.assert_array_equal(got.astype(np.uint32), want)
+
+
+def test_live_fuzz_against_google_library():
+    oracle = _tf_farmhashmk()
+    if oracle is None:
+        pytest.skip("tensorflow farmhashmk library not present")
+    rng = random.Random(0x60061E)
+    strs = []
+    for n in list(range(0, 80)) + [100, 128, 200, 255, 256, 333, 1000, 2048]:
+        for _ in range(12 if n < 80 else 3):
+            strs.append(bytes(rng.randrange(256) for _ in range(n)))
+    mat, lens = fh.encode_rows(strs)
+    batch = fh.hash32_batch(mat, lens)
+    for i, s in enumerate(strs):
+        want = oracle(s, len(s))
+        assert fh.hash32(s) == want, (len(s), s[:24])
+        assert int(batch[i]) == want, (len(s), s[:24])
