@@ -84,12 +84,6 @@ def _measure(n: int, ticks: int) -> dict:
     }
 
 
-def _clear_backends() -> None:
-    from ringpop_tpu.utils.util import clear_jax_backends
-
-    clear_jax_backends()
-
-
 def main() -> int:
     n = int(os.environ.get("BENCH_N", "1024"))
     ticks = int(os.environ.get("BENCH_TICKS", "32"))
@@ -107,7 +101,9 @@ def main() -> int:
             last_err = exc
             if not _is_transient(exc):
                 break
-            _clear_backends()
+            from ringpop_tpu.utils.util import clear_jax_backends
+
+            clear_jax_backends()
             if attempt + 1 < RETRIES:
                 time.sleep(RETRY_SLEEP_S)
 

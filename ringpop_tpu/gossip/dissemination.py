@@ -99,10 +99,10 @@ class Dissemination(EventEmitter):
         changes = self._issue_changes(keep)
         if changes:
             return changes, False
-        if (
-            sender_checksum is not None
-            and self.ringpop.membership.checksum != sender_checksum
-        ):
+        # a missing sender checksum still counts as a mismatch — the JS
+        # `checksum !== senderChecksum` is true for undefined
+        # (dissemination.js:101-114)
+        if self.ringpop.membership.checksum != sender_checksum:
             self.ringpop.stat("increment", "full-sync")
             self.ringpop.logger.info(
                 "ringpop dissemination full sync",

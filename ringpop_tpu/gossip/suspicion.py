@@ -1,7 +1,9 @@
 """Suspicion subprotocol (lib/gossip/suspicion.js rebuilt).
 
-A suspect member gets a 5-second clock; on expiry it is declared faulty with
-its current incarnation number (suspicion.js:58-76).  Timers never run for
+A suspect member gets a 5-second clock; on expiry it is declared faulty
+with the incarnation number captured from the update that started the
+suspect period (suspicion.js:58-76 closure semantics) — a concurrently
+bumped incarnation must ride out a fresh period.  Timers never run for
 the local member, stop wholesale when the node leaves, and re-enable on
 rejoin (suspicion.js:31-44,88-109).
 """

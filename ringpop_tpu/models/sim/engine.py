@@ -537,12 +537,26 @@ def tick(
         )
     )
 
-    # receiver-side piggyback bump: one issueAsReceiver per delivered ping
+    # receiver-side piggyback bump: one issueAsReceiver per delivered ping.
+    # The receiver-origin filter runs BEFORE the bump (dissemination.js:
+    # 147-160), so a change does not burn budget on pings from the sender
+    # that originated it.  A change has exactly one recorded origin, hence
+    # at most one of this tick's pinging senders can be filtered for it.
     nrecv = jax.ops.segment_sum(
         delivered.astype(jnp.int32), seg, num_segments=n + 1
     )[:n]
+    diag_inc_5 = state.inc[jnp.arange(n), jnp.arange(n)]
+    src_c = jnp.clip(state.ch_source, 0, n - 1)
+    origin_hit = (
+        state.ch_active
+        & (state.ch_source >= 0)
+        & delivered[src_c]
+        & (target[src_c] == node)
+        & (state.ch_source_inc == diag_inc_5[src_c])
+    )
     bump_r = (nrecv[:, None] > 0) & state.ch_active
-    ch_pb = state.ch_pb + jnp.where(bump_r, nrecv[:, None], 0)
+    nbump = jnp.where(bump_r, nrecv[:, None] - origin_hit.astype(jnp.int32), 0)
+    ch_pb = state.ch_pb + nbump
     over_r = state.ch_active & (ch_pb > max_pb[:, None])
     respondable = bump_r & ~over_r
     state = state._replace(ch_pb=ch_pb, ch_active=state.ch_active & ~over_r)

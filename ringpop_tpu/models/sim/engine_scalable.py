@@ -48,18 +48,36 @@ scatter conflicts, no segment reductions — the memory-bandwidth-bound shape
 TPUs like.  Deviation envelope vs the reference's per-node round-robin
 iterator is documented in SURVEY.md §7 (hard parts 4 and 6).
 
-Failure detection: a node whose direct partner's process is down publishes
-(joins) this tick's suspect batch and starts a suspicion clock; after
-``suspicion_ticks`` (5s at 200ms periods, suspicion.js:111-113) a
-still-suspect subject joins the faulty batch.  Revived nodes publish alive
-with a fresh incarnation — the refute/rejoin path (member.js:76-81,
-server/admin/member.js:44-51) — and restart with empty state (the reference
-rebuilds a restarted node entirely via join, server/protocol/join.js:131).
+Failure detection follows the reference's evidence model, not a global
+oracle: a node suspects its direct partner when the direct exchange fails
+(dead process, packet loss, or partition) AND at least one indirect
+ping-req intermediary responded but none reached the target
+(ping-req-sender.js:249-262) — so packet loss and partitions produce
+*false* suspects exactly as in the reference.  After ``suspicion_ticks``
+(5s at 200ms periods, suspicion.js:111-113) a still-suspect subject joins
+the faulty batch.  The counterpart is **refutation** (member.js:76-81): a
+rumor subject is stamped with the slot that defamed it; when a live node
+hears a suspect/faulty rumor naming itself, it publishes a refute-alive
+rumor with a fresh incarnation in the same alive batch that carries
+revive/rejoin (server/admin/member.js:44-51).  Revived nodes restart with
+empty state (the reference rebuilds a restarted node entirely via join,
+server/protocol/join.js:131).
+
+Partition groups gate every exchange (gossip, ping-req probes), so a split
+produces cross-side false suspects and checksum divergence between the
+sides, and healing reconverges to a single all-alive view.  Deviation
+envelope: ``truth_*`` is a single global chain, so a suspected node's
+refute cancels the suspecting side's clocks immediately (the reference
+would let the cut-off side escalate to faulty and merge the views after
+heal).  Exact split-brain bookkeeping — per-observer views with faulty
+marks retained across the split — is the full-fidelity ``[N, N]`` engine's
+domain (:mod:`ringpop_tpu.models.sim.engine`, parity-tested against the
+host oracle including partitions in tests/parity/).
 """
 
 from __future__ import annotations
 
-from typing import NamedTuple
+from typing import NamedTuple, Optional
 
 import jax
 import jax.numpy as jnp
@@ -90,6 +108,7 @@ class ScalableParams(NamedTuple):
 class ScalableState(NamedTuple):
     tick_index: jax.Array  # scalar int32
     proc_alive: jax.Array  # [N] bool — process up (fault plane)
+    partition: jax.Array  # [N] int32 — group id; unequal groups can't talk
     truth_status: jax.Array  # [N] int32 — latest asserted status
     truth_inc: jax.Array  # [N] int64 — latest asserted incarnation
     # batch-rumor table
@@ -101,6 +120,10 @@ class ScalableState(NamedTuple):
     # per-node failure-detection state (single in-flight suspicion per node)
     susp_subject: jax.Array  # [N] int32 — -1 or the suspected node
     susp_since: jax.Array  # [N] int32
+    # slot of the most recent rumor defaming this node (-1 none): the hook
+    # a live node uses to notice it has been called suspect/faulty and
+    # refute (member.js:76-81)
+    defame_slot: jax.Array  # [N] int32
     # commutative checksum base shared by all fully-caught-up nodes
     base_sum: jax.Array  # scalar uint32
     rng: jax.Array  # [2] uint32
@@ -115,14 +138,19 @@ class ScalableMetrics(NamedTuple):
     distinct_checksums: jax.Array
     suspects_published: jax.Array  # subjects newly suspected this tick
     faulties_published: jax.Array
+    refutes_published: jax.Array  # live defamed nodes re-asserting alive
 
 
 class ChurnInputs(NamedTuple):
     kill: jax.Array  # [N] bool
     revive: jax.Array  # [N] bool
+    # [N] int32 group assignment, -1 keeps current; None = no change
+    partition: Optional[jax.Array] = None
 
     @staticmethod
     def quiet(n: int) -> "ChurnInputs":
+        # partition=None (not a dense -1 array) keeps the pytree structure
+        # identical to plain kill/revive inputs — no jit retrace
         return ChurnInputs(kill=jnp.zeros(n, bool), revive=jnp.zeros(n, bool))
 
 
@@ -195,6 +223,7 @@ def init_state(params: ScalableParams, seed: int = 0) -> ScalableState:
     return ScalableState(
         tick_index=jnp.int32(0),
         proc_alive=jnp.ones(n, bool),
+        partition=jnp.zeros(n, jnp.int32),
         truth_status=jnp.zeros(n, jnp.int32),
         truth_inc=inc0,
         r_active=jnp.zeros(u, bool),
@@ -203,6 +232,7 @@ def init_state(params: ScalableParams, seed: int = 0) -> ScalableState:
         heard=jnp.zeros((n, u // WORD), jnp.uint32),
         susp_subject=jnp.full(n, -1, jnp.int32),
         susp_since=jnp.full(n, -1, jnp.int32),
+        defame_slot=jnp.full(n, -1, jnp.int32),
         base_sum=jnp.sum(base, dtype=jnp.uint32),
         rng=jnp.asarray(rng.integers(1, 2**32 - 1, size=2, dtype=np.uint32)),
         checksum=jnp.zeros(n, jnp.uint32),
@@ -283,14 +313,22 @@ def tick(
     # ---- fault plane ---------------------------------------------------
     revived = inputs.revive & ~state.proc_alive
     proc_alive = (state.proc_alive & ~inputs.kill) | inputs.revive
+    if inputs.partition is None:
+        partition = state.partition
+    else:
+        partition = jnp.where(
+            inputs.partition >= 0, inputs.partition, state.partition
+        )
     # a restarted process loses all pre-crash state (the reference rebuilds
     # entirely via join full-sync, server/protocol/join.js:131)
     state = state._replace(
         proc_alive=proc_alive,
+        partition=partition,
         tick_index=t,
         heard=jnp.where(revived[:, None], 0, state.heard),
         susp_subject=jnp.where(revived, -1, state.susp_subject),
         susp_since=jnp.where(revived, -1, state.susp_since),
+        defame_slot=jnp.where(revived, -1, state.defame_slot),
     )
 
     # ---- rumor aging + slot recycling ----------------------------------
@@ -302,7 +340,10 @@ def tick(
     max_age = params.piggyback_factor * digits + params.age_slack
     aged = state.r_active & (t - state.r_birth > max_age)
     # this tick's three deterministic slots are recycled regardless of age
-    slots = (SLOTS_PER_TICK * (t - 1) + jnp.arange(SLOTS_PER_TICK)) % u
+    slots = (
+        (SLOTS_PER_TICK * (t - 1) + jnp.arange(SLOTS_PER_TICK, dtype=jnp.int32))
+        % u
+    ).astype(jnp.int32)
     recycled = jnp.zeros(u, bool).at[slots].set(True)
     retired = aged | (state.r_active & recycled)
     # fold retired deltas into the shared base (dissemination has long
@@ -320,20 +361,33 @@ def tick(
 
     # ---- gossip exchange: push-pull over K random pairings -------------
     k_total = 1 + params.ping_req_size
+    partners = [
+        _perm(rng, n, salt=0xA11CE if k == 0 else 0xA11CE + 7 * k)
+        for k in range(k_total)
+    ]
+    partner0 = partners[0]
+    # one loss outcome per (node, partner-round) message — shared by the
+    # gossip data plane and the failure-detection evidence below, so the
+    # single ping-req round-trip can't be "lost" for detection yet
+    # "delivered" for dissemination
+    losses = [
+        _uniform(rng, (n,), salt=0xB0B0 + k) < params.packet_loss
+        for k in range(k_total)
+    ]
     active_words = _pack_mask(state.r_active)
     new_heard = state.heard
     direct_ok = jnp.zeros(n, bool)
-    partner0 = _perm(rng, n, salt=0xA11CE)
     for k in range(k_total):
-        partner = partner0 if k == 0 else _perm(rng, n, salt=0xA11CE + 7 * k)
-        loss = _uniform(rng, (n,), salt=0xB0B0 + k) < params.packet_loss
-        ok = proc_alive & proc_alive[partner] & ~loss
+        partner = partners[k]
+        loss = losses[k]
+        conn = partition == partition[partner]
+        ok = proc_alive & proc_alive[partner] & conn & ~loss
         if k == 0:
             direct_ok = ok
             use = ok
         else:
             # indirect exchange only for nodes whose direct ping failed
-            use = proc_alive & ~direct_ok & proc_alive[partner] & ~loss
+            use = proc_alive & ~direct_ok & proc_alive[partner] & conn & ~loss
         # pull: i ORs partner's heard set; push: partner ORs i's set.  The
         # push scatter i -> partner[i] is a gather by the inverse
         # permutation (partner is a permutation: no write conflicts).
@@ -357,8 +411,37 @@ def tick(
         susp_subject=jnp.where(cancel, -1, state.susp_subject),
         susp_since=jnp.where(cancel, -1, state.susp_since),
     )
-    tgt_dead = proc_alive & ~proc_alive[partner0]
-    start_susp = tgt_dead & (state.susp_subject != partner0)
+    # Evidence-based SWIM detection (not a liveness oracle): the direct
+    # exchange failed — dead partner, packet loss, OR partition — and the
+    # ping-req fanout's intermediaries answered but none reached the
+    # target (ping-req-sender.js:249-262).  Packet loss / partitions thus
+    # produce FALSE suspects, refuted later like the reference.
+    direct_fail = proc_alive & ~direct_ok & (partner0 != ids)
+    any_responder = jnp.zeros(n, bool)
+    any_reached = jnp.zeros(n, bool)
+    for k in range(1, k_total):
+        m = partners[k]
+        # i <-> intermediary leg: the same loss outcome the gossip
+        # exchange used for this round
+        responder = (
+            proc_alive[m] & (partition == partition[m]) & ~losses[k]
+        )
+        # intermediary -> target probe leg: its own independent loss
+        loss_probe = _uniform(rng, (n,), salt=0xD0DE + k) < params.packet_loss
+        reached = (
+            responder
+            & proc_alive[partner0]
+            & (partition[m] == partition[partner0])
+            & ~loss_probe
+        )
+        any_responder |= responder
+        any_reached |= reached
+    start_susp = (
+        direct_fail
+        & any_responder
+        & ~any_reached
+        & (state.susp_subject != partner0)
+    )
     state = state._replace(
         susp_subject=jnp.where(start_susp, partner0, state.susp_subject),
         susp_since=jnp.where(start_susp, t, state.susp_since),
@@ -377,6 +460,9 @@ def tick(
         state.truth_inc,  # suspect keeps the member's incarnation
         detector,
         t,
+    )
+    state = state._replace(
+        defame_slot=jnp.where(suspect_subjects, slots[0], state.defame_slot)
     )
 
     # ---- suspicion expiry: faulty batch --------------------------------
@@ -404,16 +490,37 @@ def tick(
         expirer,
         t,
     )
+    state = state._replace(
+        defame_slot=jnp.where(faulty_subjects, slots[1], state.defame_slot)
+    )
 
-    # ---- rejoin: alive batch -------------------------------------------
+    # ---- refute + rejoin: alive batch ----------------------------------
+    # refute (member.js:76-81): a live node that has HEARD the rumor
+    # defaming it re-asserts alive with a fresh incarnation.  "Heard" =
+    # its bit for the defaming slot is set, or that rumor already aged
+    # into base_sum (then every live node counts it).
+    ds = state.defame_slot
+    ds_c = jnp.clip(ds, 0, u - 1)
+    heard_bit = (
+        state.heard[ids, ds_c // WORD]
+        >> (ds_c % WORD).astype(jnp.uint32)
+    ) & jnp.uint32(1)
+    aware = (ds >= 0) & (heard_bit.astype(bool) | ~state.r_active[ds_c])
+    defamed = (state.truth_status == SUSPECT) | (state.truth_status == FAULTY)
+    refuter = proc_alive & ~revived & aware & defamed
+    n_refute = jnp.sum(refuter.astype(jnp.int32))
+    alive_subjects = revived | refuter
     state = _publish_batch(
         state,
         slots[2],
-        revived,
+        alive_subjects,
         jnp.full(n, ALIVE, jnp.int32),
         jnp.full(n, now, jnp.int64),  # fresh incarnation (member.js:78-81)
-        revived,
+        alive_subjects,
         t,
+    )
+    state = state._replace(
+        defame_slot=jnp.where(alive_subjects, -1, state.defame_slot)
     )
 
     # ---- checksums + metrics ------------------------------------------
@@ -466,5 +573,6 @@ def tick(
         distinct_checksums=distinct,
         suspects_published=n_susp,
         faulties_published=n_faulty,
+        refutes_published=n_refute,
     )
     return state, metrics

@@ -23,3 +23,9 @@ os.environ["JAX_PLATFORMS"] = "cpu"  # for any subprocesses tests may spawn
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers", "slow: long-running parity/scale tests (deselect with -m 'not slow')"
+    )

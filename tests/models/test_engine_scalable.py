@@ -162,3 +162,116 @@ def test_epoch_respected_in_checksums():
     state = es.init_state(params, seed=0)
     cs = es.compute_checksums(state, params)
     assert np.unique(np.asarray(cs)).size == 1
+
+
+def test_false_suspects_under_loss_are_refuted():
+    """Packet loss (no dead processes) must produce false suspects via the
+    failed-direct + failed-indirect evidence path, and the suspected live
+    nodes must refute with fresh incarnations — no permanent faulty marks
+    (ping-req: lib/gossip/ping-req-sender.js:249-262, refute:
+    lib/membership/member.js:76-81)."""
+    n = 64
+    params = es.ScalableParams(n=n, u=256, packet_loss=0.35, suspicion_ticks=30)
+    state = es.init_state(params, seed=3)
+    step = jax.jit(functools.partial(es.tick, params=params))
+    total_susp = total_refute = 0
+    for _ in range(40):
+        state, m = step(state, es.ChurnInputs.quiet(n))
+        total_susp += int(m.suspects_published)
+        total_refute += int(m.refutes_published)
+    assert total_susp >= 1, "35% loss never produced a false suspect"
+    assert total_refute >= 1, "false suspects were never refuted"
+    # run loss-free to quiesce: every refute must win — nobody stays
+    # suspect/faulty, and fresh incarnations disseminate to convergence
+    params2 = params._replace(packet_loss=0.0)
+    step2 = jax.jit(functools.partial(es.tick, params=params2))
+    for _ in range(60):
+        state, m = step2(state, es.ChurnInputs.quiet(n))
+    ts = np.asarray(state.truth_status)
+    assert (ts == es.ALIVE).all(), np.flatnonzero(ts != es.ALIVE)
+    assert int(m.distinct_checksums) == 1
+    assert int(m.live_nodes) == n
+
+
+def test_no_false_suspects_without_loss():
+    n = 32
+    params = es.ScalableParams(n=n, u=160)
+    state = es.init_state(params, seed=4)
+    step = jax.jit(functools.partial(es.tick, params=params))
+    for _ in range(20):
+        state, m = step(state, es.ChurnInputs.quiet(n))
+        assert int(m.suspects_published) == 0
+        assert int(m.refutes_published) == 0
+
+
+def test_partition_split_brain_and_heal():
+    """A partition gates every exchange: cross-side pings fail, producing
+    false suspects, and the sides' checksums diverge while split (each
+    side hears only its own rumors).  Healing restores rumor flow and the
+    cluster reconverges to a single all-alive view.  (Per-side faulty
+    bookkeeping across the split is the full-fidelity engine's domain —
+    see the engine_scalable deviation envelope.)"""
+    n = 32
+    params = es.ScalableParams(n=n, u=256, suspicion_ticks=4)
+    state = es.init_state(params, seed=5)
+    step = jax.jit(functools.partial(es.tick, params=params))
+    part = jnp.asarray(
+        np.where(np.arange(n) < n // 2, 0, 1).astype(np.int32)
+    )
+    state, m = step(
+        state,
+        es.ChurnInputs(
+            kill=jnp.zeros(n, bool), revive=jnp.zeros(n, bool), partition=part
+        ),
+    )
+    suspects = refutes = 0
+    diverged = False
+    for _ in range(40):
+        state, m = step(state, es.ChurnInputs.quiet(n))
+        suspects += int(m.suspects_published)
+        refutes += int(m.refutes_published)
+        diverged = diverged or int(m.distinct_checksums) > 1
+    assert suspects >= 1, "partition never produced cross-side suspects"
+    assert refutes >= 1, "suspected live nodes never refuted"
+    assert diverged, "sides' checksums never diverged during the split"
+    # heal: same group again
+    heal = jnp.zeros(n, jnp.int32)
+    state, m = step(
+        state,
+        es.ChurnInputs(
+            kill=jnp.zeros(n, bool), revive=jnp.zeros(n, bool), partition=heal
+        ),
+    )
+    for _ in range(80):
+        state, m = step(state, es.ChurnInputs.quiet(n))
+    ts = np.asarray(state.truth_status)
+    assert (ts == es.ALIVE).all(), np.flatnonzero(ts != es.ALIVE)
+    assert int(m.distinct_checksums) == 1
+
+
+@pytest.mark.slow
+def test_100k_nodes_5pct_loss_false_suspects_refuted():
+    """The 100k epidemic-broadcast regime (BASELINE.md north star: k=3
+    ping-req fanout, 5% packet loss): false suspects must arise from loss
+    alone and be refuted — no permanent faulty marks on live processes."""
+    n = 100_000
+    params = es.ScalableParams(n=n, u=512, packet_loss=0.05)
+    state = es.init_state(params, seed=9)
+    step = jax.jit(functools.partial(es.tick, params=params))
+    susp = ref = fau = 0
+    for _ in range(50):
+        state, m = step(state, es.ChurnInputs.quiet(n))
+        susp += int(m.suspects_published)
+        ref += int(m.refutes_published)
+        fau += int(m.faulties_published)
+    assert susp >= 10, "5% loss at 100k nodes produced almost no suspects"
+    assert ref >= 10, "false suspects were not refuted"
+    assert fau == 0, "a live process was escalated to faulty"
+    # drain: loss-free ticks let outstanding refutes land
+    params2 = params._replace(packet_loss=0.0)
+    step2 = jax.jit(functools.partial(es.tick, params=params2))
+    for _ in range(40):
+        state, m = step2(state, es.ChurnInputs.quiet(n))
+    ts = np.asarray(state.truth_status)
+    assert (ts == es.ALIVE).all()
+    assert int(m.distinct_checksums) == 1

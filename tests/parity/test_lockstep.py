@@ -1,0 +1,151 @@
+"""North-star #1: engine vs host-oracle checksum parity, tick by tick.
+
+The batched device engine (farmhash checksum mode — bit-exact reference
+checksum strings, lib/membership/index.js:48-123) and the host object
+oracle (one host Membership per node + the C++ FarmHash oracle) run the
+same event schedule and must produce IDENTICAL per-node uint32 checksums
+after every tick.  Any divergence in SWIM precedence, refutation,
+dissemination budgets, full-sync, suspicion, or checksum encoding fails
+these tests at the first differing tick.
+"""
+
+import jax
+import numpy as np
+import pytest
+
+from ringpop_tpu.models.sim import engine
+from ringpop_tpu.models.sim.cluster import default_addresses
+from ringpop_tpu.ops import checksum_encode as ce
+from ringpop_tpu.parity import OracleCluster
+
+
+def run_lockstep(n, schedule, params=None, seed=0):
+    """schedule: list of dicts with optional kill/revive/join/partition
+    [N]-arrays.  Asserts per-tick checksum equality; returns tick count."""
+    params = params or engine.SimParams(n=n, checksum_mode="farmhash")
+    addresses = default_addresses(n)
+    universe = ce.Universe.from_addresses(addresses)
+    state = engine.init_state(params, seed=seed)
+    oracle = OracleCluster(params, addresses, seed=seed)
+    tick = jax.jit(lambda s, i: engine.tick(s, i, params, universe))
+
+    for t, ev in enumerate(schedule):
+        inputs = engine.TickInputs.quiet(n)._replace(
+            **{
+                k: jax.numpy.asarray(v)
+                for k, v in ev.items()
+                if k in ("kill", "revive", "join", "partition")
+            }
+        )
+        state, metrics = tick(state, inputs)
+        got = np.asarray(state.checksum).astype(np.uint32)
+        res = oracle.tick(ev)
+        want = res.checksums
+        mismatch = np.flatnonzero(got != want)
+        assert mismatch.size == 0, (
+            f"tick {t}: engine/oracle checksums differ at nodes "
+            f"{mismatch[:8].tolist()} (engine "
+            f"{[hex(x) for x in got[mismatch[:4]]]}, oracle "
+            f"{[hex(x) for x in want[mismatch[:4]]]})"
+        )
+        assert bool(np.asarray(metrics.converged)) == res.converged, f"tick {t}"
+    return len(schedule)
+
+
+def quiet(n, ticks):
+    return [{} for _ in range(ticks)]
+
+
+def join_all(n):
+    return [{"join": np.ones(n, bool)}]
+
+
+def test_bootstrap_and_converge_n16():
+    n = 16
+    run_lockstep(n, join_all(n) + quiet(n, 20))
+
+
+def test_kill_suspect_faulty_n16():
+    n = 16
+    kill = np.zeros(n, bool)
+    kill[5] = True
+    sched = join_all(n) + quiet(n, 6) + [{"kill": kill}] + quiet(n, 40)
+    run_lockstep(n, sched)
+
+
+def test_revive_rejoin_n16():
+    n = 16
+    kill = np.zeros(n, bool)
+    kill[3] = True
+    revive = np.zeros(n, bool)
+    revive[3] = True
+    sched = (
+        join_all(n)
+        + quiet(n, 6)
+        + [{"kill": kill}]
+        + quiet(n, 34)
+        + [{"revive": revive}]
+        + quiet(n, 30)
+    )
+    run_lockstep(n, sched)
+
+
+def test_staggered_joins_n16():
+    n = 16
+    sched = []
+    for start in range(0, n, 4):
+        j = np.zeros(n, bool)
+        j[start : start + 4] = True
+        sched.append({"join": j})
+        sched += quiet(n, 3)
+    sched += quiet(n, 20)
+    run_lockstep(n, sched)
+
+
+def test_packet_loss_n16():
+    n = 16
+    params = engine.SimParams(n=n, checksum_mode="farmhash", packet_loss=0.15)
+    run_lockstep(n, join_all(n) + quiet(n, 40), params=params)
+
+
+def test_partition_heal_n16():
+    n = 16
+    part = np.zeros(n, np.int32)
+    part[n // 2 :] = 1
+    heal = np.zeros(n, np.int32)
+    sched = (
+        join_all(n)
+        + quiet(n, 8)
+        + [{"partition": part}]
+        + quiet(n, 40)
+        + [{"partition": heal}]
+        + quiet(n, 40)
+    )
+    run_lockstep(n, sched)
+
+
+def test_churn_storm_n24():
+    n = 24
+    rng = np.random.default_rng(7)
+    sched = join_all(n) + quiet(n, 8)
+    alive = np.ones(n, bool)
+    for _ in range(6):
+        kill = np.zeros(n, bool)
+        revive = np.zeros(n, bool)
+        for i in rng.choice(n, size=3, replace=False):
+            if alive[i]:
+                kill[i] = True
+                alive[i] = False
+            else:
+                revive[i] = True
+                alive[i] = True
+        sched.append({"kill": kill, "revive": revive})
+        sched += quiet(n, 9)
+    sched += quiet(n, 45)
+    run_lockstep(n, sched)
+
+
+@pytest.mark.slow
+def test_bootstrap_n128():
+    n = 128
+    run_lockstep(n, join_all(n) + quiet(n, 24))
