@@ -109,7 +109,8 @@ class Dissemination(EventEmitter):
                 extra={
                     "local": self.ringpop.whoami(),
                     "localChecksum": self.ringpop.membership.checksum,
-                    "dist": sender_checksum,
+                    "dest": sender_addr,
+                    "destChecksum": sender_checksum,
                 },
             )
             return self.full_sync(), True

@@ -98,7 +98,12 @@ class JoinCluster:
         start = time.time() * 1000.0
         nodes_joined: List[str] = []
         join_responses: List[Dict[str, Any]] = []
-        attempts = 0
+        failures: List[Dict[str, Any]] = []
+        num_failed = 0
+        num_groups = 0
+        # like the reference, maxJoinAttempts bounds FAILED NODE attempts,
+        # not retry rounds (join-sender.js:275-289 `numFailed >=
+        # maxJoinAttempts`)
         max_attempts = self.ringpop.config.get("maxJoinAttempts")
 
         while len(nodes_joined) < self.join_size:
@@ -111,16 +116,31 @@ class JoinCluster:
             if elapsed > self.max_join_duration_ms:
                 self.ringpop.logger.warning(
                     "ringpop join duration exceeded",
-                    extra={"local": self.ringpop.whoami(), "joinDuration": elapsed},
+                    extra={
+                        "local": self.ringpop.whoami(),
+                        "joinDuration": elapsed,
+                        "maxJoinDuration": self.max_join_duration_ms,
+                        "numJoined": len(nodes_joined),
+                        "numFailed": num_failed,
+                    },
                 )
                 raise JoinError(
                     "join duration exceeded", "ringpop-tpu.join-duration-exceeded"
                 )
-            if attempts >= max_attempts:
+            if num_failed >= max_attempts:
+                self.ringpop.logger.warning(
+                    "ringpop max join attempts exceeded",
+                    extra={
+                        "local": self.ringpop.whoami(),
+                        "joinAttempts": num_failed,
+                        "maxJoinAttempts": max_attempts,
+                        "numJoined": len(nodes_joined),
+                        "failures": failures[-5:],
+                    },
+                )
                 raise JoinError(
                     "max join attempts exceeded", "ringpop-tpu.join-attempts-exceeded"
                 )
-            attempts += 1
 
             remaining = [n for n in self.potential_nodes if n not in nodes_joined]
             if not remaining:
@@ -130,14 +150,16 @@ class JoinCluster:
             group = self._select_group(want)
             if not group:
                 break
+            num_groups += 1
 
             results: List[Optional[Dict[str, Any]]] = [None] * len(group)
+            errors_seen: List[Optional[Exception]] = [None] * len(group)
 
             def attempt(i: int, node: str) -> None:
                 try:
                     results[i] = self._join_node(node)
-                except (ChannelError, RemoteError):
-                    results[i] = None
+                except (ChannelError, RemoteError) as e:
+                    errors_seen[i] = e
 
             threads = [
                 threading.Thread(target=attempt, args=(i, n), daemon=True)
@@ -148,8 +170,27 @@ class JoinCluster:
             for t in threads:
                 t.join(self.join_timeout_ms / 1000.0 + 1.0)
 
-            for node, res in zip(group, results):
-                if res is None or len(nodes_joined) >= self.join_size:
+            for i, (node, res) in enumerate(zip(group, results)):
+                if res is None:
+                    # triage: transport failure vs application rejection
+                    # (join-sender.js:233-283 error paths)
+                    err = errors_seen[i]
+                    if isinstance(err, RemoteError):
+                        payload = err.payload
+                        err_type = (
+                            payload.get("type", "remote")
+                            if isinstance(payload, dict)
+                            else "remote"
+                        )
+                    elif isinstance(err, ChannelError):
+                        err_type = err.type
+                    else:
+                        err_type = "timeout"
+                    failures.append({"node": node, "errType": err_type})
+                    num_failed += 1
+                    self.ringpop.stat("increment", "join.failed")
+                    continue
+                if len(nodes_joined) >= self.join_size:
                     continue
                 nodes_joined.append(node)
                 join_responses.append(
@@ -170,14 +211,30 @@ class JoinCluster:
         if not nodes_joined:
             raise JoinError("no nodes joined", "ringpop-tpu.join-failed")
 
+        join_time_ms = time.time() * 1000.0 - start
         updates = merge_join_responses(self.ringpop, join_responses)
         self.ringpop.membership.update(updates)
+        self.ringpop.stat("timing", "join", join_time_ms)
         self.ringpop.stat("increment", "join.complete")
         self.ringpop.logger.debug(
             "ringpop join complete",
-            extra={"local": self.ringpop.whoami(), "joined": nodes_joined},
+            extra={
+                "local": self.ringpop.whoami(),
+                "joinSize": self.join_size,
+                "joinTime": join_time_ms,
+                "numJoined": len(nodes_joined),
+                "numGroups": num_groups,
+                "numFailed": num_failed,
+            },
         )
-        return {"nodesJoined": nodes_joined}
+        return {
+            "nodesJoined": nodes_joined,
+            "numJoined": len(nodes_joined),
+            "numFailed": num_failed,
+            "numGroups": num_groups,
+            "failures": failures,
+            "joinTime": join_time_ms,
+        }
 
 
 def join_cluster(ringpop: Any, opts: Optional[Dict[str, Any]] = None) -> Dict[str, Any]:

@@ -1,0 +1,70 @@
+"""Membership update rollup buffering/flush (lib/membership/rollup.js)."""
+
+from ringpop_tpu.net.timers import FakeTimers
+from ringpop_tpu.utils.rollup import MembershipUpdateRollup
+
+
+class StubRingpop:
+    def __init__(self):
+        self.timers = FakeTimers()
+        self.debug_logs = []
+
+        class M:
+            checksum = 123
+
+        self.membership = M()
+
+        outer = self
+
+        class _Log:
+            def debug(self, msg, extra=None):
+                outer.debug_logs.append((msg, extra))
+
+            info = warning = error = debug
+
+        self.logger = _Log()
+
+    def whoami(self):
+        return "127.0.0.1:3000"
+
+
+def upd(addr, status="alive", inc=1):
+    return {"address": addr, "status": status, "incarnationNumber": inc}
+
+
+def test_flush_after_quiet_interval():
+    rp = StubRingpop()
+    r = MembershipUpdateRollup(rp, flush_interval_ms=5000)
+    r.track_updates([upd("a:1"), upd("b:2")])
+    assert r._num_updates() == 2
+    assert not rp.debug_logs
+    rp.timers.advance(5.5)  # quiet interval elapses -> timer flush
+    assert r.buffer == {}
+    assert len(rp.debug_logs) == 1
+    _, extra = rp.debug_logs[0]
+    assert extra["updateCount"] == 2
+    assert set(extra["updates"]) == {"a:1", "b:2"}
+
+
+def test_force_flush_at_max_updates():
+    rp = StubRingpop()
+    r = MembershipUpdateRollup(rp, flush_interval_ms=5000, max_num_updates=3)
+    r.track_updates([upd("a:1"), upd("a:1")])  # same address: 2 updates
+    assert not rp.debug_logs
+    r.track_updates([upd("b:2")])  # hits the max -> immediate flush
+    assert len(rp.debug_logs) == 1
+    assert rp.debug_logs[0][1]["updateCount"] == 3
+    assert r.buffer == {}
+
+
+def test_flushed_event_and_destroy_cancels_timer():
+    rp = StubRingpop()
+    r = MembershipUpdateRollup(rp, flush_interval_ms=5000)
+    flushes = []
+    r.on("flushed", lambda *a: flushes.append(1))
+    r.track_updates([upd("a:1")])
+    r.destroy()
+    rp.timers.advance(10.0)
+    assert not flushes  # destroyed before the quiet flush fired
+    r.flush_buffer()  # manual flush still works
+    assert flushes == [1]

@@ -1,0 +1,209 @@
+"""Live multi-node integration: real Ringpop nodes over real sockets.
+
+The test-ringpop-cluster scope (test/lib/test-ringpop-cluster.js): N nodes
+bootstrap against each other, converge, survive kill -> suspect -> faulty,
+refute wrong suspicion, leave/rejoin, and keep the ring consistent.
+"""
+
+import pytest
+
+from ringpop_tpu.gossip.join_sender import JoinError
+from ringpop_tpu.models.membership.host import Status
+from tests.lib.cluster import LiveCluster
+
+
+@pytest.fixture
+def cluster():
+    made = []
+
+    def make(n=5, **kw):
+        c = LiveCluster(n=n, **kw)
+        made.append(c)
+        return c
+
+    yield make
+    for c in made:
+        c.destroy_all()
+
+
+def test_bootstrap_converges(cluster):
+    c = cluster(n=5)
+    c.bootstrap_all()
+    ticks = c.tick_until_converged()
+    assert ticks <= 60
+    for rp in c.nodes:
+        assert rp.membership.get_member_count() == 5
+        assert sorted(rp.ring.servers) == sorted(c.hosts)
+        assert rp.membership.checksum is not None
+
+
+def test_kill_suspect_then_faulty(cluster):
+    c = cluster(n=5)
+    c.bootstrap_all()
+    c.tick_until_converged()
+    victim = c.node(2)
+    victim_addr = victim.whoami()
+    victim.destroy()  # SIGKILL equivalent: sockets die, no goodbye
+
+    # gossip: someone's direct ping fails, ping-req finds no path -> suspect
+    for _ in range(30):
+        c.tick_all()
+        if any(
+            s == Status.suspect for s in c.statuses_of(victim_addr).values()
+        ):
+            break
+    assert any(
+        s == Status.suspect for s in c.statuses_of(victim_addr).values()
+    ), c.statuses_of(victim_addr)
+
+    # suspicion clocks expire (5s virtual) -> faulty, disseminated to all
+    for _ in range(40):
+        c.advance_all(6.0)
+        c.tick_all()
+        statuses = c.statuses_of(victim_addr)
+        if all(s == Status.faulty for s in statuses.values()):
+            break
+    assert all(
+        s == Status.faulty for s in c.statuses_of(victim_addr).values()
+    ), c.statuses_of(victim_addr)
+    c.tick_until_converged()
+    # faulty members leave the ring but stay in the member list
+    for rp in c.live():
+        assert victim_addr not in rp.ring.servers
+        assert rp.membership.find_member_by_address(victim_addr) is not None
+
+
+def test_wrongly_suspected_node_refutes(cluster):
+    c = cluster(n=4)
+    c.bootstrap_all()
+    c.tick_until_converged()
+    accuser, accused = c.node(0), c.node(1)
+    inc_before = accused.membership.local_member.incarnation_number
+    # accuser wrongly declares the (live) accused suspect
+    m = accuser.membership.find_member_by_address(accused.whoami())
+    accuser.membership.make_suspect(accused.whoami(), m.incarnation_number)
+    assert c.status_of(accuser, accused.whoami()) == Status.suspect
+
+    # accused's own clock must move past the stale incarnation so the
+    # refute is fresh (incarnations are clock-derived, member.js:78-81)
+    accused.timers.advance(1.0)
+    for _ in range(40):
+        c.tick_all()
+        statuses = c.statuses_of(accused.whoami())
+        if all(s == Status.alive for s in statuses.values()):
+            break
+    assert all(
+        s == Status.alive for s in c.statuses_of(accused.whoami()).values()
+    ), c.statuses_of(accused.whoami())
+    assert (
+        accused.membership.local_member.incarnation_number > inc_before
+    ), "refute must bump the incarnation number"
+    c.tick_until_converged()
+
+
+def test_leave_and_rejoin(cluster):
+    c = cluster(n=4)
+    c.bootstrap_all()
+    c.tick_until_converged()
+    leaver = c.node(3)
+    addr = leaver.whoami()
+
+    _, res = leaver.server.admin_member_leave(None, {})
+    assert res["status"] == "ok"
+    # LocalMemberLeaveEvent stops the leaver's gossip
+    assert leaver.gossip.is_stopped
+    for _ in range(40):
+        c.tick_all()
+        statuses = {
+            k: v for k, v in c.statuses_of(addr).items()
+        }
+        if all(s == Status.leave for s in statuses.values()):
+            break
+    assert all(
+        s == Status.leave for s in c.statuses_of(addr).values()
+    ), c.statuses_of(addr)
+    for rp in c.live():
+        if rp.whoami() != addr:
+            assert addr not in rp.ring.servers
+
+    # rejoin: fresh incarnation, gossip restarted, back in every ring
+    leaver.timers.advance(1.0)
+    _, res = leaver.server.admin_member_join(None, {})
+    assert res["status"] == "rejoined"
+    assert not leaver.gossip.is_stopped
+    for _ in range(60):
+        c.tick_all()
+        if all(
+            s == Status.alive for s in c.statuses_of(addr).values()
+        ):
+            break
+    assert all(s == Status.alive for s in c.statuses_of(addr).values())
+    c.tick_until_converged()
+    for rp in c.live():
+        assert addr in rp.ring.servers
+
+
+def test_deny_joins(cluster):
+    def deny(cl):
+        for rp in cl.nodes[1:]:
+            rp.deny_joins()
+
+    c = cluster(n=3, tap=deny)
+    joiner = c.node(0)
+    for rp in c.nodes[1:]:
+        rp.bootstrap([rp.whoami()])  # bring up targets standalone
+    with pytest.raises(JoinError):
+        joiner.bootstrap({"bootstrapFile": c.hosts, "maxJoinDuration": 2000})
+
+
+def test_full_sync_recovers_divergence(cluster):
+    """A node whose change buffer is empty but whose checksum differs gets
+    the target's full membership (dissemination.js:101-114)."""
+    c = cluster(n=3)
+    c.bootstrap_all()
+    c.tick_until_converged()
+    # fabricate divergence: node0 learns of a phantom member directly, with
+    # the change buffer cleared so only full-sync can repair the others
+    phantom = "127.0.0.1:19999"
+    c.node(0).membership.update(
+        {
+            "address": phantom,
+            "status": Status.faulty,
+            "incarnationNumber": 1,
+            "source": c.node(0).whoami(),
+            "sourceIncarnationNumber": 1,
+        }
+    )
+    c.node(0).dissemination.clear_changes()
+    assert not c.converged()
+    c.tick_until_converged(max_ticks=40)
+    for rp in c.live():
+        assert rp.membership.find_member_by_address(phantom) is not None
+
+
+def test_join_failure_triage_stats(cluster):
+    """Failed join attempts are triaged by error type and surfaced in the
+    join result (join-sender.js:233-283 stats)."""
+    c = cluster(n=4)
+
+    def deny(rp):
+        rp.deny_joins()
+
+    # one denier + one dead address in the bootstrap list
+    c.node(1).deny_joins()
+    for rp in c.nodes[1:]:
+        rp.bootstrap([rp.whoami()])
+
+    joiner = c.node(0)
+    hosts = c.hosts + ["127.0.0.1:1"]
+    joiner.membership.make_alive(joiner.whoami(), joiner.timers.now_ms())
+    from ringpop_tpu.gossip.join_sender import join_cluster
+
+    joiner.bootstrap_hosts = hosts
+    result = join_cluster(
+        joiner, {"joinSize": 2, "joinTimeout": 500, "maxJoinDuration": 10000}
+    )
+    assert result["numJoined"] >= 2
+    assert result["numGroups"] >= 1
+    if result["numFailed"]:
+        assert all("errType" in f for f in result["failures"])

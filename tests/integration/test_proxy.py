@@ -1,0 +1,194 @@
+"""Request forwarding over a live cluster (test/integration/proxy-test.js
+scope): handle-or-proxy, retries with re-lookup and reroute, keys-diverged
+abort, checksum-mismatch rejection, handle_or_proxy_all grouping, and the
+sk-header sharding handler (ringpop-handler.js)."""
+
+import pytest
+
+from ringpop_tpu.utils import errors
+from tests.lib.cluster import LiveCluster
+
+
+@pytest.fixture
+def cluster():
+    made = []
+
+    def make(n=3, **kw):
+        c = LiveCluster(n=n, **kw)
+        made.append(c)
+        c.bootstrap_all()
+        c.tick_until_converged()
+        return c
+
+    yield make
+    for c in made:
+        c.destroy_all()
+
+
+def wire_echo_handlers(c):
+    """Every node answers proxied requests with its own identity."""
+    for rp in c.nodes:
+        def handler(req, res, head, rp=rp):
+            res.end(
+                {"handledBy": rp.whoami(), "keys": req.get("ringpopKeys")},
+            )
+        rp.on("request", handler)
+
+
+def key_owned_by(c, owner, tag="k"):
+    """A key whose ring owner is `owner` in everyone's converged view."""
+    for i in range(10000):
+        key = "%s-%d" % (tag, i)
+        if c.node(0).lookup(key) == owner.whoami():
+            return key
+    raise AssertionError("no key found for %s" % owner.whoami())
+
+
+def test_handle_or_proxy_local_and_remote(cluster):
+    c = cluster(n=3)
+    wire_echo_handlers(c)
+    sender, remote = c.node(0), c.node(1)
+    local_key = key_owned_by(c, sender)
+    remote_key = key_owned_by(c, remote)
+
+    assert sender.handle_or_proxy(local_key, {"url": "/x"}) is True
+
+    captured = {}
+    orig = sender.request_proxy.proxy_req
+
+    def spy(opts):
+        res = orig(opts)
+        captured.update(res)
+        return res
+
+    sender.request_proxy.proxy_req = spy
+    assert sender.handle_or_proxy(remote_key, {"url": "/x"}) is False
+    assert captured["body"]["handledBy"] == remote.whoami()
+    assert captured["body"]["keys"] == [remote_key]
+
+
+def test_handle_or_proxy_all_groups_by_owner(cluster):
+    c = cluster(n=3)
+    wire_echo_handlers(c)
+    sender = c.node(0)
+    keys = [key_owned_by(c, rp, tag="g%d" % i) for i, rp in enumerate(c.nodes)]
+    results = sender.handle_or_proxy_all(keys, {"url": "/all"})
+    assert len(results) == 3
+    by_dest = {r["dest"]: r for r in results}
+    for rp, key in zip(c.nodes, keys):
+        entry = by_dest[rp.whoami()]
+        assert entry["keys"] == [key]
+        assert "error" not in entry
+        assert entry["res"]["body"]["handledBy"] == rp.whoami()
+
+
+def test_checksum_mismatch_rejected_then_retried_to_success(cluster):
+    c = cluster(n=3)
+    wire_echo_handlers(c)
+    sender, dest = c.node(0), c.node(1)
+    key = key_owned_by(c, dest)
+    # destabilize the DEST's checksum so the first attempt is rejected;
+    # convergence repairs it and the retry (after re-lookup) succeeds
+    phantom = "127.0.0.1:19998"
+    dest.membership.update(
+        {
+            "address": phantom,
+            "status": "faulty",
+            "incarnationNumber": 1,
+            "source": dest.whoami(),
+            "sourceIncarnationNumber": 1,
+        }
+    )
+    stats_before = _stat_count(sender, "requestProxy.retry.attempted")
+
+    # background convergence: the proxy retry sleeps on FakeTimers, so we
+    # drive gossip from a thread while proxy_req blocks
+    import threading
+
+    def converge():
+        for _ in range(30):
+            c.tick_all()
+            sender.timers.advance(2.0)
+
+    t = threading.Thread(target=converge, daemon=True)
+    t.start()
+    res = sender.proxy_req(
+        {"keys": [key], "dest": dest.whoami(), "req": {"url": "/y"}}
+    )
+    t.join(10.0)
+    assert res["body"]["handledBy"] in {rp.whoami() for rp in c.nodes}
+    assert (
+        _stat_count(sender, "requestProxy.retry.attempted") > stats_before
+    ), "first attempt should have been checksum-rejected and retried"
+
+
+def test_keys_diverged_aborts_retry(cluster):
+    c = cluster(n=3)
+    sender = c.node(0)
+    k1 = key_owned_by(c, c.node(1), tag="d1")
+    k2 = key_owned_by(c, c.node(2), tag="d2")
+    with pytest.raises(errors.KeysDivergedError):
+        sender.request_proxy._relookup([k1, k2], c.node(1).whoami())
+
+
+def test_retry_reroutes_to_new_owner(cluster):
+    c = cluster(n=3)
+    wire_echo_handlers(c)
+    sender, old_owner = c.node(0), c.node(1)
+    key = key_owned_by(c, old_owner)
+    # point the first attempt at a dead address: retries re-lookup and
+    # reroute to the real owner (send.js:181-208)
+    dead = "127.0.0.1:1"
+    res = sender.proxy_req(
+        {"keys": [key], "dest": dead, "req": {"url": "/z"}}
+    )
+    assert res["body"]["handledBy"] == old_owner.whoami()
+
+
+def test_sharding_handler_relays_by_sk(cluster):
+    from ringpop_tpu.api.handler import RingpopHandler
+
+    c = cluster(n=3)
+    for rp in c.nodes:
+        def app_handler(head, body, rp=rp):
+            return None, {"servedBy": rp.whoami(), "echo": body}
+
+        RingpopHandler(rp, app_handler, "/app/op").register()
+
+    sender, other = c.node(0), c.node(2)
+    sk = key_owned_by(c, other, tag="sk")
+    _, body = sender.channel.request(
+        sender.whoami(), "/app/op", head={"sk": sk}, body={"v": 1}
+    )
+    assert body["servedBy"] == other.whoami()
+    assert body["echo"] == {"v": 1}
+
+    sk_local = key_owned_by(c, sender, tag="skl")
+    _, body = sender.channel.request(
+        sender.whoami(), "/app/op", head={"sk": sk_local}, body={"v": 2}
+    )
+    assert body["servedBy"] == sender.whoami()
+
+
+def _stat_count(rp, suffix):
+    # NullStatsd records nothing; count via the stat-key cache side effect
+    # is unreliable — attach a counting statsd instead
+    return getattr(rp, "_test_counts", {}).get(suffix, 0)
+
+
+@pytest.fixture(autouse=True)
+def counting_statsd(monkeypatch):
+    """Wrap Ringpop.stat to count increments per suffix for assertions."""
+    from ringpop_tpu.api.ringpop import Ringpop
+
+    orig = Ringpop.stat
+
+    def counting(self, stat_type, key, value=None):
+        if stat_type == "increment":
+            counts = getattr(self, "_test_counts", None)
+            if counts is None:
+                counts = self._test_counts = {}
+            counts[key] = counts.get(key, 0) + 1
+        return orig(self, stat_type, key, value)
+
+    monkeypatch.setattr(Ringpop, "stat", counting)

@@ -1,0 +1,127 @@
+"""In-process live-cluster fixture: N full Ringpop nodes with real framed
+JSON-over-TCP channels on 127.0.0.1 — the equivalent of the reference's
+``testRingpopCluster`` (test/lib/test-ringpop-cluster.js:31-135).
+
+Gossip is driven manually (``autoGossip: False`` + ``tick_all``) and every
+node gets ``FakeTimers`` so suspicion clocks and proxy retry sleeps advance
+virtually (the reference wires time-mock the same way,
+test/lib/alloc-ringpop.js:24-63) while the RPC plane stays real sockets.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional
+
+from ringpop_tpu.api.ringpop import Ringpop
+from ringpop_tpu.net.channel import Channel
+from ringpop_tpu.net.timers import FakeTimers
+
+
+class LiveCluster:
+    def __init__(
+        self,
+        n: int = 5,
+        app: str = "integration-app",
+        options: Optional[dict] = None,
+        tap=None,
+    ):
+        self.nodes: List[Ringpop] = []
+        for i in range(n):
+            ch = Channel("127.0.0.1:0")
+            host_port = ch.listen()
+            rp = Ringpop(
+                app,
+                host_port,
+                channel=ch,
+                timers=FakeTimers(),
+                options=dict({"autoGossip": False}, **(options or {})),
+                seed=i,
+            )
+            self.nodes.append(rp)
+        self.hosts = [rp.whoami() for rp in self.nodes]
+        if tap is not None:
+            # pre-bootstrap sabotage hook (test-ringpop-cluster.js tap())
+            tap(self)
+
+    # -- lifecycle --------------------------------------------------------
+
+    def bootstrap_all(self, timeout_s: float = 30.0) -> None:
+        """Concurrent bootstrap against the shared hosts list, like
+        tick-cluster's simultaneous child-process startup."""
+        errors: List[tuple] = []
+
+        def boot(rp: Ringpop) -> None:
+            try:
+                rp.bootstrap(self.hosts)
+            except Exception as e:  # collected for the assert below
+                errors.append((rp.whoami(), e))
+
+        threads = [
+            threading.Thread(target=boot, args=(rp,), daemon=True)
+            for rp in self.nodes
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout_s)
+        assert not errors, errors
+        assert all(rp.is_ready for rp in self.nodes)
+        # start gossip so tick() runs its full path (the ping-req fallback
+        # is skipped while stopped, gossip/index.js:129-131); the
+        # self-rescheduling timer lands in FakeTimers, so protocol periods
+        # still only run when the test calls tick_all()/advance_all()
+        for rp in self.nodes:
+            rp.gossip.start()
+
+    def destroy_all(self) -> None:
+        for rp in self.nodes:
+            rp.destroy()
+
+    # -- drive ------------------------------------------------------------
+
+    def live(self) -> List[Ringpop]:
+        return [rp for rp in self.nodes if rp.is_ready and not rp.destroyed]
+
+    def tick_all(self) -> None:
+        # manual drive: run a protocol period on every live node (stopped
+        # gossip still ticks, mirroring /admin/gossip/tick)
+        for rp in self.live():
+            rp.gossip.tick()
+
+    def advance_all(self, seconds: float) -> None:
+        for rp in self.live():
+            rp.timers.advance(seconds)
+
+    def checksums(self) -> Dict[str, int]:
+        return {rp.whoami(): rp.membership.checksum for rp in self.live()}
+
+    def converged(self) -> bool:
+        # all live checksums equal (scenario-runner.js:152-170)
+        values = set(self.checksums().values())
+        return len(values) <= 1
+
+    def tick_until_converged(self, max_ticks: int = 60) -> int:
+        for i in range(max_ticks):
+            self.tick_all()
+            if self.converged():
+                return i + 1
+        raise AssertionError(
+            "no convergence after %d ticks: %r" % (max_ticks, self.checksums())
+        )
+
+    # -- queries ----------------------------------------------------------
+
+    def node(self, i: int) -> Ringpop:
+        return self.nodes[i]
+
+    def status_of(self, viewer: Ringpop, address: str) -> Optional[str]:
+        m = viewer.membership.find_member_by_address(address)
+        return m.status if m is not None else None
+
+    def statuses_of(self, address: str) -> Dict[str, Optional[str]]:
+        return {
+            rp.whoami(): self.status_of(rp, address)
+            for rp in self.live()
+            if rp.whoami() != address
+        }
