@@ -1,0 +1,243 @@
+#!/usr/bin/env python3
+"""Convergence-time benchmark scenarios
+(benchmarks/convergence-time/scenario-runner.js:37-98 rebuilt).
+
+Each cycle induces a failure, measures the time until every live node
+reports the same membership checksum — the reference's convergence rule
+(scenario-runner.js:152-170) — then recovers (rejoins the failed nodes)
+and reconverges before the next cycle.  Reports the reference's histogram
+fields: count/min/max/mean/median/p75/p95/p99 (metrics Histogram printObj).
+
+Scenarios (benchmarks/convergence-time/scenarios/*.js):
+- ``single-node-failure`` — one random live node gracefully leaves
+- ``half-cluster-failure`` — half the cluster leaves at once
+
+Backends:
+- ``jax-sim`` — the batched device simulator; convergence measured in
+  protocol periods (ticks), reported as simulated milliseconds
+  (ticks x 200 ms) plus the wall-clock compute cost
+- ``live`` — real in-process Ringpop nodes over real sockets with REAL
+  timers and auto-gossip; convergence measured in wall-clock ms, like the
+  reference's multi-process runner
+
+Prints one JSON line per run:
+{"scenario", "backend", "n", "cycles", "unit", "histogram": {...}}
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import random
+import sys
+import time
+from typing import Dict, List
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def histogram(values: List[float]) -> Dict[str, float]:
+    """count/min/max/mean/median/p75/p95/p99 (metrics Histogram printObj)."""
+    if not values:
+        return {"count": 0}
+    s = sorted(values)
+
+    def pct(p: float) -> float:
+        i = min(len(s) - 1, max(0, int(round(p * (len(s) - 1)))))
+        return s[i]
+
+    return {
+        "count": len(s),
+        "min": s[0],
+        "max": s[-1],
+        "mean": sum(s) / len(s),
+        "median": pct(0.5),
+        "p75": pct(0.75),
+        "p95": pct(0.95),
+        "p99": pct(0.99),
+    }
+
+
+def pick_victims(scenario: str, hosts: List[str], rng: random.Random) -> List[int]:
+    if scenario == "single-node-failure":
+        return [rng.randrange(len(hosts))]
+    if scenario == "half-cluster-failure":
+        return rng.sample(range(len(hosts)), len(hosts) // 2)
+    raise ValueError("unknown scenario %r" % scenario)
+
+
+# -- jax-sim backend ---------------------------------------------------------
+
+
+def run_jax_sim(scenario: str, n: int, cycles: int, seed: int) -> dict:
+    import numpy as np
+
+    from ringpop_tpu.models.sim import engine
+    from ringpop_tpu.models.sim.cluster import SimCluster
+
+    params = engine.SimParams(n=n, checksum_mode="fast")
+    sim = SimCluster(n=n, params=params, seed=seed)
+    sim.bootstrap()
+    assert sim.run_until_converged() > 0
+    rng = random.Random(seed)
+
+    def live_mask() -> "np.ndarray":
+        # the reference's convergence set is the ALIVE workers only —
+        # left nodes drop out of hostToAliveWorker
+        return np.asarray(
+            sim.state.proc_alive & sim.state.ready & sim.state.gossip_on
+        )
+
+    def converged_fresh(pre: "np.ndarray") -> bool:
+        # reference rule (scenario-runner.js:152-170): every alive worker
+        # has REPORTED A NEW CHECKSUM since the event (hostToChecksum is
+        # cleared each round) and all of them agree
+        cs = sim.checksums()
+        lm = live_mask()
+        if not lm.any():
+            return False
+        vals = cs[lm]
+        return bool((vals == vals[0]).all() and (vals != pre[lm]).all())
+
+    def wait_fresh(pre: "np.ndarray", max_ticks: int = 10_000) -> int:
+        ticks = 0
+        while not converged_fresh(pre):
+            sim.step()
+            ticks += 1
+            if ticks > max_ticks:
+                raise RuntimeError("no convergence in %d ticks" % max_ticks)
+        return ticks
+
+    sim_ms: List[float] = []
+    wall_start = time.perf_counter()
+    for _ in range(cycles):
+        victims = pick_victims(scenario, list(sim.universe.addresses), rng)
+        pre = sim.checksums().copy()
+        sim.leave(victims)
+        ticks = 1 + wait_fresh(pre)
+        sim_ms.append(ticks * params.period_ms)
+        # recover: rejoin and reconverge before the next cycle
+        pre = sim.checksums().copy()
+        sim.rejoin(victims)
+        wait_fresh(pre)
+    wall_s = time.perf_counter() - wall_start
+
+    return {
+        "scenario": scenario,
+        "backend": "jax-sim",
+        "n": n,
+        "cycles": cycles,
+        "unit": "simulated-ms (ticks x %dms)" % params.period_ms,
+        "histogram": histogram(sim_ms),
+        "wall_clock_s_total": round(wall_s, 3),
+    }
+
+
+# -- live backend ------------------------------------------------------------
+
+
+def run_live(scenario: str, n: int, cycles: int, seed: int) -> dict:
+    from ringpop_tpu.api.ringpop import Ringpop
+    from ringpop_tpu.net.channel import Channel
+
+    nodes = []
+    for i in range(n):
+        ch = Channel("127.0.0.1:0")
+        hp = ch.listen()
+        # real timers + auto-gossip: genuine wall-clock protocol dynamics
+        nodes.append(Ringpop("bench-app", hp, channel=ch, seed=seed + i))
+    hosts = [rp.whoami() for rp in nodes]
+
+    import threading
+
+    threads = [
+        threading.Thread(target=rp.bootstrap, args=(hosts,), daemon=True)
+        for rp in nodes
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(60.0)
+
+    def live_nodes():
+        return [rp for rp in nodes if rp.membership.local_member.status != "leave"]
+
+    def snapshot() -> Dict[str, int]:
+        return {rp.whoami(): rp.membership.checksum for rp in nodes}
+
+    def converged_fresh(pre: Dict[str, int]) -> bool:
+        # reference rule (scenario-runner.js:152-170): every alive worker
+        # has reported a NEW checksum since the event and all agree
+        live = live_nodes()
+        vals = [rp.membership.checksum for rp in live]
+        return (
+            len(set(vals)) == 1
+            and all(
+                rp.membership.checksum != pre[rp.whoami()] for rp in live
+            )
+        )
+
+    def wait_fresh(pre: Dict[str, int], timeout_s: float = 120.0) -> float:
+        t0 = time.perf_counter()
+        while time.perf_counter() - t0 < timeout_s:
+            if converged_fresh(pre):
+                return (time.perf_counter() - t0) * 1000.0
+            time.sleep(0.005)
+        raise RuntimeError("no convergence within %ss" % timeout_s)
+
+    # initial settle: everyone simply agrees
+    t0 = time.perf_counter()
+    while len({rp.membership.checksum for rp in nodes}) > 1:
+        if time.perf_counter() - t0 > 120:
+            raise RuntimeError("bootstrap never converged")
+        time.sleep(0.01)
+
+    rng = random.Random(seed)
+    ms: List[float] = []
+    try:
+        for _ in range(cycles):
+            victims = pick_victims(scenario, hosts, rng)
+            pre = snapshot()
+            for v in victims:
+                nodes[v].server.admin_member_leave(None, {})
+            ms.append(wait_fresh(pre))
+            pre = snapshot()
+            for v in victims:
+                nodes[v].server.admin_member_join(None, {})
+            wait_fresh(pre)
+    finally:
+        for rp in nodes:
+            rp.destroy()
+
+    return {
+        "scenario": scenario,
+        "backend": "live",
+        "n": n,
+        "cycles": cycles,
+        "unit": "wall-clock ms",
+        "histogram": histogram(ms),
+    }
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(prog="convergence-time")
+    p.add_argument(
+        "--scenario",
+        choices=("single-node-failure", "half-cluster-failure"),
+        default="single-node-failure",
+    )
+    p.add_argument("--backend", choices=("jax-sim", "live"), default="jax-sim")
+    p.add_argument("-n", type=int, default=10)
+    p.add_argument("--cycles", type=int, default=10)
+    p.add_argument("--seed", type=int, default=0)
+    args = p.parse_args(argv)
+
+    run = run_jax_sim if args.backend == "jax-sim" else run_live
+    result = run(args.scenario, args.n, args.cycles, args.seed)
+    print(json.dumps(result))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

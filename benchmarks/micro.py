@@ -1,0 +1,214 @@
+#!/usr/bin/env python3
+"""Micro-benchmarks mirroring the reference's harness suite
+(/root/reference/benchmarks/): membership checksum compute, large
+membership update, hash-ring add/remove (individual + bulk),
+findMemberByAddress, join-response merge, and stat() emission with
+cached vs uncached keys.  Prints one JSON line per benchmark:
+{"bench", "value", "unit": "ops/sec", ...}.
+
+Run: python benchmarks/micro.py [--bench NAME] [--quick]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+from typing import Callable, Dict, List
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def ops_per_sec(fn: Callable[[], None], min_time_s: float = 1.0) -> float:
+    fn()  # warm
+    n = 1
+    while True:
+        t0 = time.perf_counter()
+        for _ in range(n):
+            fn()
+        dt = time.perf_counter() - t0
+        if dt >= min_time_s:
+            return n / dt
+        n = max(n + 1, int(n * max(2.0, min_time_s / max(dt, 1e-9))))
+
+
+def make_membership(n_members: int):
+    from tests.lib.fixtures import RingpopFixture
+
+    rp = RingpopFixture()
+    for i in range(n_members - 1):
+        rp.membership.update(
+            {
+                "address": "10.0.%d.%d:9000" % (i // 256, i % 256),
+                "status": "alive",
+                "incarnationNumber": 1414142122274 + i,
+                "source": rp.host_port,
+                "sourceIncarnationNumber": 1414142122274,
+            }
+        )
+    return rp
+
+
+def bench_compute_checksum(quick: bool) -> List[dict]:
+    # benchmarks/compute-checksum.js:46-56 (100 and 1000 members)
+    out = []
+    for n in (100, 1000):
+        rp = make_membership(n)
+        rate = ops_per_sec(
+            rp.membership.compute_checksum, 0.2 if quick else 1.0
+        )
+        out.append(
+            {"bench": "compute-checksum-%d" % n, "value": round(rate, 1),
+             "unit": "ops/sec"}
+        )
+    return out
+
+
+def bench_large_membership_update(quick: bool) -> List[dict]:
+    # benchmarks/large-membership-update.js:37-44 (1332-member changeset)
+    changes = [
+        {
+            "address": "10.1.%d.%d:9000" % (i // 256, i % 256),
+            "status": "alive",
+            "incarnationNumber": 1414142122274 + i,
+            "source": "127.0.0.1:3000",
+            "sourceIncarnationNumber": 1414142122274,
+        }
+        for i in range(1332)
+    ]
+
+    def run():
+        rp = make_membership(1)
+        rp.membership.update(changes)
+
+    rate = ops_per_sec(run, 0.2 if quick else 1.0)
+    return [
+        {"bench": "large-membership-update-1332", "value": round(rate, 2),
+         "unit": "ops/sec"}
+    ]
+
+
+def bench_hashring(quick: bool) -> List[dict]:
+    # benchmarks/add-remove-hashring.js:36-82
+    from ringpop_tpu.models.ring.host import HashRing
+
+    servers = ["10.2.%d.%d:9000" % (i // 256, i % 256) for i in range(1000)]
+
+    def individual():
+        ring = HashRing()
+        for s in servers:
+            ring.add_server(s)
+        for s in servers:
+            ring.remove_server(s)
+
+    def bulk():
+        ring = HashRing()
+        ring.add_remove_servers(servers, [])
+        ring.add_remove_servers([], servers)
+
+    t = 0.2 if quick else 1.0
+    return [
+        {"bench": "hashring-add-remove-1000-individual",
+         "value": round(ops_per_sec(individual, t), 2), "unit": "ops/sec"},
+        {"bench": "hashring-add-remove-1000-bulk",
+         "value": round(ops_per_sec(bulk, t), 2), "unit": "ops/sec"},
+    ]
+
+
+def bench_find_member(quick: bool) -> List[dict]:
+    # benchmarks/find-member-by-address.js:31-49 (1 of 1000)
+    rp = make_membership(1000)
+    addr = "10.0.1.200:9000"
+
+    def run():
+        assert rp.membership.find_member_by_address(addr) is not None
+
+    rate = ops_per_sec(run, 0.2 if quick else 1.0)
+    return [
+        {"bench": "find-member-by-address-1000", "value": round(rate, 1),
+         "unit": "ops/sec"}
+    ]
+
+
+def bench_join_response_merge(quick: bool) -> List[dict]:
+    # benchmarks/join-response-merge.js:30-60 (3 x 1000-member responses,
+    # same vs different checksums)
+    from ringpop_tpu.gossip.join_response_merge import merge_join_responses
+    from tests.lib.fixtures import RingpopFixture
+
+    rp = RingpopFixture()
+    members = [
+        {
+            "address": "10.3.%d.%d:9000" % (i // 256, i % 256),
+            "status": "alive",
+            "incarnationNumber": 1414142122274 + i,
+        }
+        for i in range(1000)
+    ]
+    same = [{"checksum": 1, "members": members} for _ in range(3)]
+    diff = [{"checksum": k, "members": members} for k in range(3)]
+    t = 0.2 if quick else 1.0
+    return [
+        {"bench": "join-response-merge-3x1000-same-checksum",
+         "value": round(ops_per_sec(lambda: merge_join_responses(rp, same), t), 1),
+         "unit": "ops/sec"},
+        {"bench": "join-response-merge-3x1000-diff-checksum",
+         "value": round(ops_per_sec(lambda: merge_join_responses(rp, diff), t), 1),
+         "unit": "ops/sec"},
+    ]
+
+
+def bench_stat_keys(quick: bool) -> List[dict]:
+    # bench_ringpop_stat_cached_keys.js / bench_ringpop_stat_new_keys.js
+    from ringpop_tpu.api.ringpop import Ringpop
+
+    rp = Ringpop("bench", "127.0.0.1:3000")
+    t = 0.2 if quick else 1.0
+
+    def cached():
+        rp.stat("increment", "bench-key")
+
+    counter = [0]
+
+    def uncached():
+        counter[0] += 1
+        rp.stat("increment", "bench-key-%d" % counter[0])
+
+    return [
+        {"bench": "stat-cached-keys",
+         "value": round(ops_per_sec(cached, t), 1), "unit": "ops/sec"},
+        {"bench": "stat-new-keys",
+         "value": round(ops_per_sec(uncached, t), 1), "unit": "ops/sec"},
+    ]
+
+
+BENCHES: Dict[str, Callable[[bool], List[dict]]] = {
+    "compute-checksum": bench_compute_checksum,
+    "large-membership-update": bench_large_membership_update,
+    "hashring": bench_hashring,
+    "find-member": bench_find_member,
+    "join-response-merge": bench_join_response_merge,
+    "stat-keys": bench_stat_keys,
+}
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(prog="micro-bench")
+    p.add_argument("--bench", choices=sorted(BENCHES), help="run just one")
+    p.add_argument("--quick", action="store_true", help="short timing windows")
+    args = p.parse_args(argv)
+    names = [args.bench] if args.bench else sorted(BENCHES)
+    for name in names:
+        for result in BENCHES[name](args.quick):
+            print(json.dumps(result), flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    # standalone run: host-only benchmarks, no JAX/TPU init needed.  (Do
+    # NOT set this at module level: importing this file inside a process
+    # that also uses the JAX engine would silently disable x64 mode.)
+    os.environ.setdefault("RINGPOP_TPU_NO_X64", "1")
+    sys.exit(main())

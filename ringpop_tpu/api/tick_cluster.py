@@ -1,0 +1,384 @@
+"""tick-cluster: operator harness for an N-node cluster
+(scripts/tick-cluster.js rebuilt) with two interchangeable backends.
+
+- ``live`` — spawns N real node processes (``python -m ringpop_tpu.api.cli``)
+  and drives them over the admin endpoints, with genuine SIGKILL / SIGSTOP /
+  SIGCONT fault injection (tick-cluster.js:351-470).
+- ``jax-sim`` — the same command surface against the batched device
+  simulator (:class:`~ringpop_tpu.models.sim.cluster.SimCluster`), the
+  ``backend:'jax-sim'`` adapter of the BASELINE north star.
+
+Commands (tick-cluster.js:249-330 key menu): ``tick`` runs one protocol
+period on every live node and prints nodes GROUPED BY MEMBERSHIP CHECKSUM —
+the convergence view (tick-cluster.js:87-114) — ``join`` re-joins all,
+``kill i`` / ``suspend i`` / ``revive i`` inject faults, ``stats`` dumps
+protocol stats.
+
+``generate_hosts`` mirrors scripts/generate-hosts.js:23-58.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+from typing import Any, Dict, List, Optional
+
+_PKG_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def generate_hosts(
+    path: str, n: int, base_port: int = 3000, host: str = "127.0.0.1"
+) -> List[str]:
+    """Write a hosts.json bootstrap file (scripts/generate-hosts.js:23-58)."""
+    hosts = ["%s:%d" % (host, base_port + i) for i in range(n)]
+    with open(path, "w") as f:
+        json.dump(hosts, f)
+    return hosts
+
+
+class LiveBackend:
+    """N real node processes on 127.0.0.1, driven via admin endpoints."""
+
+    def __init__(
+        self,
+        n: int,
+        base_port: int = 3000,
+        app: str = "ringpop",
+        hosts_file: Optional[str] = None,
+    ):
+        import tempfile
+
+        from ringpop_tpu.api.client import RingpopClient
+
+        self.n = n
+        self.app = app
+        if hosts_file is None:
+            fd, hosts_file = tempfile.mkstemp(
+                prefix="ringpop-hosts-", suffix=".json"
+            )
+            os.close(fd)
+        self.hosts_file = hosts_file
+        self.hosts = generate_hosts(hosts_file, n, base_port)
+        self.procs: Dict[str, Optional[subprocess.Popen]] = {}
+        self.suspended: Dict[str, bool] = {}
+        self.client = RingpopClient(timeout_s=5.0)
+
+    def start(self, startup_timeout_s: float = 30.0) -> None:
+        for hp in self.hosts:
+            self._spawn(hp)
+        deadline = time.time() + startup_timeout_s
+        for hp in self.hosts:
+            while time.time() < deadline:
+                try:
+                    self.client.health(hp)
+                    break
+                except Exception:
+                    time.sleep(0.1)
+            else:
+                raise RuntimeError("node %s never became healthy" % hp)
+
+    def _spawn(self, host_port: str) -> None:
+        env = dict(
+            os.environ,
+            RINGPOP_TPU_NO_X64="1",  # node proc is host-only: no JAX init
+            JAX_PLATFORMS="cpu",
+            PYTHONPATH=os.pathsep.join(
+                [p for p in (_PKG_ROOT, os.environ.get("PYTHONPATH")) if p]
+            ),
+        )
+        self.procs[host_port] = subprocess.Popen(
+            [
+                sys.executable,
+                "-m",
+                "ringpop_tpu.api.cli",
+                "--listen",
+                host_port,
+                "--hosts",
+                self.hosts_file,
+                "--app",
+                self.app,
+                "--quiet",
+            ],
+            env=env,
+            stdout=subprocess.DEVNULL,
+            stderr=subprocess.DEVNULL,
+        )
+        self.suspended[host_port] = False
+
+    # -- command surface --------------------------------------------------
+
+    def tick_all(self) -> Dict[str, Optional[int]]:
+        """One gossip period per live node; returns host -> checksum
+        (None = unreachable), the '/admin/tick' sweep
+        (tick-cluster.js:87-114)."""
+        out: Dict[str, Optional[int]] = {}
+        for hp in self.hosts:
+            try:
+                out[hp] = self.client.admin_gossip_tick(hp)["checksum"]
+            except Exception:
+                out[hp] = None
+        return out
+
+    def join_all(self) -> None:
+        for hp in self.hosts:
+            try:
+                self.client.admin_member_join(hp)
+            except Exception:
+                pass
+
+    def stats_all(self) -> Dict[str, Any]:
+        out = {}
+        for hp in self.hosts:
+            try:
+                out[hp] = self.client.admin_stats(hp)
+            except Exception:
+                out[hp] = None
+        return out
+
+    def kill(self, i: int) -> None:
+        hp = self.hosts[i]
+        proc = self.procs.get(hp)
+        if proc is not None and proc.poll() is None:
+            proc.send_signal(signal.SIGKILL)
+            proc.wait(5.0)
+        self.procs[hp] = None
+
+    def suspend(self, i: int) -> None:
+        hp = self.hosts[i]
+        proc = self.procs.get(hp)
+        if proc is not None and proc.poll() is None:
+            proc.send_signal(signal.SIGSTOP)
+            self.suspended[hp] = True
+
+    def revive(self, i: int) -> None:
+        """SIGCONT a suspended proc; respawn a killed one
+        (tick-cluster.js:417-429)."""
+        hp = self.hosts[i]
+        proc = self.procs.get(hp)
+        if proc is not None and self.suspended.get(hp):
+            proc.send_signal(signal.SIGCONT)
+            self.suspended[hp] = False
+        elif proc is None or proc.poll() is not None:
+            self._spawn(hp)
+
+    def destroy(self) -> None:
+        self.client.destroy()
+        for hp, proc in self.procs.items():
+            if proc is not None and proc.poll() is None:
+                if self.suspended.get(hp):
+                    proc.send_signal(signal.SIGCONT)
+                proc.terminate()
+        for proc in self.procs.values():
+            if proc is not None:
+                try:
+                    proc.wait(5.0)
+                except subprocess.TimeoutExpired:
+                    proc.kill()
+
+
+class JaxSimBackend:
+    """The same command surface over the batched device simulator."""
+
+    def __init__(self, n: int, base_port: int = 3000, **sim_kw):
+        from ringpop_tpu.models.sim.cluster import SimCluster, default_addresses
+
+        self.n = n
+        self.hosts = default_addresses(n, base_port=base_port)
+        self.sim = SimCluster(n=n, addresses=self.hosts, **sim_kw)
+        self._dead: set = set()
+        self._suspended: set = set()
+
+    def start(self) -> None:
+        self.sim.bootstrap()
+
+    def tick_all(self) -> Dict[str, Optional[int]]:
+        self.sim.step()
+        cs = self.sim.checksums()
+        import numpy as np
+
+        alive = np.asarray(self.sim.state.proc_alive & self.sim.state.ready)
+        return {
+            hp: (int(cs[i]) if alive[i] else None)
+            for i, hp in enumerate(self.hosts)
+        }
+
+    def join_all(self) -> None:
+        self.sim.bootstrap()
+
+    def stats_all(self) -> Dict[str, Any]:
+        import numpy as np
+
+        alive = np.asarray(self.sim.state.proc_alive)
+        return {
+            hp: {"membership": self.sim.membership_of(i)}
+            for i, hp in enumerate(self.hosts)
+            if alive[i]
+        }
+
+    def kill(self, i: int) -> None:
+        self._dead.add(i)
+        self._suspended.discard(i)  # kill trumps an earlier suspend
+        self.sim.kill([i])
+
+    def suspend(self, i: int) -> None:
+        self._suspended.add(i)
+        self.sim.suspend([i])
+
+    def revive(self, i: int) -> None:
+        if i in self._suspended:
+            self._suspended.discard(i)
+            self.sim.resume([i])
+        else:
+            self._dead.discard(i)
+            self.sim.revive([i])
+
+    def destroy(self) -> None:
+        pass
+
+
+class TickCluster:
+    """Backend-agnostic driver with the tick-cluster command surface."""
+
+    def __init__(self, backend):
+        self.backend = backend
+
+    @staticmethod
+    def create(backend: str, n: int, **kw) -> "TickCluster":
+        if backend == "live":
+            return TickCluster(LiveBackend(n, **kw))
+        if backend == "jax-sim":
+            return TickCluster(JaxSimBackend(n, **kw))
+        raise ValueError("unknown backend %r (live | jax-sim)" % backend)
+
+    def start(self) -> None:
+        self.backend.start()
+
+    def checksum_groups(self) -> Dict[Any, List[str]]:
+        """host lists grouped by checksum; key None = unreachable/dead."""
+        groups: Dict[Any, List[str]] = {}
+        for hp, cs in self.backend.tick_all().items():
+            groups.setdefault(cs, []).append(hp)
+        return groups
+
+    def format_groups(self, groups: Optional[Dict[Any, List[str]]] = None) -> str:
+        """The tick-cluster convergence display (tick-cluster.js:87-114)."""
+        if groups is None:
+            groups = self.checksum_groups()
+        lines = []
+        for cs, hosts in sorted(
+            groups.items(), key=lambda kv: (kv[0] is None, str(kv[0]))
+        ):
+            label = "dead" if cs is None else ("%08x" % (cs & 0xFFFFFFFF))
+            lines.append("  %s  %d node(s): %s" % (label, len(hosts), " ".join(hosts)))
+        n_groups = sum(1 for cs in groups if cs is not None)
+        lines.append(
+            "  -> %s"
+            % ("CONVERGED" if n_groups <= 1 else "%d checksum groups" % n_groups)
+        )
+        return "\n".join(lines)
+
+    def converged(self) -> bool:
+        groups = self.checksum_groups()
+        return sum(1 for cs in groups if cs is not None) <= 1
+
+    def tick_until_converged(self, max_ticks: int = 120) -> int:
+        for t in range(max_ticks):
+            if self.converged():
+                return t + 1
+        raise RuntimeError("no convergence after %d ticks" % max_ticks)
+
+    def run_command(self, line: str) -> str:
+        """Scriptable command surface (mirrors the key menu,
+        tick-cluster.js:249-330)."""
+        parts = line.strip().split()
+        if not parts:
+            return ""
+        cmd, args = parts[0], parts[1:]
+        if cmd in ("t", "tick"):
+            return self.format_groups()
+        if cmd in ("j", "join"):
+            self.backend.join_all()
+            return "join sent to all nodes"
+        if cmd in ("k", "kill"):
+            i = int(args[0])
+            self.backend.kill(i)
+            return "killed %s" % self.backend.hosts[i]
+        if cmd in ("l", "suspend"):
+            i = int(args[0])
+            self.backend.suspend(i)
+            return "suspended %s" % self.backend.hosts[i]
+        if cmd in ("K", "revive"):
+            i = int(args[0])
+            self.backend.revive(i)
+            return "revived %s" % self.backend.hosts[i]
+        if cmd in ("s", "stats"):
+            return json.dumps(self.backend.stats_all(), default=str)[:2000]
+        if cmd in ("q", "quit"):
+            raise EOFError
+        return "commands: tick|join|kill i|suspend i|revive i|stats|quit"
+
+    def interactive(self, stdin=None, stdout=None) -> None:
+        stdin = stdin or sys.stdin
+        stdout = stdout or sys.stdout
+        stdout.write(
+            "tick-cluster [%s] %d nodes. Commands: t(ick) j(oin) "
+            "k(ill) i, l/suspend i, K/revive i, s(tats), q(uit)\n"
+            % (type(self.backend).__name__, len(self.backend.hosts))
+        )
+        while True:
+            stdout.write("> ")
+            stdout.flush()
+            line = stdin.readline()
+            if not line:
+                break
+            try:
+                out = self.run_command(line)
+            except EOFError:
+                break
+            except Exception as e:
+                out = "error: %s" % e
+            if out:
+                stdout.write(out + "\n")
+
+    def destroy(self) -> None:
+        self.backend.destroy()
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        prog="tick-cluster",
+        description="ringpop-tpu cluster harness (scripts/tick-cluster.js)",
+    )
+    p.add_argument("-n", type=int, default=5, help="number of nodes")
+    p.add_argument(
+        "--backend", choices=("live", "jax-sim"), default="live"
+    )
+    p.add_argument("--base-port", type=int, default=3000)
+    p.add_argument(
+        "--gen-hosts",
+        metavar="PATH",
+        help="only write a hosts.json and exit (scripts/generate-hosts.js)",
+    )
+    args = p.parse_args(argv)
+
+    if args.gen_hosts:
+        hosts = generate_hosts(args.gen_hosts, args.n, args.base_port)
+        print(json.dumps(hosts))
+        return 0
+
+    tc = TickCluster.create(args.backend, args.n, base_port=args.base_port)
+    try:
+        tc.start()
+        tc.interactive()
+    finally:
+        tc.destroy()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,93 @@
+"""Array-state checkpointing for long simulator runs (SURVEY §5.4).
+
+The reference persists nothing — a restarted node rebuilds via join
+full-sync (server/protocol/join.js:131) — but multi-minute 100k/1M-node
+sweeps deserve kill-and-resume.  Any engine state (``SimState``,
+``ScalableState`` — any NamedTuple of arrays) round-trips through one
+``.npz`` file; resuming from a checkpoint continues the exact trajectory
+bit-for-bit (the engines are deterministic pure functions of state).
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any, Optional, Type, TypeVar
+
+import jax.numpy as jnp
+import numpy as np
+
+T = TypeVar("T", bound=tuple)
+
+_FORMAT_KEY = "__ringpop_tpu_state__"
+_PARAMS_KEY = "__ringpop_tpu_params__"
+_FORMAT_VERSION = 1
+
+
+def save_state(path: str, state: Any, params: Any = None) -> None:
+    """Write a NamedTuple-of-arrays engine state to ``path``.
+
+    ``params`` (the engine's SimParams/ScalableParams NamedTuple) is stored
+    alongside so a resume can verify it runs under the same protocol
+    constants.  The literal path is used — no silent ``.npz`` suffixing —
+    so ``save(p)`` / ``load(p)`` always round-trip.
+    """
+    fields = getattr(state, "_fields", None)
+    if fields is None:
+        raise TypeError("state must be a NamedTuple of arrays")
+    arrays = {name: np.asarray(getattr(state, name)) for name in fields}
+    arrays[_FORMAT_KEY] = np.array(
+        [type(state).__name__, str(_FORMAT_VERSION)]
+    )
+    if params is not None:
+        arrays[_PARAMS_KEY] = np.array(
+            [json.dumps(dict(params._asdict()), sort_keys=True)]
+        )
+    with open(path, "wb") as f:
+        np.savez(f, **arrays)
+
+
+def load_state(path: str, state_cls: Type[T], params: Any = None) -> T:
+    """Rebuild ``state_cls`` from a checkpoint written by ``save_state``.
+
+    Mismatched fields (older engine revision) or — when both sides provide
+    them — mismatched params raise rather than resuming a silently wrong
+    trajectory.
+    """
+    with np.load(path, allow_pickle=False) as data:
+        meta = data.get(_FORMAT_KEY)
+        if meta is None:
+            raise ValueError("%s is not a ringpop_tpu checkpoint" % path)
+        saved_name = str(meta[0])
+        if saved_name != state_cls.__name__:
+            raise ValueError(
+                "checkpoint holds %s, expected %s" % (saved_name, state_cls.__name__)
+            )
+        if params is not None and _PARAMS_KEY in data.files:
+            saved_params = json.loads(str(data[_PARAMS_KEY][0]))
+            current = json.loads(
+                json.dumps(dict(params._asdict()), sort_keys=True)
+            )
+            if saved_params != current:
+                diff = {
+                    k: (saved_params.get(k), current.get(k))
+                    for k in set(saved_params) | set(current)
+                    if saved_params.get(k) != current.get(k)
+                }
+                raise ValueError(
+                    "checkpoint params differ from the resuming engine's "
+                    "(saved, current): %r" % diff
+                )
+        missing = [f for f in state_cls._fields if f not in data.files]
+        extra = [
+            f
+            for f in data.files
+            if f not in state_cls._fields and f not in (_FORMAT_KEY, _PARAMS_KEY)
+        ]
+        if missing or extra:
+            raise ValueError(
+                "checkpoint fields do not match %s (missing=%r, extra=%r)"
+                % (state_cls.__name__, missing, extra)
+            )
+        return state_cls(
+            **{f: jnp.asarray(data[f]) for f in state_cls._fields}
+        )

@@ -43,6 +43,8 @@ class EventSchedule:
     revive: np.ndarray = None
     join: np.ndarray = None
     partition: np.ndarray = None  # [T, N] int32
+    resume: np.ndarray = None  # [T, N] bool, or None (no SIGCONTs)
+    leave: np.ndarray = None  # [T, N] bool, or None (no graceful leaves)
 
     def __post_init__(self):
         T, n = self.ticks, self.n
@@ -56,11 +58,15 @@ class EventSchedule:
             self.partition = np.full((T, n), -1, np.int32)  # -1 keeps current
 
     def as_inputs(self) -> engine.TickInputs:
+        # resume/leave stay None (not dense zeros) when unused, keeping the
+        # pytree structure of plain inputs — no jit retrace
         return engine.TickInputs(
             kill=jnp.asarray(self.kill),
             revive=jnp.asarray(self.revive),
             join=jnp.asarray(self.join),
             partition=jnp.asarray(self.partition),
+            resume=None if self.resume is None else jnp.asarray(self.resume),
+            leave=None if self.leave is None else jnp.asarray(self.leave),
         )
 
 
@@ -143,6 +149,33 @@ class SimCluster:
         rv[list(indices)] = True
         return self.step(inputs._replace(revive=jnp.asarray(rv)))
 
+    def suspend(self, indices: Sequence[int]) -> engine.TickMetrics:
+        """SIGSTOP: process stops answering but keeps its state (the
+        tick-cluster 'l' key)."""
+        return self.kill(indices)
+
+    def resume(self, indices: Sequence[int]) -> engine.TickMetrics:
+        """SIGCONT: suspended process returns with pre-stop state intact."""
+        inputs = engine.TickInputs.quiet(self.params.n)
+        rs = np.zeros(self.params.n, bool)
+        rs[list(indices)] = True
+        return self.step(inputs._replace(resume=jnp.asarray(rs)))
+
+    def leave(self, indices: Sequence[int]) -> engine.TickMetrics:
+        """Graceful leave (membership.makeLeave + gossip stop)."""
+        inputs = engine.TickInputs.quiet(self.params.n)
+        lv = np.zeros(self.params.n, bool)
+        lv[list(indices)] = True
+        return self.step(inputs._replace(leave=jnp.asarray(lv)))
+
+    def rejoin(self, indices: Sequence[int]) -> engine.TickMetrics:
+        """Rejoin left nodes: alive with fresh incarnation, gossip restart
+        (server/admin/member.js:44-51)."""
+        inputs = engine.TickInputs.quiet(self.params.n)
+        j = np.zeros(self.params.n, bool)
+        j[list(indices)] = True
+        return self.step(inputs._replace(join=jnp.asarray(j)))
+
     def partition(self, groups: Sequence[int]) -> engine.TickMetrics:
         inputs = engine.TickInputs.quiet(self.params.n)
         return self.step(
@@ -187,3 +220,15 @@ class SimCluster:
             "%s%s%d" % (m["address"], m["status"], m["incarnationNumber"])
             for m in self.membership_of(i)
         )
+
+    # -- checkpoint/resume (SURVEY §5.4) ---------------------------------
+
+    def save(self, path: str) -> None:
+        from ringpop_tpu.models.sim.checkpoint import save_state
+
+        save_state(path, self.state, self.params)
+
+    def load(self, path: str) -> None:
+        from ringpop_tpu.models.sim.checkpoint import load_state
+
+        self.state = load_state(path, engine.SimState, self.params)

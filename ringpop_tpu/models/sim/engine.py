@@ -126,13 +126,27 @@ class SimState(NamedTuple):
 class TickInputs(NamedTuple):
     """Per-tick event-schedule inputs (the fault-injection plane)."""
 
-    kill: jax.Array  # [N] bool — SIGKILL this tick (proc_alive -> False)
+    kill: jax.Array  # [N] bool — SIGKILL/SIGSTOP this tick (proc_alive off)
     revive: jax.Array  # [N] bool — restart this tick (fresh state, rejoin)
     join: jax.Array  # [N] bool — bootstrap/join this tick
     partition: jax.Array  # [N] int32 — group assignment; -1 keeps current
+    # [N] bool SIGCONT: bring the process back WITHOUT the state reset that
+    # ``revive`` performs (tick-cluster 'l'/SIGSTOP + revive of a suspended
+    # proc, scripts/tick-cluster.js:431-470); None = all-false
+    resume: Optional[jax.Array] = None
+    # [N] bool graceful leave: the node marks ITSELF status=leave at its
+    # current incarnation and stops gossiping (membership.makeLeave +
+    # LocalMemberLeaveEvent -> gossip.stop, on_membership_event.js:32-41).
+    # The process stays up and keeps answering pings, so the leave change
+    # disseminates via its ping responses.  A later `join` input on a left
+    # node rejoins: alive with a fresh incarnation, gossip restarted
+    # (server/admin/member.js:44-51).  None = all-false
+    leave: Optional[jax.Array] = None
 
     @staticmethod
     def quiet(n: int) -> "TickInputs":
+        # resume=None (not a dense array) keeps the pytree structure equal
+        # to plain inputs — no jit retrace
         return TickInputs(
             kill=jnp.zeros(n, bool),
             revive=jnp.zeros(n, bool),
@@ -341,6 +355,9 @@ def tick(
 
     # ---- phase 0: fault-injection plane -------------------------------
     proc_alive = (state.proc_alive & ~inputs.kill) | inputs.revive
+    if inputs.resume is not None:
+        # SIGCONT: process returns with its pre-stop state intact
+        proc_alive = proc_alive | inputs.resume
     partition = jnp.where(inputs.partition >= 0, inputs.partition, state.partition)
     # revive resets a node to fresh state (process restart)
     rv = inputs.revive & ~state.proc_alive
@@ -351,6 +368,8 @@ def tick(
     ready = jnp.where(rv, False, state.ready)
     ch_active = jnp.where(rv[:, None], False, state.ch_active)
     susp_deadline = jnp.where(rv[:, None], -1, state.susp_deadline)
+    # a restarted process gossips again even if it had left before dying
+    gossip_on = state.gossip_on | rv
 
     state = state._replace(
         proc_alive=proc_alive,
@@ -361,7 +380,58 @@ def tick(
         ready=ready,
         ch_active=ch_active,
         susp_deadline=susp_deadline,
+        gossip_on=gossip_on,
         tick_index=tick_next,
+    )
+
+    # ---- phase 0.5: graceful leave ------------------------------------
+    # the node marks itself leave at its CURRENT incarnation (makeLeave,
+    # membership/index.js:192), records the change, and stops gossiping;
+    # the change disseminates via its ping responses
+    if inputs.leave is not None:
+        diag = jnp.arange(n)
+        self_status = state.status[diag, diag]
+        lv = (
+            inputs.leave
+            & state.proc_alive
+            & state.ready
+            & (self_status != LEAVE)
+        )
+        lv_mask = lv[:, None] & is_self
+        own_inc = state.inc[diag, diag]
+        state = state._replace(
+            status=jnp.where(lv_mask, LEAVE, state.status),
+            gossip_on=state.gossip_on & ~lv,
+            ch_active=state.ch_active | lv_mask,
+            ch_status=jnp.where(lv_mask, LEAVE, state.ch_status),
+            ch_inc=jnp.where(lv_mask, own_inc[:, None], state.ch_inc),
+            ch_source=jnp.where(lv_mask, node, state.ch_source),
+            ch_source_inc=jnp.where(
+                lv_mask, own_inc[:, None], state.ch_source_inc
+            ),
+            ch_pb=jnp.where(lv_mask, 0, state.ch_pb),
+        )
+
+    # rejoin of a left node: alive with a fresh incarnation, gossip back on
+    # (server/admin/member.js:44-51) — no cluster-join round needed
+    diag = jnp.arange(n)
+    rejoin = (
+        inputs.join
+        & state.proc_alive
+        & state.ready
+        & (state.status[diag, diag] == LEAVE)
+    )
+    rj_mask = rejoin[:, None] & is_self
+    state = state._replace(
+        status=jnp.where(rj_mask, ALIVE, state.status),
+        inc=jnp.where(rj_mask, now_ms, state.inc),
+        gossip_on=state.gossip_on | rejoin,
+        ch_active=state.ch_active | rj_mask,
+        ch_status=jnp.where(rj_mask, ALIVE, state.ch_status),
+        ch_inc=jnp.where(rj_mask, now_ms, state.ch_inc),
+        ch_source=jnp.where(rj_mask, node, state.ch_source),
+        ch_source_inc=jnp.where(rj_mask, now_ms, state.ch_source_inc),
+        ch_pb=jnp.where(rj_mask, 0, state.ch_pb),
     )
 
     # ---- phase 1: join/bootstrap --------------------------------------

@@ -155,3 +155,15 @@ class ScalableCluster:
     def ring_checksum(self) -> int:
         """Rebuild the ring from current truth, return its digest."""
         return int(self._ring_checksum(self.state.truth_status, self.state.proc_alive))
+
+    # -- checkpoint/resume (SURVEY §5.4) ---------------------------------
+
+    def save(self, path: str) -> None:
+        from ringpop_tpu.models.sim.checkpoint import save_state
+
+        save_state(path, self.state, self.params)
+
+    def load(self, path: str) -> None:
+        from ringpop_tpu.models.sim.checkpoint import load_state
+
+        self.state = load_state(path, es.ScalableState, self.params)

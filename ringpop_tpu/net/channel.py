@@ -176,6 +176,12 @@ class Channel:
         try:
             while True:
                 sock, _ = self._server_sock.accept()
+                # destroy() may have raced with the blocking accept(2): the
+                # kernel listener completes handshakes until the acceptor
+                # wakes, so a "dead" node must refuse, not serve
+                if self.destroyed:
+                    sock.close()
+                    return
                 sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
                 with self._lock:
                     self._inbound.append(_Conn(sock, self))
@@ -183,6 +189,9 @@ class Channel:
             pass
 
     def _dispatch(self, conn: _Conn, msg: dict) -> None:
+        if self.destroyed:
+            conn.close()
+            return
         endpoint = msg.get("endpoint")
         handler = self.handlers.get(endpoint)
         res = {"id": msg["id"], "type": "res"}
@@ -214,6 +223,15 @@ class Channel:
                 return conn
         host, _, port = host_port.rpartition(":")
         sock = socket.create_connection((host, int(port)), timeout=5.0)
+        # TCP self-connect guard: connecting to a dead peer's (ephemeral)
+        # port can pick that very port as the SOURCE and connect the socket
+        # to itself — the "peer" then answers with OUR handlers, e.g. a
+        # destroyed node appearing to answer pings.  Treat as dead peer.
+        if sock.getsockname() == sock.getpeername():
+            sock.close()
+            raise ConnectionRefusedError(
+                "self-connection to %s (peer is down)" % host_port
+            )
         sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
         sock.settimeout(None)
         conn = _Conn(sock, self)
@@ -267,6 +285,14 @@ class Channel:
     def destroy(self) -> None:
         self.destroyed = True
         if self._server_sock is not None:
+            try:
+                # shutdown wakes an acceptor blocked in accept(2); closing
+                # alone leaves the kernel listener accepting into the
+                # backlog while the thread sleeps (a destroyed node would
+                # keep answering pings and refute its own suspicion)
+                self._server_sock.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
             try:
                 self._server_sock.close()
             except OSError:

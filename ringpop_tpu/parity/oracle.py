@@ -111,6 +111,9 @@ class _Node:
         self.ctx = _Ctx(addr)
         self.ctx._now_ms = now_ms
         self.membership = Membership(self.ctx, rng=random.Random(idx))
+        # the Member state machine reaches back through the ringpop context
+        # for LocalMemberLeaveEvent emission (host.py Member.evaluate_update)
+        self.ctx.membership = self.membership
         self.membership.make_alive(addr, now_ms)
         self.changes: Dict[int, _Change] = {}
         self.susp: Dict[int, int] = {}  # subject -> deadline tick
@@ -207,6 +210,9 @@ class OracleCluster:
             inputs.get("partition", np.full(n, -1, np.int32)), np.int32
         )
 
+        leave_in = np.asarray(inputs.get("leave", np.zeros(n, bool)), bool)
+        resume_in = np.asarray(inputs.get("resume", np.zeros(n, bool)), bool)
+
         tick_next = self.tick_index + 1
         now_ms = p.epoch_ms + tick_next * p.period_ms
         for node in self.nodes:
@@ -214,14 +220,58 @@ class OracleCluster:
 
         # ---- phase 0: fault plane --------------------------------------
         prev_alive = self.proc_alive.copy()
-        self.proc_alive = (self.proc_alive & ~kill) | revive
+        self.proc_alive = (self.proc_alive & ~kill) | revive | resume_in
         self.partition = np.where(part_in >= 0, part_in, self.partition)
         rv = revive & ~prev_alive
         for i in np.flatnonzero(rv):
             self.nodes[i] = _Node(self, int(i), now_ms)
             self.nodes[i].ctx._now_ms = now_ms
             self.ready[i] = False
+            self.gossip_on[i] = True
         self.tick_index = tick_next
+
+        # ---- phase 0.5: graceful leave + rejoin-from-leave -------------
+        # (engine phase 0.5; makeLeave at current incarnation, gossip off;
+        # rejoin = alive with fresh incarnation, gossip back on)
+        for i in np.flatnonzero(leave_in & self.proc_alive & self.ready):
+            mem = self.nodes[i].membership
+            m = mem.find_member_by_address(self.addresses[i])
+            if m is None or m.status == Status.leave:
+                continue
+            self._apply(
+                i,
+                [
+                    {
+                        "address": self.addresses[i],
+                        "status": Status.leave,
+                        "incarnationNumber": m.incarnation_number,
+                        "source": self.addresses[i],
+                        "sourceIncarnationNumber": m.incarnation_number,
+                    }
+                ],
+                tick_next,
+            )
+            self.gossip_on[i] = False
+        for i in np.flatnonzero(join_in & self.proc_alive & self.ready):
+            m = self.nodes[i].membership.find_member_by_address(
+                self.addresses[i]
+            )
+            if m is None or m.status != Status.leave:
+                continue
+            self._apply(
+                i,
+                [
+                    {
+                        "address": self.addresses[i],
+                        "status": Status.alive,
+                        "incarnationNumber": now_ms,
+                        "source": self.addresses[i],
+                        "sourceIncarnationNumber": now_ms,
+                    }
+                ],
+                tick_next,
+            )
+            self.gossip_on[i] = True
 
         # ---- phase 1: join ----------------------------------------------
         joiner = (join_in | rv) & self.proc_alive & ~self.ready

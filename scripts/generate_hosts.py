@@ -1,0 +1,27 @@
+#!/usr/bin/env python3
+"""Write a hosts.json bootstrap file (reference: scripts/generate-hosts.js)."""
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from ringpop_tpu.api.tick_cluster import generate_hosts  # noqa: E402
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(prog="generate-hosts")
+    p.add_argument("-n", type=int, default=5, help="number of hosts")
+    p.add_argument("--base-port", type=int, default=3000)
+    p.add_argument("--host", default="127.0.0.1")
+    p.add_argument("--output", "-o", default="hosts.json")
+    args = p.parse_args(argv)
+    hosts = generate_hosts(args.output, args.n, args.base_port, args.host)
+    print(json.dumps(hosts))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,15 @@
+#!/usr/bin/env python3
+"""Operator entry point: interactive N-node cluster harness
+(reference: scripts/tick-cluster.js).  Thin wrapper over
+ringpop_tpu.api.tick_cluster — `--backend live` spawns real node
+processes; `--backend jax-sim` drives the batched device simulator."""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from ringpop_tpu.api.tick_cluster import main  # noqa: E402
+
+if __name__ == "__main__":
+    sys.exit(main())

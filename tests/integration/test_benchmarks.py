@@ -1,0 +1,43 @@
+"""Smoke tests: benchmark harnesses run and emit well-formed results."""
+
+import numpy as np
+import pytest
+
+from benchmarks.convergence_time import histogram, run_jax_sim, run_live
+from benchmarks.micro import BENCHES
+
+
+def test_histogram_fields():
+    h = histogram([5.0, 1.0, 3.0, 2.0, 4.0])
+    assert h["count"] == 5 and h["min"] == 1.0 and h["max"] == 5.0
+    assert h["mean"] == 3.0 and h["median"] == 3.0
+    assert h["p75"] == 4.0 and h["p99"] == 5.0
+    assert histogram([]) == {"count": 0}
+
+
+def test_convergence_jax_sim_single_node():
+    res = run_jax_sim("single-node-failure", n=12, cycles=2, seed=0)
+    assert res["histogram"]["count"] == 2
+    assert res["histogram"]["min"] >= 200  # at least one protocol period
+
+
+def test_convergence_jax_sim_half_cluster():
+    res = run_jax_sim("half-cluster-failure", n=12, cycles=1, seed=1)
+    assert res["histogram"]["count"] == 1
+
+
+@pytest.mark.slow
+def test_convergence_live_single_node():
+    res = run_live("single-node-failure", n=5, cycles=1, seed=0)
+    assert res["histogram"]["count"] == 1
+    assert res["histogram"]["min"] > 0
+
+
+@pytest.mark.parametrize("name", sorted(BENCHES))
+def test_micro_bench_smoke(name):
+    if name in ("hashring", "large-membership-update", "join-response-merge",
+                "compute-checksum"):
+        pytest.skip("heavier micro benches exercised via CLI, not CI")
+    for result in BENCHES[name](True):
+        assert result["value"] > 0
+        assert result["unit"] == "ops/sec"

@@ -1,0 +1,172 @@
+"""tick-cluster harness: both backends drive the same command surface
+(scripts/tick-cluster.js scope): convergence groups, kill/suspend/revive,
+CLI node processes, generate-hosts."""
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+import pytest
+
+from ringpop_tpu.api.tick_cluster import (
+    JaxSimBackend,
+    LiveBackend,
+    TickCluster,
+    generate_hosts,
+)
+
+BASE_PORT = 23100  # away from other suites' ephemeral ports
+
+
+def test_generate_hosts(tmp_path):
+    path = str(tmp_path / "hosts.json")
+    hosts = generate_hosts(path, 4, base_port=9000)
+    assert hosts == ["127.0.0.1:%d" % (9000 + i) for i in range(4)]
+    with open(path) as f:
+        assert json.load(f) == hosts
+
+
+def test_jax_sim_backend_commands():
+    tc = TickCluster.create("jax-sim", 8)
+    tc.start()
+    ticks = tc.tick_until_converged()
+    assert ticks >= 1 and tc.converged()
+
+    out = tc.run_command("k 3")
+    assert "killed" in out
+    # dead node drops out of the groups; cluster reconverges around it
+    for _ in range(60):
+        groups = tc.checksum_groups()
+        if None in groups and sum(1 for c in groups if c is not None) == 1:
+            break
+    groups = tc.checksum_groups()
+    assert groups.get(None) == [tc.backend.hosts[3]]
+
+    tc.run_command("K 3")  # revive: fresh state, rejoins
+    for _ in range(80):
+        if tc.converged() and None not in tc.checksum_groups():
+            break
+    assert tc.converged()
+
+    # suspend keeps state but stops participation; resume restores it
+    tc.run_command("l 2")
+    assert None in tc.checksum_groups()
+    tc.run_command("K 2")
+    for _ in range(60):
+        groups = tc.checksum_groups()
+        if None not in groups and tc.converged():
+            break
+    assert tc.converged() and None not in tc.checksum_groups()
+
+    display = tc.format_groups()
+    assert "CONVERGED" in display
+
+
+def test_jax_sim_stats_and_join():
+    tc = TickCluster.create("jax-sim", 4)
+    tc.start()
+    tc.tick_until_converged()
+    stats = tc.backend.stats_all()
+    assert len(stats) == 4
+    membership = stats[tc.backend.hosts[0]]["membership"]
+    assert len(membership) == 4
+    assert tc.run_command("j") == "join sent to all nodes"
+
+
+@pytest.mark.slow
+def test_live_backend_cluster(tmp_path):
+    """Real processes: spawn 4 CLI nodes, converge, SIGKILL one, SIGSTOP
+    another, revive both, reconverge (tick-cluster.js:351-470)."""
+    tc = TickCluster.create(
+        "live", 4, base_port=BASE_PORT, hosts_file=str(tmp_path / "hosts.json")
+    )
+    try:
+        tc.start()
+        for _ in range(120):
+            if tc.converged() and None not in tc.checksum_groups():
+                break
+            time.sleep(0.05)
+        assert tc.converged()
+
+        tc.backend.kill(1)
+        tc.backend.suspend(2)
+        deadline = time.time() + 60
+        while time.time() < deadline:
+            groups = tc.checksum_groups()
+            dead = set(groups.get(None, []))
+            if {tc.backend.hosts[1], tc.backend.hosts[2]} <= dead:
+                break
+            time.sleep(0.1)
+        groups = tc.checksum_groups()
+        assert {tc.backend.hosts[1], tc.backend.hosts[2]} <= set(
+            groups.get(None, [])
+        )
+
+        tc.backend.revive(1)  # respawn (was SIGKILLed)
+        tc.backend.revive(2)  # SIGCONT (was SIGSTOPped)
+        deadline = time.time() + 90
+        while time.time() < deadline:
+            groups = tc.checksum_groups()
+            if None not in groups and tc.converged():
+                break
+            time.sleep(0.2)
+        groups = tc.checksum_groups()
+        assert None not in groups, groups
+        assert tc.converged()
+    finally:
+        tc.destroy()
+
+
+@pytest.mark.slow
+def test_cli_single_node(tmp_path):
+    """The CLI bin starts, bootstraps a single-node cluster, answers
+    /health, and exits on SIGTERM (main.js:24-85)."""
+    hosts_file = str(tmp_path / "hosts.json")
+    hp = "127.0.0.1:%d" % (BASE_PORT + 50)
+    generate_hosts(hosts_file, 1, base_port=BASE_PORT + 50)
+    repo = os.path.dirname(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    )
+    env = dict(
+        os.environ,
+        RINGPOP_TPU_NO_X64="1",
+        JAX_PLATFORMS="cpu",
+        PYTHONPATH=repo,
+    )
+    proc = subprocess.Popen(
+        [
+            sys.executable,
+            "-m",
+            "ringpop_tpu.api.cli",
+            "--listen",
+            hp,
+            "--hosts",
+            hosts_file,
+            "--quiet",
+        ],
+        env=env,
+        stdout=subprocess.PIPE,
+        stderr=subprocess.DEVNULL,
+    )
+    try:
+        line = proc.stdout.readline().decode()
+        assert json.loads(line) == {"listening": hp, "ready": True}
+        from ringpop_tpu.api.client import RingpopClient
+
+        cl = RingpopClient()
+        assert cl.health(hp) == "ok"
+        status = cl.admin_gossip_status(hp)
+        assert status["status"] == "running"
+        cl.destroy()
+    finally:
+        proc.terminate()
+        assert proc.wait(10.0) == 0
+
+
+def test_cli_requires_listen_and_hosts():
+    from ringpop_tpu.api.cli import main
+
+    assert main([]) == 1
+    assert main(["--listen", "127.0.0.1:9"]) == 1

@@ -1,0 +1,100 @@
+"""Checkpoint/resume: kill a run mid-storm, resume, bitwise-equal
+trajectory (SURVEY §5.4)."""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from ringpop_tpu.models.sim import engine, engine_scalable as es
+from ringpop_tpu.models.sim.checkpoint import load_state, save_state
+from ringpop_tpu.ops import checksum_encode as ce
+
+
+def test_scalable_resume_bitwise_equal(tmp_path):
+    n = 256
+    params = es.ScalableParams(n=n, u=512, packet_loss=0.05, suspicion_ticks=5)
+    state = es.init_state(params, seed=3)
+    step = jax.jit(functools.partial(es.tick, params=params))
+    rng = np.random.default_rng(0)
+
+    def inputs_at(t):
+        kill = np.zeros(n, bool)
+        revive = np.zeros(n, bool)
+        if t % 7 == 0:
+            kill[rng.integers(0, n, 4)] = True  # deterministic per call order
+        return es.ChurnInputs(kill=jnp.asarray(kill), revive=jnp.asarray(revive))
+
+    # storm for 30 ticks, checkpoint, storm 30 more -> trajectory A
+    sched = [inputs_at(t) for t in range(60)]
+    for t in range(30):
+        state, _ = step(state, sched[t])
+    path = str(tmp_path / "storm.npz")
+    save_state(path, state)
+    cont = state
+    for t in range(30, 60):
+        cont, _ = step(cont, sched[t])
+
+    # resume from the checkpoint -> trajectory B must equal A bitwise
+    resumed = load_state(path, es.ScalableState)
+    for t in range(30, 60):
+        resumed, _ = step(resumed, sched[t])
+    for f in es.ScalableState._fields:
+        np.testing.assert_array_equal(
+            np.asarray(getattr(cont, f)), np.asarray(getattr(resumed, f)), f
+        )
+    np.testing.assert_array_equal(
+        np.asarray(es.compute_checksums(cont, params)),
+        np.asarray(es.compute_checksums(resumed, params)),
+    )
+
+
+def test_full_engine_resume_bitwise_equal(tmp_path):
+    n = 16
+    params = engine.SimParams(n=n, checksum_mode="fast")
+    universe = ce.Universe.from_addresses(
+        ["127.0.0.1:%d" % (3000 + i) for i in range(n)]
+    )
+    tick = jax.jit(lambda s, i: engine.tick(s, i, params, universe))
+    state = engine.init_state(params, seed=1)
+    join = engine.TickInputs.quiet(n)._replace(join=jnp.ones(n, bool))
+    state, _ = tick(state, join)
+    for _ in range(10):
+        state, _ = tick(state, engine.TickInputs.quiet(n))
+
+    path = str(tmp_path / "sim.npz")
+    save_state(path, state)
+    a = state
+    b = load_state(path, engine.SimState)
+    for _ in range(20):
+        a, _ = tick(a, engine.TickInputs.quiet(n))
+        b, _ = tick(b, engine.TickInputs.quiet(n))
+    for f in engine.SimState._fields:
+        np.testing.assert_array_equal(
+            np.asarray(getattr(a, f)), np.asarray(getattr(b, f)), f
+        )
+
+
+def test_checkpoint_rejects_wrong_class_and_fields(tmp_path):
+    params = es.ScalableParams(n=8, u=96)
+    state = es.init_state(params)
+    path = str(tmp_path / "s.npz")
+    save_state(path, state)
+    with pytest.raises(ValueError):
+        load_state(path, engine.SimState)
+    # non-checkpoint npz rejected
+    other = str(tmp_path / "other.npz")
+    np.savez(other, a=np.zeros(3))
+    with pytest.raises(ValueError):
+        load_state(other, es.ScalableState)
+    # same class round-trips
+    back = load_state(path, es.ScalableState)
+    for f in es.ScalableState._fields:
+        np.testing.assert_array_equal(
+            np.asarray(getattr(state, f)), np.asarray(getattr(back, f)), f
+        )
+        assert np.asarray(getattr(back, f)).dtype == np.asarray(
+            getattr(state, f)
+        ).dtype

@@ -121,3 +121,66 @@ def test_fake_timers_ordering():
     ft.advance(1.0)
     assert fired == ["b", "c"]
     assert ft.now_ms() > 1414142122274
+
+
+def test_self_connect_treated_as_dead_peer():
+    """Connecting to a freed ephemeral port can self-connect on localhost
+    (source port == destination port); the channel must classify that as
+    the peer being down, not answer requests with its own handlers."""
+    import socket
+
+    from ringpop_tpu.net.channel import Channel, ChannelError
+
+    # deliberately self-connect to prove the phenomenon this guards
+    s = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+    s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    try:
+        s.connect(("127.0.0.1", port))
+        assert s.getsockname() == s.getpeername()
+    finally:
+        s.close()
+
+    ch = Channel("127.0.0.1:0")
+    ch.listen()
+    try:
+        # repeatedly request a dead ephemeral target; without the guard a
+        # self-connect would make the request "succeed" via our own
+        # handlers — with it, every attempt is a clean ChannelError
+        ch.register("/echo", lambda head, body: (head, body))
+        dead = "127.0.0.1:%d" % port
+        for _ in range(5):
+            with pytest.raises(ChannelError):
+                ch.request(dead, "/echo", body={"x": 1}, timeout_s=1.0)
+    finally:
+        ch.destroy()
+
+
+def test_destroyed_channel_refuses_new_connections():
+    """destroy() must wake the blocked acceptor: otherwise the kernel
+    listener keeps completing handshakes and a 'dead' node goes on
+    answering requests (a destroyed cluster node would refute its own
+    suspicion forever)."""
+    import time
+
+    from ringpop_tpu.net.channel import Channel, ChannelError, RemoteError
+
+    server = Channel("127.0.0.1:0")
+    hp = server.listen()
+    server.register("/echo", lambda head, body: (head, body))
+    client = Channel("127.0.0.1:0")
+    client.listen()
+    try:
+        _, res = client.request(hp, "/echo", body="x", timeout_s=2.0)
+        assert res == "x"
+        server.destroy()
+        time.sleep(0.05)
+        for _ in range(20):
+            with pytest.raises((ChannelError, RemoteError)):
+                # fresh connection each time: the pooled one died with the
+                # server, and new handshakes must now be refused/ignored
+                client.request(hp, "/echo", body="y", timeout_s=0.5)
+    finally:
+        client.destroy()
+        server.destroy()

@@ -34,7 +34,7 @@ def run_lockstep(n, schedule, params=None, seed=0):
             **{
                 k: jax.numpy.asarray(v)
                 for k, v in ev.items()
-                if k in ("kill", "revive", "join", "partition")
+                if k in ("kill", "revive", "join", "partition", "leave", "resume")
             }
         )
         state, metrics = tick(state, inputs)
@@ -142,6 +142,41 @@ def test_churn_storm_n24():
         sched.append({"kill": kill, "revive": revive})
         sched += quiet(n, 9)
     sched += quiet(n, 45)
+    run_lockstep(n, sched)
+
+
+def test_leave_and_rejoin_n16():
+    n = 16
+    lv = np.zeros(n, bool)
+    lv[4] = True
+    rj = np.zeros(n, bool)
+    rj[4] = True
+    sched = (
+        join_all(n)
+        + quiet(n, 8)
+        + [{"leave": lv}]
+        + quiet(n, 25)
+        + [{"join": rj}]
+        + quiet(n, 25)
+    )
+    run_lockstep(n, sched)
+
+
+def test_suspend_resume_n16():
+    n = 16
+    kill = np.zeros(n, bool)
+    kill[6] = True
+    rs = np.zeros(n, bool)
+    rs[6] = True
+    # SIGSTOP (kill without reset) ... SIGCONT (resume keeps state)
+    sched = (
+        join_all(n)
+        + quiet(n, 6)
+        + [{"kill": kill}]
+        + quiet(n, 12)
+        + [{"resume": rs}]
+        + quiet(n, 40)
+    )
     run_lockstep(n, sched)
 
 
