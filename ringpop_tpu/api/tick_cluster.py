@@ -71,16 +71,20 @@ class LiveBackend:
     def start(self, startup_timeout_s: float = 30.0) -> None:
         for hp in self.hosts:
             self._spawn(hp)
-        deadline = time.time() + startup_timeout_s
+        # per-host deadline: nodes boot concurrently, so a slow first node
+        # must not consume the probe budget of the ones after it
         for hp in self.hosts:
-            while time.time() < deadline:
+            deadline = time.time() + startup_timeout_s
+            while True:
                 try:
                     self.client.health(hp)
                     break
                 except Exception:
+                    if time.time() >= deadline:
+                        raise RuntimeError(
+                            "node %s never became healthy" % hp
+                        )
                     time.sleep(0.1)
-            else:
-                raise RuntimeError("node %s never became healthy" % hp)
 
     def _spawn(self, host_port: str) -> None:
         env = dict(
@@ -242,10 +246,18 @@ class JaxSimBackend:
 
 
 class TickCluster:
-    """Backend-agnostic driver with the tick-cluster command surface."""
+    """Backend-agnostic driver with the tick-cluster command surface.
+
+    Stepping and inspection are separate: :meth:`tick` runs ONE protocol
+    round and caches the resulting host->checksum snapshot; the query
+    methods (:meth:`checksum_groups`, :meth:`converged`,
+    :meth:`format_groups`) read that snapshot without advancing the
+    cluster.
+    """
 
     def __init__(self, backend):
         self.backend = backend
+        self._snapshot: Optional[Dict[str, Optional[int]]] = None
 
     @staticmethod
     def create(backend: str, n: int, **kw) -> "TickCluster":
@@ -258,10 +270,19 @@ class TickCluster:
     def start(self) -> None:
         self.backend.start()
 
+    def tick(self) -> Dict[str, Optional[int]]:
+        """One gossip round on every live node; caches and returns the
+        host -> checksum snapshot (None = unreachable/dead)."""
+        self._snapshot = self.backend.tick_all()
+        return self._snapshot
+
     def checksum_groups(self) -> Dict[Any, List[str]]:
-        """host lists grouped by checksum; key None = unreachable/dead."""
+        """host lists grouped by checksum from the LAST snapshot (ticks
+        once only if no snapshot exists yet); key None = dead."""
+        if self._snapshot is None:
+            self.tick()
         groups: Dict[Any, List[str]] = {}
-        for hp, cs in self.backend.tick_all().items():
+        for hp, cs in self._snapshot.items():
             groups.setdefault(cs, []).append(hp)
         return groups
 
@@ -288,6 +309,7 @@ class TickCluster:
 
     def tick_until_converged(self, max_ticks: int = 120) -> int:
         for t in range(max_ticks):
+            self.tick()
             if self.converged():
                 return t + 1
         raise RuntimeError("no convergence after %d ticks" % max_ticks)
@@ -300,6 +322,7 @@ class TickCluster:
             return ""
         cmd, args = parts[0], parts[1:]
         if cmd in ("t", "tick"):
+            self.tick()
             return self.format_groups()
         if cmd in ("j", "join"):
             self.backend.join_all()

@@ -44,9 +44,14 @@ class HashRing(EventEmitter):
         self.checksum: Optional[int] = None
         # per-server replica hashes, keyed by name (uint32 [R])
         self._server_points: Dict[str, np.ndarray] = {}
-        # sorted ring table (by hash, ties by server name)
+        # sorted ring table (by hash, ties by server name); owners stored as
+        # ranks into the sorted name list, rebuilt LAZILY on first lookup —
+        # a burst of N individual add/remove calls (the reference pays an
+        # rbtree insert each; we'd pay N full sorts) costs one sort total
         self._hashes = np.empty(0, dtype=np.uint64)
-        self._owners: List[str] = []
+        self._owner_ranks = np.empty(0, dtype=np.int64)
+        self._names: List[str] = []
+        self._table_dirty = False
 
     # -- construction -----------------------------------------------------
 
@@ -59,9 +64,11 @@ class HashRing(EventEmitter):
         )
 
     def _rebuild(self) -> None:
+        self._table_dirty = False
         if not self._server_points:
             self._hashes = np.empty(0, dtype=np.uint64)
-            self._owners = []
+            self._owner_ranks = np.empty(0, dtype=np.int64)
+            self._names = []
             return
         names = sorted(self._server_points.keys())
         hashes = np.concatenate([self._server_points[n] for n in names]).astype(
@@ -70,15 +77,19 @@ class HashRing(EventEmitter):
         owner_rank = np.repeat(np.arange(len(names)), self.replica_points)
         order = np.lexsort((owner_rank, hashes))
         self._hashes = hashes[order]
-        ranks = owner_rank[order]
-        self._owners = [names[r] for r in ranks]
+        self._owner_ranks = owner_rank[order]
+        self._names = names
+
+    def _ensure_table(self) -> None:
+        if self._table_dirty:
+            self._rebuild()
 
     def add_server(self, name: str) -> None:
         if self.has_server(name):
             return
         self.servers[name] = True
         self._server_points[name] = self._replica_hashes(name)
-        self._rebuild()
+        self._table_dirty = True
         self.compute_checksum()
         self.emit("added", name)
 
@@ -87,7 +98,7 @@ class HashRing(EventEmitter):
             return
         del self.servers[name]
         del self._server_points[name]
-        self._rebuild()
+        self._table_dirty = True
         self.compute_checksum()
         self.emit("removed", name)
 
@@ -112,7 +123,7 @@ class HashRing(EventEmitter):
                 removed = True
         changed = added or removed
         if changed:
-            self._rebuild()
+            self._table_dirty = True
             self.compute_checksum()
         return changed
 
@@ -140,16 +151,18 @@ class HashRing(EventEmitter):
         return int(np.searchsorted(self._hashes, h, side="left"))
 
     def lookup(self, key) -> Optional[str]:
+        self._ensure_table()
         if self._hashes.size == 0:
             return None
         h = self.hash_func(str(key))
         idx = self._lower_bound(h)
         if idx == self._hashes.size:
             idx = 0  # wraparound to min()
-        return self._owners[idx]
+        return self._names[self._owner_ranks[idx]]
 
     def lookup_n(self, key, n: int) -> List[str]:
         """Up to ``n`` unique successor servers — ring/index.js:157-189."""
+        self._ensure_table()
         server_count = self.get_server_count()
         n = min(n, server_count)
         if n <= 0 or self._hashes.size == 0:
@@ -161,7 +174,7 @@ class HashRing(EventEmitter):
         size = self._hashes.size
         # full-cycle guard mirrors the reference's firstVal check
         for step in range(size):
-            name = self._owners[(start + step) % size]
+            name = self._names[self._owner_ranks[(start + step) % size]]
             if name not in seen:
                 seen.add(name)
                 result.append(name)
@@ -174,4 +187,8 @@ class HashRing(EventEmitter):
     def table(self):
         """The sorted (hash, owner-name) table — the layout the device ring
         consumes (models/ring/device.py)."""
-        return self._hashes.astype(np.uint32), list(self._owners)
+        self._ensure_table()
+        return (
+            self._hashes.astype(np.uint32),
+            [self._names[r] for r in self._owner_ranks],
+        )

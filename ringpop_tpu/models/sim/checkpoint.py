@@ -88,6 +88,17 @@ def load_state(path: str, state_cls: Type[T], params: Any = None) -> T:
                 "checkpoint fields do not match %s (missing=%r, extra=%r)"
                 % (state_cls.__name__, missing, extra)
             )
-        return state_cls(
-            **{f: jnp.asarray(data[f]) for f in state_cls._fields}
-        )
+        out = {}
+        for f in state_cls._fields:
+            arr = jnp.asarray(data[f])
+            if arr.dtype != data[f].dtype:
+                # e.g. int64 incarnations truncated to int32 because JAX
+                # x64 is disabled (RINGPOP_TPU_NO_X64): resuming would
+                # silently wrap epoch-ms timestamps
+                raise ValueError(
+                    "checkpoint field %r is %s but this process loads it "
+                    "as %s (is JAX x64 mode off?)"
+                    % (f, data[f].dtype, arr.dtype)
+                )
+            out[f] = arr
+        return state_cls(**out)

@@ -87,7 +87,7 @@ class SimCluster:
         self.params = params or engine.SimParams(n=n)
         if self.params.n != n:
             self.params = self.params._replace(n=n)
-        self.state = engine.init_state(self.params, seed=seed)
+        self.state = engine.init_state(self.params, seed=seed, universe=self.universe)
         self._tick = jax.jit(
             functools.partial(
                 engine.tick, params=self.params, universe=self.universe

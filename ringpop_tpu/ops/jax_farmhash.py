@@ -142,20 +142,20 @@ def _hash_long(mat: jax.Array, words: jax.Array, lens: jax.Array) -> jax.Array:
 
     iters = (lens - 1) // 20
     max_iters = max((mat.shape[1] - 1) // 20, 1)
+    # pre-slice the aligned word stream into [max_iters, B, 5] blocks and
+    # lax.scan over them: each step reads its block directly instead of
+    # issuing five dynamic word-gathers (the former fori_loop body was
+    # gather-bound — ~6x the per-tick cost at 1k nodes)
+    need = 5 * max_iters
+    w = words
+    if w.shape[1] < need:
+        w = jnp.pad(w, ((0, 0), (0, need - w.shape[1])))
+    blocks = w[:, :need].reshape(w.shape[0], max_iters, 5).transpose(1, 0, 2)
 
-    def word_at(i: jax.Array, j: int) -> jax.Array:
-        # byte offset 20*i + 4*j  ==  word index 5*i + j (aligned)
-        idx = jnp.clip(5 * i + j, 0, words.shape[1] - 1)
-        return words[:, idx]
-
-    def body(i, state):
-        h, g, f = state
+    def body(state, blk):
+        h, g, f, i = state
         active = i < iters
-        a = word_at(i, 0)
-        b = word_at(i, 1)
-        c = word_at(i, 2)
-        d = word_at(i, 3)
-        e = word_at(i, 4)
+        a, b, c, d, e = (blk[:, j] for j in range(5))
         nh = h + a
         ng = g + b
         nf = f + c
@@ -168,9 +168,12 @@ def _hash_long(mat: jax.Array, words: jax.Array, lens: jax.Array) -> jax.Array:
             jnp.where(active, nh, h),
             jnp.where(active, ng, g),
             jnp.where(active, nf, f),
-        )
+            i + 1,
+        ), None
 
-    h, g, f = jax.lax.fori_loop(0, max_iters, body, (h, g, f))
+    (h, g, f, _), _ = jax.lax.scan(
+        body, (h, g, f, jnp.int32(0)), blocks
+    )
 
     g = _rot(g, 11) * C1
     g = _rot(g, 17) * C1

@@ -167,7 +167,8 @@ class ShardedSim:
                 % (self.params.n, self.mesh.devices.size)
             )
         self.state = shard_state(
-            engine.init_state(self.params, seed=seed), self.mesh
+            engine.init_state(self.params, seed=seed, universe=self.universe),
+            self.mesh,
         )
         self._tick = make_sharded_tick(self.params, self.universe, self.mesh)
         self._scan = make_sharded_scan(self.params, self.universe, self.mesh)

@@ -38,6 +38,7 @@ def test_jax_sim_backend_commands():
     assert "killed" in out
     # dead node drops out of the groups; cluster reconverges around it
     for _ in range(60):
+        tc.tick()
         groups = tc.checksum_groups()
         if None in groups and sum(1 for c in groups if c is not None) == 1:
             break
@@ -46,15 +47,18 @@ def test_jax_sim_backend_commands():
 
     tc.run_command("K 3")  # revive: fresh state, rejoins
     for _ in range(80):
+        tc.tick()
         if tc.converged() and None not in tc.checksum_groups():
             break
     assert tc.converged()
 
     # suspend keeps state but stops participation; resume restores it
     tc.run_command("l 2")
+    tc.tick()
     assert None in tc.checksum_groups()
     tc.run_command("K 2")
     for _ in range(60):
+        tc.tick()
         groups = tc.checksum_groups()
         if None not in groups and tc.converged():
             break
@@ -85,6 +89,7 @@ def test_live_backend_cluster(tmp_path):
     try:
         tc.start()
         for _ in range(120):
+            tc.tick()
             if tc.converged() and None not in tc.checksum_groups():
                 break
             time.sleep(0.05)
@@ -94,6 +99,7 @@ def test_live_backend_cluster(tmp_path):
         tc.backend.suspend(2)
         deadline = time.time() + 60
         while time.time() < deadline:
+            tc.tick()
             groups = tc.checksum_groups()
             dead = set(groups.get(None, []))
             if {tc.backend.hosts[1], tc.backend.hosts[2]} <= dead:
@@ -108,6 +114,7 @@ def test_live_backend_cluster(tmp_path):
         tc.backend.revive(2)  # SIGCONT (was SIGSTOPped)
         deadline = time.time() + 90
         while time.time() < deadline:
+            tc.tick()
             groups = tc.checksum_groups()
             if None not in groups and tc.converged():
                 break

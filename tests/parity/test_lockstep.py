@@ -25,7 +25,7 @@ def run_lockstep(n, schedule, params=None, seed=0):
     params = params or engine.SimParams(n=n, checksum_mode="farmhash")
     addresses = default_addresses(n)
     universe = ce.Universe.from_addresses(addresses)
-    state = engine.init_state(params, seed=seed)
+    state = engine.init_state(params, seed=seed, universe=universe)
     oracle = OracleCluster(params, addresses, seed=seed)
     tick = jax.jit(lambda s, i: engine.tick(s, i, params, universe))
 
