@@ -1,15 +1,18 @@
 """Headline benchmark: batched SWIM gossip throughput at 1k nodes.
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+Prints ONE JSON line with the primary metric {"metric", "value", "unit",
+"vs_baseline"} plus secondary fields, including the parity-mode rate
+(parity_mode_node_ticks_per_sec / parity_mode_vs_baseline).
 
 Metric: simulated node-protocol-periods per second for a 1k-node cluster
 running the full SWIM tick (target selection, piggyback dissemination,
 ping/ping-req delivery, suspicion, per-node membership checksums) as a
-single compiled lax.scan.  Checksums use the fast commutative record-hash
-mode (checksum_mode="fast"), which has the same equality semantics as the
-reference's FarmHash32 string checksum but not its bit pattern; bit-exact
-FarmHash32 checksums are the parity mode (checksum_mode="farmhash"),
-exercised by the parity tests.
+single compiled lax.scan.  Two configurations are measured: the fast
+commutative record-hash checksum mode (primary; same equality semantics
+as the reference's FarmHash32 string checksum but not its bit pattern)
+and the farmhash parity mode (bit-exact reference checksum strings with
+dirty-row caching) — both compile and run, roughly doubling bench wall
+time.
 
 Baseline: the reference (ringpop-node) runs clusters in real time with a
 200 ms minimum protocol period (lib/gossip/index.js:194-196), i.e. a 1k-node

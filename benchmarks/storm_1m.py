@@ -1,0 +1,88 @@
+#!/usr/bin/env python3
+"""The 1M-node churn-storm config (BASELINE.md north star: 10% fail/rejoin
+with ring rebalance + checksums in < 60 s wall-clock on a v5e-8).
+
+Drives the O(N·U) scalable engine through a churn storm — a kill wave of
+``fail_frac`` of the cluster, dissemination, then a revive wave, then
+reconvergence — and reports wall-clock for the whole scanned run plus the
+final convergence state.  Prints one JSON line.
+
+Usage: python benchmarks/storm_1m.py [-n 1000000] [--ticks 60]
+       [--fail-frac 0.10] [--device tpu|cpu]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(prog="storm-1m")
+    p.add_argument("-n", type=int, default=1_000_000)
+    p.add_argument("--ticks", type=int, default=60)
+    p.add_argument("--fail-frac", type=float, default=0.10)
+    p.add_argument("--seed", type=int, default=0)
+    args = p.parse_args(argv)
+
+    import jax
+    import numpy as np
+
+    from ringpop_tpu.models.sim import engine_scalable as es
+    from ringpop_tpu.models.sim.storm import ScalableCluster, StormSchedule
+
+    n = args.n
+    params = es.ScalableParams(n=n, u=512, checksum_in_tick=True)
+    cluster = ScalableCluster(n=n, params=params, seed=args.seed)
+
+    rng = np.random.default_rng(args.seed)
+    victims = rng.choice(n, size=int(n * args.fail_frac), replace=False)
+    kill = np.zeros((args.ticks, n), bool)
+    revive = np.zeros((args.ticks, n), bool)
+    kill[2, victims] = True  # fail wave
+    revive[args.ticks // 2, victims] = True  # rejoin wave
+    sched = StormSchedule(ticks=args.ticks, n=n, kill=kill, revive=revive)
+
+    # compile + warm on a copy of the inputs
+    t0 = time.perf_counter()
+    metrics = cluster.run(sched)
+    jax.block_until_ready(cluster.state)
+    cold_s = time.perf_counter() - t0
+
+    cluster2 = ScalableCluster(n=n, params=params, seed=args.seed)
+    t0 = time.perf_counter()
+    metrics = cluster2.run(sched)
+    jax.block_until_ready(cluster2.state)
+    warm_s = time.perf_counter() - t0
+
+    ring_checksum = cluster2.ring_checksum()
+    print(
+        json.dumps(
+            {
+                "metric": "churn_storm_wall_clock_s",
+                "value": round(warm_s, 2),
+                "unit": "s (warm)",
+                "vs_baseline": round(60.0 / warm_s, 2),  # target: < 60 s
+                "n_nodes": n,
+                "ticks": args.ticks,
+                "fail_frac": args.fail_frac,
+                "cold_s": round(cold_s, 2),
+                "final_distinct_checksums": int(
+                    np.asarray(metrics.distinct_checksums)[-1]
+                ),
+                "final_live_nodes": int(np.asarray(metrics.live_nodes)[-1]),
+                "ring_checksum": ring_checksum,
+                "platform": jax.devices()[0].platform,
+            }
+        )
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

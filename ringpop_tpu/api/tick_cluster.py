@@ -277,10 +277,12 @@ class TickCluster:
         return self._snapshot
 
     def checksum_groups(self) -> Dict[Any, List[str]]:
-        """host lists grouped by checksum from the LAST snapshot (ticks
-        once only if no snapshot exists yet); key None = dead."""
+        """host lists grouped by checksum from the LAST snapshot; key None
+        = dead.  Purely a read: call :meth:`tick` first."""
         if self._snapshot is None:
-            self.tick()
+            raise RuntimeError(
+                "no snapshot yet: call tick() before querying groups"
+            )
         groups: Dict[Any, List[str]] = {}
         for hp, cs in self._snapshot.items():
             groups.setdefault(cs, []).append(hp)

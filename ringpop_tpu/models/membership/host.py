@@ -3,8 +3,8 @@
 The reference's member/membership pair (/root/reference/lib/membership/
 member.js, index.js) rebuilt in Python.  This is the *control-plane* model —
 one real Ringpop node's membership list — and also the per-node parity oracle
-the batched device simulator is property-tested against
-(ringpop_tpu/models/membership/device.py).
+the batched device simulator is lockstep-tested against
+(ringpop_tpu/parity/oracle.py, tests/parity/).
 
 Semantics preserved exactly:
 - SWIM update precedence (member.js:171-202): alive/suspect/faulty/leave ×

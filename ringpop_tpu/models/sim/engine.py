@@ -225,10 +225,15 @@ def init_state(
 ) -> SimState:
     """Every node knows only itself (alive, incarnation = epoch).
 
-    Pass ``universe`` to seed the per-node checksum cache with the real
-    self-view checksums — required in farmhash mode, where the tick only
-    rehashes rows whose view changed (an idle node's pre-join checksum
-    would otherwise stay at the zero placeholder)."""
+    ``universe`` seeds the per-node checksum cache with the real self-view
+    checksums — REQUIRED in farmhash mode, where the tick only rehashes
+    rows whose view changed (an idle node's pre-join checksum would
+    otherwise stay at the zero placeholder)."""
+    if params.checksum_mode == "farmhash" and universe is None:
+        raise ValueError(
+            "farmhash checksum mode needs the universe at init_state to "
+            "seed the dirty-row checksum cache (pass universe=...)"
+        )
     n = params.n
     eye = np.eye(n, dtype=bool)
     inc0 = np.where(eye, params.epoch_ms, 0).astype(np.int64)

@@ -1,0 +1,107 @@
+#!/usr/bin/env python3
+"""North-star #1 artifact: engine vs host-oracle checksum parity at scale.
+
+Runs the batched engine (farmhash mode) and the host object oracle through
+the same schedule — bootstrap, churn (kills + revives), quiet convergence —
+asserting bit-identical per-node checksums after every tick, and writes a
+JSON report.  The 1k-node configuration is the BASELINE.md parity target.
+
+Usage: python scripts/parity_check.py [-n 1024] [--ticks 40] [-o PARITY.json]
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(prog="parity-check")
+    p.add_argument("-n", type=int, default=1024)
+    p.add_argument("--ticks", type=int, default=40)
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--output", "-o", default=None)
+    args = p.parse_args(argv)
+
+    import jax
+    import numpy as np
+
+    from ringpop_tpu.models.sim import engine
+    from ringpop_tpu.models.sim.cluster import default_addresses
+    from ringpop_tpu.ops import checksum_encode as ce
+    from ringpop_tpu.parity import OracleCluster
+
+    n = args.n
+    params = engine.SimParams(n=n, checksum_mode="farmhash")
+    addresses = default_addresses(n)
+    universe = ce.Universe.from_addresses(addresses)
+    state = engine.init_state(params, seed=args.seed, universe=universe)
+    oracle = OracleCluster(params, addresses, seed=args.seed)
+    tick = jax.jit(lambda s, i: engine.tick(s, i, params, universe))
+
+    rng = np.random.default_rng(args.seed)
+    schedule = [{"join": np.ones(n, bool)}]
+    down: list = []
+    quiet_tail = max(args.ticks // 3, 12)  # reconvergence window at the end
+    for t in range(1, args.ticks):
+        ev = {}
+        if t % 10 == 5 and t < args.ticks - quiet_tail:
+            # churn pulse: kill a few, revive earlier victims
+            kill = np.zeros(n, bool)
+            victims = rng.choice(n, size=max(1, n // 200), replace=False)
+            kill[victims] = True
+            ev["kill"] = kill
+            if down:
+                rv = np.zeros(n, bool)
+                rv[down.pop()] = True
+                ev["revive"] = rv
+            down.append(victims)
+        schedule.append(ev)
+
+    t0 = time.time()
+    mismatch_ticks = 0
+    for t, ev in enumerate(schedule):
+        inputs = engine.TickInputs.quiet(n)._replace(
+            **{k: jax.numpy.asarray(v) for k, v in ev.items()}
+        )
+        state, metrics = tick(state, inputs)
+        got = np.asarray(state.checksum).astype(np.uint32)
+        res = oracle.tick(ev)
+        bad = np.flatnonzero(got != res.checksums)
+        if bad.size:
+            mismatch_ticks += 1
+            print(
+                json.dumps(
+                    {
+                        "tick": t,
+                        "mismatched_nodes": bad[:8].tolist(),
+                        "engine": [int(x) for x in got[bad[:4]]],
+                        "oracle": [int(x) for x in res.checksums[bad[:4]]],
+                    }
+                ),
+                file=sys.stderr,
+            )
+
+    report = {
+        "metric": "checksum_parity_engine_vs_host_oracle",
+        "n_nodes": n,
+        "ticks": len(schedule),
+        "checksum_comparisons": n * len(schedule),
+        "mismatched_ticks": mismatch_ticks,
+        "parity": mismatch_ticks == 0,
+        "converged_at_end": bool(np.asarray(metrics.converged)),
+        "elapsed_s": round(time.time() - t0, 1),
+        "checksum_mode": "farmhash (bit-exact reference strings)",
+    }
+    print(json.dumps(report))
+    if args.output:
+        with open(args.output, "w") as f:
+            json.dump(report, f)
+    return 0 if mismatch_ticks == 0 else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
