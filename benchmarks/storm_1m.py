@@ -5,10 +5,11 @@ with ring rebalance + checksums in < 60 s wall-clock on a v5e-8).
 Drives the O(N·U) scalable engine through a churn storm — a kill wave of
 ``fail_frac`` of the cluster, dissemination, then a revive wave, then
 reconvergence — and reports wall-clock for the whole scanned run plus the
-final convergence state.  Prints one JSON line.
+final convergence state.  Prints one JSON line.  (Select the device via
+the ambient JAX platform, e.g. JAX_PLATFORMS=cpu.)
 
 Usage: python benchmarks/storm_1m.py [-n 1000000] [--ticks 60]
-       [--fail-frac 0.10] [--device tpu|cpu]
+       [--fail-frac 0.10]
 """
 
 from __future__ import annotations
@@ -29,6 +30,8 @@ def main(argv=None) -> int:
     p.add_argument("--fail-frac", type=float, default=0.10)
     p.add_argument("--seed", type=int, default=0)
     args = p.parse_args(argv)
+    if args.ticks < 8:
+        p.error("--ticks must be >= 8 (fail wave at 2, rejoin at ticks//2)")
 
     import jax
     import numpy as np
@@ -40,13 +43,9 @@ def main(argv=None) -> int:
     params = es.ScalableParams(n=n, u=512, checksum_in_tick=True)
     cluster = ScalableCluster(n=n, params=params, seed=args.seed)
 
-    rng = np.random.default_rng(args.seed)
-    victims = rng.choice(n, size=int(n * args.fail_frac), replace=False)
-    kill = np.zeros((args.ticks, n), bool)
-    revive = np.zeros((args.ticks, n), bool)
-    kill[2, victims] = True  # fail wave
-    revive[args.ticks // 2, victims] = True  # rejoin wave
-    sched = StormSchedule(ticks=args.ticks, n=n, kill=kill, revive=revive)
+    sched = StormSchedule.churn_storm(
+        args.ticks, n, fraction=args.fail_frac, fail_tick=2, seed=args.seed
+    )
 
     # compile + warm on a copy of the inputs
     t0 = time.perf_counter()

@@ -144,6 +144,10 @@ class LiveBackend:
                 out[hp] = None
         return out
 
+    def lookup(self, key, node: int = 0) -> Optional[str]:
+        """Key owner per node ``node``'s ring (/admin/lookup)."""
+        return self.client.admin_lookup(self.hosts[node], str(key))["dest"]
+
     def kill(self, i: int) -> None:
         hp = self.hosts[i]
         proc = self.procs.get(hp)
@@ -196,6 +200,7 @@ class JaxSimBackend:
         self.sim = SimCluster(n=n, addresses=self.hosts, **sim_kw)
         self._dead: set = set()
         self._suspended: set = set()
+        self._replica_hashes = None  # device-ring table, built on demand
 
     def start(self) -> None:
         self.sim.bootstrap()
@@ -223,6 +228,46 @@ class JaxSimBackend:
             for i, hp in enumerate(self.hosts)
             if alive[i]
         }
+
+    def lookup(self, key, node: int = 0) -> Optional[str]:
+        """Key owner per node ``node``'s view, served from the in-jit
+        device ring (the /admin/lookup analog of the jax-sim control
+        plane, SURVEY §5.8).  Asking a dead node raises, matching the
+        live backend's connection error.  The sorted ring is cached per
+        membership view, so repeated lookups between ticks sort once."""
+        import jax.numpy as jnp
+        import numpy as np
+
+        from ringpop_tpu.models.ring import device as ringdev
+        from ringpop_tpu.ops import farmhash32 as fh
+
+        st = self.sim.state
+        if not bool(np.asarray(st.proc_alive)[node]):
+            raise RuntimeError(
+                "node %s is dead; its ring cannot serve lookups"
+                % self.hosts[node]
+            )
+        if self._replica_hashes is None:
+            self._replica_hashes = jnp.asarray(
+                ringdev.replica_table(self.sim.universe.addresses)
+            )
+        in_ring_np = np.asarray(st.known[node]) & (
+            np.asarray(st.status[node]) <= 1  # alive|suspect stay in ring
+        )
+        cache_key = (node, in_ring_np.tobytes())
+        cached = getattr(self, "_ring_cache", None)
+        if cached is None or cached[0] != cache_key:
+            in_ring = jnp.asarray(in_ring_np)
+            ring = ringdev.build_ring(self._replica_hashes, in_ring)
+            n_points = ringdev.ring_size(
+                in_ring, self._replica_hashes.shape[1]
+            )
+            self._ring_cache = cached = (cache_key, ring, n_points)
+        _, ring, n_points = cached
+        owner = int(
+            ringdev.lookup(ring, n_points, jnp.uint32(fh.hash32(str(key))))
+        )
+        return self.sim.universe.addresses[owner] if owner >= 0 else None
 
     def kill(self, i: int) -> None:
         self._dead.add(i)
@@ -343,9 +388,15 @@ class TickCluster:
             return "revived %s" % self.backend.hosts[i]
         if cmd in ("s", "stats"):
             return json.dumps(self.backend.stats_all(), default=str)[:2000]
+        if cmd in ("w", "lookup"):
+            dest = self.backend.lookup(args[0])
+            return "%s -> %s" % (args[0], dest)
         if cmd in ("q", "quit"):
             raise EOFError
-        return "commands: tick|join|kill i|suspend i|revive i|stats|quit"
+        return (
+            "commands: tick|join|kill i|suspend i|revive i|stats|"
+            "lookup key|quit"
+        )
 
     def interactive(self, stdin=None, stdout=None) -> None:
         stdin = stdin or sys.stdin

@@ -85,8 +85,12 @@ def shard_state(state: engine.SimState, mesh: Mesh) -> engine.SimState:
 
 
 def _abstract_state(params: engine.SimParams):
-    """Shape-only SimState (no arrays built) for deriving shardings."""
-    return jax.eval_shape(lambda: engine.init_state(params))
+    """Shape-only SimState (no arrays built) for deriving shardings.
+    Checksum mode does not affect shapes, so evaluate in fast mode — the
+    farmhash mode requires a universe to seed the checksum cache, which a
+    shape probe neither has nor needs."""
+    shape_params = params._replace(checksum_mode="fast")
+    return jax.eval_shape(lambda: engine.init_state(shape_params))
 
 
 def _replicated_metrics(mesh: Mesh):

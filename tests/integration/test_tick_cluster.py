@@ -177,3 +177,33 @@ def test_cli_requires_listen_and_hosts():
 
     assert main([]) == 1
     assert main(["--listen", "127.0.0.1:9"]) == 1
+
+
+def test_jax_sim_lookup_matches_host_ring():
+    """The jax-sim backend's device-ring lookup agrees with the host
+    HashRing for the same member set (the /admin/lookup analog)."""
+    from ringpop_tpu.models.ring.host import HashRing
+
+    tc = TickCluster.create("jax-sim", 6)
+    tc.start()
+    tc.tick_until_converged()
+    host_ring = HashRing()
+    for hp in tc.backend.hosts:
+        host_ring.add_server(hp)
+    for key in ("a", "b", "key-%d" % 17, "zz-9"):
+        assert tc.backend.lookup(key) == host_ring.lookup(key)
+    out = tc.run_command("lookup some-key")
+    assert "->" in out and out.split("-> ")[1] in tc.backend.hosts
+
+    # after a kill disseminates, the dead node drops out of the ring view
+    tc.run_command("k 2")
+    victim = tc.backend.hosts[2]
+    for _ in range(80):
+        tc.tick()
+        if all(
+            tc.backend.lookup("probe-%d" % i) != victim for i in range(30)
+        ):
+            break
+    assert all(
+        tc.backend.lookup("probe-%d" % i) != victim for i in range(30)
+    )
