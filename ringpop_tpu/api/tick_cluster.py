@@ -196,11 +196,17 @@ class JaxSimBackend:
         from ringpop_tpu.models.sim.cluster import SimCluster, default_addresses
 
         self.n = n
-        self.hosts = default_addresses(n, base_port=base_port)
-        self.sim = SimCluster(n=n, addresses=self.hosts, **sim_kw)
+        self.sim = SimCluster(
+            n=n, addresses=default_addresses(n, base_port=base_port), **sim_kw
+        )
+        # engine node indices follow the universe's lexicographically
+        # sorted address order (not construction order) — expose hosts in
+        # that order so index i means the same node everywhere
+        self.hosts = list(self.sim.universe.addresses)
         self._dead: set = set()
         self._suspended: set = set()
         self._replica_hashes = None  # device-ring table, built on demand
+        self._ring_cache = None  # (key, ring, n_points) per membership view
 
     def start(self) -> None:
         self.sim.bootstrap()
@@ -255,7 +261,7 @@ class JaxSimBackend:
             np.asarray(st.status[node]) <= 1  # alive|suspect stay in ring
         )
         cache_key = (node, in_ring_np.tobytes())
-        cached = getattr(self, "_ring_cache", None)
+        cached = self._ring_cache
         if cached is None or cached[0] != cache_key:
             in_ring = jnp.asarray(in_ring_np)
             ring = ringdev.build_ring(self._replica_hashes, in_ring)

@@ -600,10 +600,21 @@ def tick(
     iter_pos = jnp.where(
         participating & has_target, (state.iter_pos + first_k + 1) % n, state.iter_pos
     )
-    # reshuffle permutation on wrap (membership/iterator.js:38-41)
-    shuf_rand = _uniform(state.rng, (n, n), salt=7)
-    new_perm = jnp.argsort(shuf_rand, axis=1).astype(jnp.int32)
-    perm = jnp.where((wrapped & participating)[:, None], new_perm, state.perm)
+    # reshuffle permutation on wrap (membership/iterator.js:38-41).  The
+    # [N, N] argsort is the single hottest non-checksum op in the tick, and
+    # rows wrap only once per full round — skip it entirely on wrap-free
+    # ticks (the uniform draw is a pure function of state.rng, so skipping
+    # changes no other randomness)
+    resh = wrapped & participating
+
+    def _reshuffled(_):
+        shuf_rand = _uniform(state.rng, (n, n), salt=7)
+        new_perm = jnp.argsort(shuf_rand, axis=1).astype(jnp.int32)
+        return jnp.where(resh[:, None], new_perm, state.perm)
+
+    perm = jax.lax.cond(
+        jnp.any(resh), _reshuffled, lambda _: state.perm, operand=None
+    )
     state = state._replace(perm=perm, iter_pos=iter_pos)
 
     valid_send = target >= 0

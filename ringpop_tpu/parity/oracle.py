@@ -376,10 +376,13 @@ class OracleCluster:
             (self.iter_pos + first_k + 1) % n,
             self.iter_pos,
         )
-        shuf_rand = _np_uniform(self.rng, (n, n), salt=7)
-        new_perm = np.argsort(shuf_rand, axis=1, kind="stable").astype(np.int32)
         resh = wrapped & participating
-        self.perm = np.where(resh[:, None], new_perm, self.perm)
+        if resh.any():  # engine skips the draw on wrap-free ticks too
+            shuf_rand = _np_uniform(self.rng, (n, n), salt=7)
+            new_perm = np.argsort(shuf_rand, axis=1, kind="stable").astype(
+                np.int32
+            )
+            self.perm = np.where(resh[:, None], new_perm, self.perm)
         valid_send = target >= 0
 
         # ---- phase 3: sender piggyback bump (issueAsSender) -------------
