@@ -206,7 +206,7 @@ class JaxSimBackend:
         self._dead: set = set()
         self._suspended: set = set()
         self._replica_hashes = None  # device-ring table, built on demand
-        self._ring_cache = None  # (key, ring, n_points) per membership view
+        self._ring_cache: Dict[bytes, tuple] = {}  # view bytes -> (ring, n)
 
     def start(self) -> None:
         self.sim.bootstrap()
@@ -260,16 +260,20 @@ class JaxSimBackend:
         in_ring_np = np.asarray(st.known[node]) & (
             np.asarray(st.status[node]) <= 1  # alive|suspect stay in ring
         )
-        cache_key = (node, in_ring_np.tobytes())
-        cached = self._ring_cache
-        if cached is None or cached[0] != cache_key:
+        # keyed on the VIEW bytes alone: converged nodes share one ring;
+        # bounded so a churny session can't grow it without limit
+        cache_key = in_ring_np.tobytes()
+        cached = self._ring_cache.get(cache_key)
+        if cached is None:
             in_ring = jnp.asarray(in_ring_np)
             ring = ringdev.build_ring(self._replica_hashes, in_ring)
             n_points = ringdev.ring_size(
                 in_ring, self._replica_hashes.shape[1]
             )
-            self._ring_cache = cached = (cache_key, ring, n_points)
-        _, ring, n_points = cached
+            if len(self._ring_cache) >= 8:
+                self._ring_cache.pop(next(iter(self._ring_cache)))
+            self._ring_cache[cache_key] = cached = (ring, n_points)
+        ring, n_points = cached
         owner = int(
             ringdev.lookup(ring, n_points, jnp.uint32(fh.hash32(str(key))))
         )

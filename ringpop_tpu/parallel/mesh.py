@@ -57,7 +57,37 @@ def make_mesh(
     return Mesh(np.asarray(devices), (axis,))
 
 
-def _spec_for(x, axis: str) -> P:
+def make_mesh_2d(
+    n_hosts: int,
+    chips_per_host: int,
+    devices: Optional[Sequence] = None,
+    axes: Sequence[str] = ("dcn", "ici"),
+) -> Mesh:
+    """A 2-D (hosts x chips) mesh — the multi-host topology: the outer axis
+    crosses DCN between hosts, the inner axis rides ICI within a slice.
+    The node dimension shards over BOTH (a tuple PartitionSpec axis), so
+    the same SPMD program spans slices the way the reference's TChannel
+    cluster spans machines."""
+    need = n_hosts * chips_per_host
+    if devices is None:
+        devices = jax.devices()[:need]  # default pool: take what we need
+    if len(devices) != need:
+        raise ValueError(
+            "need exactly %d devices for a %dx%d mesh, have %d"
+            % (need, n_hosts, chips_per_host, len(devices))
+        )
+    grid = np.asarray(devices).reshape(n_hosts, chips_per_host)
+    return Mesh(grid, tuple(axes))
+
+
+def _node_axis(mesh: Mesh):
+    """The PartitionSpec axis entry sharding the node dimension: the mesh's
+    single axis name, or the tuple of all axes for multi-D meshes."""
+    names = mesh.axis_names
+    return names[0] if len(names) == 1 else tuple(names)
+
+
+def _spec_for(x, axis) -> P:
     """Shard the leading (observer/node) axis; replicate scalars."""
     if getattr(x, "ndim", 0) == 0:
         return P()
@@ -66,14 +96,14 @@ def _spec_for(x, axis: str) -> P:
 
 def state_shardings(mesh: Mesh, state: engine.SimState):
     """NamedSharding pytree for a SimState: node axis sharded, rest local."""
-    axis = mesh.axis_names[0]
+    axis = _node_axis(mesh)
     return jax.tree.map(
         lambda x: NamedSharding(mesh, _spec_for(x, axis)), state
     )
 
 
 def inputs_shardings(mesh: Mesh, inputs: engine.TickInputs):
-    axis = mesh.axis_names[0]
+    axis = _node_axis(mesh)
     return jax.tree.map(
         lambda x: NamedSharding(mesh, _spec_for(x, axis)), inputs
     )
@@ -122,7 +152,7 @@ def make_sharded_scan(
 ):
     """Compile a ``lax.scan`` of the tick over a [T, N] event schedule."""
     st_sh = state_shardings(mesh, _abstract_state(params))
-    axis = mesh.axis_names[0]
+    axis = _node_axis(mesh)
     sched_sh = jax.tree.map(
         lambda x: NamedSharding(mesh, P(None, axis)),
         engine.TickInputs.quiet(params.n),
