@@ -15,11 +15,15 @@ TPU-first design notes:
   all 4-aligned: the byte matrix is pre-packed once into an aligned
   little-endian ``uint32`` word view, turning 20 byte-gathers per block into
   5 word-gathers.  Only the five unaligned tail fetches gather bytes.
-- The loop is a ``lax.fori_loop`` with trip count ``(L-1)//20`` (static from
-  the padded width) and per-row active masks — no dynamic shapes.
+- The loop is a ``lax.scan`` over pre-sliced word blocks with trip count
+  ``(L-1)//20`` (static from the padded width) and per-row active masks —
+  no dynamic shapes.  A Pallas TPU kernel for the same loop is opt-in via
+  RINGPOP_TPU_PALLAS=1 (:mod:`ringpop_tpu.ops.pallas_farmhash`).
 """
 
 from __future__ import annotations
+
+import functools
 
 import jax
 import jax.numpy as jnp
@@ -31,6 +35,15 @@ C1 = np.uint32(0xCC9E2D51)
 C2 = np.uint32(0x1B873593)
 FIVE = np.uint32(5)
 MAGIC = np.uint32(0xE6546B64)
+
+
+def _impl_from_env() -> str:
+    """Block-loop implementation: 'pallas' (opt-in via RINGPOP_TPU_PALLAS=1;
+    interpret mode off-TPU so tests validate the kernel everywhere) or the
+    default 'scan' lowering."""
+    import os
+
+    return "pallas" if os.environ.get("RINGPOP_TPU_PALLAS", "") == "1" else "scan"
 
 
 def _rot(x: jax.Array, r: int) -> jax.Array:
@@ -118,7 +131,12 @@ def _hash_13_24(mat: jax.Array, lens: jax.Array) -> jax.Array:
     return _fmix(h)
 
 
-def _hash_long(mat: jax.Array, words: jax.Array, lens: jax.Array) -> jax.Array:
+def _hash_long(
+    mat: jax.Array,
+    words: jax.Array,
+    lens: jax.Array,
+    impl: str = "scan",
+) -> jax.Array:
     n32 = lens.astype(jnp.uint32)
     h = n32
     g = C1 * n32
@@ -142,38 +160,54 @@ def _hash_long(mat: jax.Array, words: jax.Array, lens: jax.Array) -> jax.Array:
 
     iters = (lens - 1) // 20
     max_iters = max((mat.shape[1] - 1) // 20, 1)
-    # pre-slice the aligned word stream into [max_iters, B, 5] blocks and
-    # lax.scan over them: each step reads its block directly instead of
-    # issuing five dynamic word-gathers (the former fori_loop body was
-    # gather-bound — ~6x the per-tick cost at 1k nodes)
+    # pre-slice the aligned word stream into per-iteration blocks: each
+    # step reads its block directly instead of issuing five dynamic
+    # word-gathers (the former fori_loop body was gather-bound — ~6x the
+    # per-tick cost at 1k nodes)
     need = 5 * max_iters
     w = words
     if w.shape[1] < need:
         w = jnp.pad(w, ((0, 0), (0, need - w.shape[1])))
-    blocks = w[:, :need].reshape(w.shape[0], max_iters, 5).transpose(1, 0, 2)
 
-    def body(state, blk):
-        h, g, f, i = state
-        active = i < iters
-        a, b, c, d, e = (blk[:, j] for j in range(5))
-        nh = h + a
-        ng = g + b
-        nf = f + c
-        nh = _mur(d, nh) + e
-        ng = _mur(c, ng) + a
-        nf = _mur(b + e * C1, nf) + d
-        nf = nf + ng
-        ng = ng + nf
-        return (
-            jnp.where(active, nh, h),
-            jnp.where(active, ng, g),
-            jnp.where(active, nf, f),
-            i + 1,
-        ), None
+    if impl == "pallas":
+        from ringpop_tpu.ops import pallas_farmhash
 
-    (h, g, f, _), _ = jax.lax.scan(
-        body, (h, g, f, jnp.int32(0)), blocks
-    )
+        blocks_bi5 = w[:, :need].reshape(w.shape[0], max_iters, 5)
+        h, g, f = pallas_farmhash.block_loop(
+            h,
+            g,
+            f,
+            blocks_bi5,
+            iters.astype(jnp.int32),
+            interpret=jax.devices()[0].platform != "tpu",
+        )
+    else:
+        blocks = (
+            w[:, :need].reshape(w.shape[0], max_iters, 5).transpose(1, 0, 2)
+        )
+
+        def body(state, blk):
+            h, g, f, i = state
+            active = i < iters
+            a, b, c, d, e = (blk[:, j] for j in range(5))
+            nh = h + a
+            ng = g + b
+            nf = f + c
+            nh = _mur(d, nh) + e
+            ng = _mur(c, ng) + a
+            nf = _mur(b + e * C1, nf) + d
+            nf = nf + ng
+            ng = ng + nf
+            return (
+                jnp.where(active, nh, h),
+                jnp.where(active, ng, g),
+                jnp.where(active, nf, f),
+                i + 1,
+            ), None
+
+        (h, g, f, _), _ = jax.lax.scan(
+            body, (h, g, f, jnp.int32(0)), blocks
+        )
 
     g = _rot(g, 11) * C1
     g = _rot(g, 17) * C1
@@ -188,24 +222,38 @@ def _hash_long(mat: jax.Array, words: jax.Array, lens: jax.Array) -> jax.Array:
     return h
 
 
-def hash32_rows(mat: jax.Array, lens: jax.Array) -> jax.Array:
+def hash32_rows(
+    mat: jax.Array, lens: jax.Array, impl: str = None
+) -> jax.Array:
     """farmhashmk::Hash32 of each padded row — jit-friendly, ``[B] uint32``.
 
     ``mat`` must carry >= 4 bytes of zero slack beyond the longest row (use
     :func:`ringpop_tpu.ops.farmhash32.encode_rows` on host, or allocate the
-    device buffer with slack).
+    device buffer with slack).  ``impl`` selects the block-loop lowering
+    ('scan' default, 'pallas' opt-in); None reads RINGPOP_TPU_PALLAS at
+    trace time.
     """
+    if impl is None:
+        impl = _impl_from_env()
     mat = mat.astype(jnp.uint8)
     lens = lens.astype(jnp.int32) if lens.dtype not in (jnp.int32, jnp.int64) else lens
     words = pack_words(mat)
     out = _hash_0_4(mat, lens)
     out = jnp.where(lens > 4, _hash_5_12(mat, lens), out)
     out = jnp.where(lens > 12, _hash_13_24(mat, lens), out)
-    out = jnp.where(lens > 24, _hash_long(mat, words, lens), out)
+    out = jnp.where(lens > 24, _hash_long(mat, words, lens, impl), out)
     return out
 
 
-hash32_rows_jit = jax.jit(hash32_rows)
+@functools.lru_cache(maxsize=None)
+def _jitted_rows(impl: str):
+    return jax.jit(functools.partial(hash32_rows, impl=impl))
+
+
+def hash32_rows_jit(mat: jax.Array, lens: jax.Array) -> jax.Array:
+    """Jitted :func:`hash32_rows`; the env-selected impl is part of the jit
+    cache key, so toggling RINGPOP_TPU_PALLAS mid-process takes effect."""
+    return _jitted_rows(_impl_from_env())(mat, lens)
 
 
 def hash32_strings_device(strings) -> np.ndarray:

@@ -1,0 +1,143 @@
+"""Pallas TPU kernel for the FarmHash32 20-byte block loop.
+
+The long-string path of farmhashmk chains (h, g, f) through one mixing
+round per 20-byte block — sequential per row, embarrassingly parallel
+across rows.  This kernel streams the pre-packed word blocks from HBM
+through VMEM one iteration tile at a time (grid = row-tiles x iterations,
+Pallas double-buffers the fetches), keeps the three carries in VMEM
+scratch across the iteration axis, and writes each row-tile's result once
+on the last iteration.
+
+Layout: rows are tiled (8 sublanes x 128 lanes) = 1024 rows per grid row;
+each iteration step loads a [8, 128, 5] uint32 block (20 KB), so VMEM
+holds carries + two in-flight blocks regardless of string length.
+
+Used by :func:`ringpop_tpu.ops.jax_farmhash.hash32_rows` when
+``RINGPOP_TPU_PALLAS=1`` (interpret mode off-TPU keeps tests hermetic);
+the default remains the `lax.scan` lowering, which the dirty-row checksum
+cache already keeps off the critical path on quiet ticks.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+# one source of truth for the farmhashmk constants and mixing primitives
+# (no import cycle: jax_farmhash imports this module lazily)
+from ringpop_tpu.ops.jax_farmhash import C1, _mur
+
+SUB, LANE = 8, 128
+TILE = SUB * LANE  # rows per grid step
+
+
+def _kernel(iters_ref, blocks_ref, h0_ref, g0_ref, f0_ref,
+            oh_ref, og_ref, of_ref, h_s, g_s, f_s, *, max_iters: int):
+    from jax.experimental import pallas as pl
+
+    i = pl.program_id(1)
+
+    @pl.when(i == 0)
+    def _():
+        h_s[:] = h0_ref[0]
+        g_s[:] = g0_ref[0]
+        f_s[:] = f0_ref[0]
+
+    blk = blocks_ref[0, 0]  # [SUB, LANE, 5] uint32
+    a = blk[:, :, 0]
+    b = blk[:, :, 1]
+    c = blk[:, :, 2]
+    d = blk[:, :, 3]
+    e = blk[:, :, 4]
+
+    h = h_s[:]
+    g = g_s[:]
+    f = f_s[:]
+    nh = h + a
+    ng = g + b
+    nf = f + c
+    nh = _mur(d, nh) + e
+    ng = _mur(c, ng) + a
+    nf = _mur(b + e * C1, nf) + d
+    nf = nf + ng
+    ng = ng + nf
+
+    active = i < iters_ref[0]
+    h_s[:] = jnp.where(active, nh, h)
+    g_s[:] = jnp.where(active, ng, g)
+    f_s[:] = jnp.where(active, nf, f)
+
+    @pl.when(i == max_iters - 1)
+    def _():
+        oh_ref[0] = h_s[:]
+        og_ref[0] = g_s[:]
+        of_ref[0] = f_s[:]
+
+
+def block_loop(h0, g0, f0, blocks, iters, *, interpret: bool = False):
+    """Run the farmhashmk block loop on TPU via Pallas.
+
+    ``h0/g0/f0``: [B] uint32 carries after tail mixing; ``blocks``:
+    [B, max_iters, 5] uint32 aligned words; ``iters``: [B] per-row trip
+    counts.  Returns (h, g, f) [B] uint32.
+    """
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    B, max_iters, five = blocks.shape
+    assert five == 5
+    pad = (-B) % TILE
+    if pad:
+        h0 = jnp.pad(h0, (0, pad))
+        g0 = jnp.pad(g0, (0, pad))
+        f0 = jnp.pad(f0, (0, pad))
+        blocks = jnp.pad(blocks, ((0, pad), (0, 0), (0, 0)))
+        iters = jnp.pad(iters, (0, pad))
+    bp = B + pad
+    gsz = bp // TILE
+
+    def rows(x):
+        return x.reshape(gsz, SUB, LANE)
+
+    blocks_t = (
+        blocks.reshape(gsz, SUB, LANE, max_iters, 5)
+        .transpose(0, 3, 1, 2, 4)  # [G, I, SUB, LANE, 5]
+    )
+
+    row_spec = pl.BlockSpec(
+        (1, SUB, LANE), lambda g, i: (g, 0, 0), memory_space=pltpu.VMEM
+    )
+    out = pl.pallas_call(
+        functools.partial(_kernel, max_iters=max_iters),
+        grid=(gsz, max_iters),
+        in_specs=[
+            row_spec,  # iters
+            pl.BlockSpec(
+                (1, 1, SUB, LANE, 5),
+                lambda g, i: (g, i, 0, 0, 0),
+                memory_space=pltpu.VMEM,
+            ),
+            row_spec,  # h0
+            row_spec,  # g0
+            row_spec,  # f0
+        ],
+        out_specs=[row_spec, row_spec, row_spec],
+        out_shape=[
+            jax.ShapeDtypeStruct((gsz, SUB, LANE), jnp.uint32)
+            for _ in range(3)
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((SUB, LANE), jnp.uint32) for _ in range(3)
+        ],
+        interpret=interpret,
+    )(
+        rows(iters.astype(jnp.int32)),
+        blocks_t,
+        rows(h0),
+        rows(g0),
+        rows(f0),
+    )
+    h, g, f = (x.reshape(bp)[:B] for x in out)
+    return h, g, f
