@@ -88,7 +88,13 @@ from ringpop_tpu.ops.record_mix import record_mix
 ALIVE, SUSPECT, FAULTY, LEAVE = 0, 1, 2, 3
 
 WORD = 32
-SLOTS_PER_TICK = 3  # suspect batch, faulty batch, alive batch
+SLOTS_PER_TICK = 3  # suspect, faulty, alive (revive/refute/rejoin)
+
+
+def slots_per_tick(params: "ScalableParams") -> int:
+    """3 rumor classes per tick, +1 (leave) when the feature is enabled —
+    leave-free storms don't pay table capacity for an empty slot."""
+    return SLOTS_PER_TICK + (1 if params.enable_leave else 0)
 
 
 class ScalableParams(NamedTuple):
@@ -103,11 +109,18 @@ class ScalableParams(NamedTuple):
     # checksums every tick cost O(N*U) bandwidth; 1M-node storms can compute
     # them on demand (compute_checksums) instead
     checksum_in_tick: bool = True
+    # graceful-leave support allocates a 4th rumor slot per tick (raises
+    # the minimum table capacity u by a third); off by default
+    enable_leave: bool = False
 
 
 class ScalableState(NamedTuple):
     tick_index: jax.Array  # scalar int32
     proc_alive: jax.Array  # [N] bool — process up (fault plane)
+    # gossiping flag: False after a graceful leave — the node stops
+    # initiating exchanges and probes but keeps answering its partners
+    # (makeLeave -> gossip.stop, on_membership_event.js:32-41)
+    gossip_on: jax.Array  # [N] bool
     partition: jax.Array  # [N] int32 — group id; unequal groups can't talk
     truth_status: jax.Array  # [N] int32 — latest asserted status
     truth_inc: jax.Array  # [N] int64 — latest asserted incarnation
@@ -139,13 +152,20 @@ class ScalableMetrics(NamedTuple):
     suspects_published: jax.Array  # subjects newly suspected this tick
     faulties_published: jax.Array
     refutes_published: jax.Array  # live defamed nodes re-asserting alive
+    leaves_published: jax.Array  # graceful leaves this tick
 
 
 class ChurnInputs(NamedTuple):
     kill: jax.Array  # [N] bool
+    # revive restarts a dead process (fresh state) OR rejoins a left node
+    # (alive with fresh incarnation, gossip back on —
+    # server/admin/member.js:44-51)
     revive: jax.Array  # [N] bool
     # [N] int32 group assignment, -1 keeps current; None = no change
     partition: Optional[jax.Array] = None
+    # [N] bool graceful leave: publish status=leave at the current
+    # incarnation and stop initiating gossip; None = no leaves
+    leave: Optional[jax.Array] = None
 
     @staticmethod
     def quiet(n: int) -> "ChurnInputs":
@@ -209,7 +229,7 @@ def max_rumor_age(params: ScalableParams) -> int:
 def init_state(params: ScalableParams, seed: int = 0) -> ScalableState:
     n, u = params.n, params.u
     assert u % WORD == 0, "rumor capacity must be a multiple of 32"
-    need = SLOTS_PER_TICK * (max_rumor_age(params) + 2)
+    need = slots_per_tick(params) * (max_rumor_age(params) + 2)
     if u < need:
         raise ValueError(
             "rumor table u=%d can recycle a slot before its rumor ages out "
@@ -223,6 +243,7 @@ def init_state(params: ScalableParams, seed: int = 0) -> ScalableState:
     return ScalableState(
         tick_index=jnp.int32(0),
         proc_alive=jnp.ones(n, bool),
+        gossip_on=jnp.ones(n, bool),
         partition=jnp.zeros(n, jnp.int32),
         truth_status=jnp.zeros(n, jnp.int32),
         truth_inc=inc0,
@@ -312,7 +333,11 @@ def tick(
 
     # ---- fault plane ---------------------------------------------------
     revived = inputs.revive & ~state.proc_alive
+    # a live-but-left node revived == admin rejoin (alive, fresh inc,
+    # gossip restarted — server/admin/member.js:44-51)
+    rejoined = inputs.revive & state.proc_alive & ~state.gossip_on
     proc_alive = (state.proc_alive & ~inputs.kill) | inputs.revive
+    gossip_on = (state.gossip_on | revived | rejoined) & proc_alive
     if inputs.partition is None:
         partition = state.partition
     else:
@@ -323,6 +348,7 @@ def tick(
     # entirely via join full-sync, server/protocol/join.js:131)
     state = state._replace(
         proc_alive=proc_alive,
+        gossip_on=gossip_on,
         partition=partition,
         tick_index=t,
         heard=jnp.where(revived[:, None], 0, state.heard),
@@ -340,9 +366,9 @@ def tick(
     max_age = params.piggyback_factor * digits + params.age_slack
     aged = state.r_active & (t - state.r_birth > max_age)
     # this tick's three deterministic slots are recycled regardless of age
+    spt = slots_per_tick(params)
     slots = (
-        (SLOTS_PER_TICK * (t - 1) + jnp.arange(SLOTS_PER_TICK, dtype=jnp.int32))
-        % u
+        (spt * (t - 1) + jnp.arange(spt, dtype=jnp.int32)) % u
     ).astype(jnp.int32)
     recycled = jnp.zeros(u, bool).at[slots].set(True)
     retired = aged | (state.r_active & recycled)
@@ -377,17 +403,20 @@ def tick(
     active_words = _pack_mask(state.r_active)
     new_heard = state.heard
     direct_ok = jnp.zeros(n, bool)
+    gossiping = proc_alive & state.gossip_on
     for k in range(k_total):
         partner = partners[k]
         loss = losses[k]
         conn = partition == partition[partner]
-        ok = proc_alive & proc_alive[partner] & conn & ~loss
+        # only gossiping nodes INITIATE; a left node still answers when it
+        # is the partner (the reference's left node keeps serving pings)
+        ok = gossiping & proc_alive[partner] & conn & ~loss
         if k == 0:
             direct_ok = ok
             use = ok
         else:
             # indirect exchange only for nodes whose direct ping failed
-            use = proc_alive & ~direct_ok & proc_alive[partner] & conn & ~loss
+            use = gossiping & ~direct_ok & proc_alive[partner] & conn & ~loss
         # pull: i ORs partner's heard set; push: partner ORs i's set.  The
         # push scatter i -> partner[i] is a gather by the inverse
         # permutation (partner is a permutation: no write conflicts).
@@ -416,7 +445,7 @@ def tick(
     # ping-req fanout's intermediaries answered but none reached the
     # target (ping-req-sender.js:249-262).  Packet loss / partitions thus
     # produce FALSE suspects, refuted later like the reference.
-    direct_fail = proc_alive & ~direct_ok & (partner0 != ids)
+    direct_fail = gossiping & ~direct_ok & (partner0 != ids)
     any_responder = jnp.zeros(n, bool)
     any_reached = jnp.zeros(n, bool)
     for k in range(1, k_total):
@@ -509,7 +538,7 @@ def tick(
     defamed = (state.truth_status == SUSPECT) | (state.truth_status == FAULTY)
     refuter = proc_alive & ~revived & aware & defamed
     n_refute = jnp.sum(refuter.astype(jnp.int32))
-    alive_subjects = revived | refuter
+    alive_subjects = revived | rejoined | refuter
     state = _publish_batch(
         state,
         slots[2],
@@ -522,6 +551,43 @@ def tick(
     state = state._replace(
         defame_slot=jnp.where(alive_subjects, -1, state.defame_slot)
     )
+
+    # ---- graceful leave: leave batch -----------------------------------
+    # self-assertion of status=leave at the CURRENT incarnation
+    # (membership.makeLeave); the leaver stops initiating gossip but keeps
+    # answering, so the rumor still reaches it and everyone else
+    if inputs.leave is not None:
+        if not params.enable_leave:
+            raise ValueError(
+                "leave inputs require ScalableParams(enable_leave=True) "
+                "(allocates the 4th rumor slot)"
+            )
+        leaver = (
+            inputs.leave
+            & proc_alive
+            & state.gossip_on
+            & (state.truth_status != LEAVE)
+        )
+        n_leave = jnp.sum(leaver.astype(jnp.int32))
+        state = _publish_batch(
+            state,
+            slots[3],
+            leaver,
+            jnp.full(n, LEAVE, jnp.int32),
+            state.truth_inc,
+            leaver,
+            t,
+        )
+        # the reference stops gossip AND suspicion wholesale on leave
+        # (on_membership_event.js:32-41 suspicion.stopAll) — a departed
+        # node must not escalate a pre-leave suspicion to faulty
+        state = state._replace(
+            gossip_on=state.gossip_on & ~leaver,
+            susp_subject=jnp.where(leaver, -1, state.susp_subject),
+            susp_since=jnp.where(leaver, -1, state.susp_since),
+        )
+    else:
+        n_leave = jnp.int32(0)
 
     # ---- checksums + metrics ------------------------------------------
     if params.checksum_in_tick:
@@ -574,5 +640,6 @@ def tick(
         suspects_published=n_susp,
         faulties_published=n_faulty,
         refutes_published=n_refute,
+        leaves_published=n_leave,
     )
     return state, metrics

@@ -223,7 +223,7 @@ def _hash_long(
 
 
 def hash32_rows(
-    mat: jax.Array, lens: jax.Array, impl: str = None
+    mat: jax.Array, lens: jax.Array, impl: "str | None" = None
 ) -> jax.Array:
     """farmhashmk::Hash32 of each padded row — jit-friendly, ``[B] uint32``.
 

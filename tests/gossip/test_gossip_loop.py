@@ -1,0 +1,64 @@
+"""Gossip loop rate adaptation + lifecycle (lib/gossip/index.js:42-105)."""
+
+from ringpop_tpu.gossip.gossip import Gossip
+
+
+class StubRingpop:
+    def __init__(self):
+        from ringpop_tpu.net.timers import FakeTimers
+
+        self.timers = FakeTimers()
+
+        class _Log:
+            def debug(self, *a, **k):
+                pass
+
+            info = warning = error = debug
+
+        self.logger = _Log()
+        self.stats = []
+
+    def whoami(self):
+        return "127.0.0.1:3000"
+
+    def stat(self, t, k, v=None):
+        self.stats.append((t, k))
+
+
+def test_first_tick_staggered_within_min_period():
+    import random
+
+    g = Gossip(StubRingpop(), rng=random.Random(7))
+    delays = {g.compute_protocol_delay_ms() for _ in range(20)}
+    assert all(0 <= d < g.min_protocol_period_ms for d in delays)
+    assert len(delays) > 1  # actually random, not constant
+
+
+def test_rate_is_twice_p50_floored():
+    g = Gossip(StubRingpop())
+    # no observations: floored at the minimum period
+    assert g.compute_protocol_rate_ms() == g.min_protocol_period_ms
+    for ms in (10.0, 20.0, 30.0):
+        g.protocol_timing.update(ms)
+    # p50=20 -> 2x = 40 < 200 floor
+    assert g.compute_protocol_rate_ms() == g.min_protocol_period_ms
+    for ms in (400.0, 500.0, 600.0, 700.0):
+        g.protocol_timing.update(ms)
+    assert g.compute_protocol_rate_ms() > g.min_protocol_period_ms
+
+
+def test_start_stop_idempotent():
+    class M:
+        def shuffle(self):
+            pass
+
+    rp = StubRingpop()
+    rp.membership = M()
+    g = Gossip(rp)
+    assert g.is_stopped
+    g.start()
+    assert not g.is_stopped
+    g.start()  # no-op
+    g.stop()
+    assert g.is_stopped
+    g.stop()  # warns, no crash

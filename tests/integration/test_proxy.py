@@ -192,3 +192,39 @@ def counting_statsd(monkeypatch):
         return orig(self, stat_type, key, value)
 
     monkeypatch.setattr(Ringpop, "stat", counting)
+
+
+def test_sharding_handler_blacklist_passes_through(cluster):
+    """Blacklisted endpoints skip sk routing entirely
+    (ringpop-handler.js:52-68)."""
+    from ringpop_tpu.api.handler import RingpopHandler
+
+    c = cluster(n=3)
+    sender, other = c.node(0), c.node(1)
+
+    def app_handler(head, body):
+        return None, {"servedBy": sender.whoami()}
+
+    RingpopHandler(
+        sender, app_handler, "/app/admin-ish", blacklist=["/app/admin-ish"]
+    ).register()
+    sk = key_owned_by(c, other, tag="bl")
+    # even with a remote-owned sk, the blacklist serves locally
+    _, body = sender.channel.request(
+        sender.whoami(), "/app/admin-ish", head={"sk": sk}, body={}
+    )
+    assert body["servedBy"] == sender.whoami()
+
+
+def test_sharding_handler_no_sk_serves_locally(cluster):
+    from ringpop_tpu.api.handler import RingpopHandler
+
+    c = cluster(n=2)
+    sender = c.node(0)
+    RingpopHandler(
+        sender, lambda h, b: (None, {"servedBy": sender.whoami()}), "/app/nosk"
+    ).register()
+    _, body = sender.channel.request(
+        sender.whoami(), "/app/nosk", head={}, body={}
+    )
+    assert body["servedBy"] == sender.whoami()

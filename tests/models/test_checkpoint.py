@@ -78,7 +78,7 @@ def test_full_engine_resume_bitwise_equal(tmp_path):
 
 
 def test_checkpoint_rejects_wrong_class_and_fields(tmp_path):
-    params = es.ScalableParams(n=8, u=96)
+    params = es.ScalableParams(n=8, u=128)
     state = es.init_state(params)
     path = str(tmp_path / "s.npz")
     save_state(path, state)

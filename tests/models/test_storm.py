@@ -9,7 +9,7 @@ from ringpop_tpu.models.sim.storm import ScalableCluster, StormSchedule
 def test_churn_storm_reconverges():
     n = 64
     sim = ScalableCluster(
-        n=n, params=es.ScalableParams(n=n, u=128, suspicion_ticks=4)
+        n=n, params=es.ScalableParams(n=n, u=192, suspicion_ticks=4)
     )
     ring0 = sim.ring_checksum()
     sched = StormSchedule.churn_storm(
@@ -30,7 +30,7 @@ def test_churn_storm_reconverges():
 
 def test_ring_checksum_tracks_membership():
     n = 32
-    sim = ScalableCluster(n=n, params=es.ScalableParams(n=n, u=128, suspicion_ticks=2))
+    sim = ScalableCluster(n=n, params=es.ScalableParams(n=n, u=192, suspicion_ticks=2))
     r_full = sim.ring_checksum()
     sched = StormSchedule(ticks=10, n=n)
     sched.kill[1, :4] = True
@@ -44,7 +44,7 @@ def test_checksum_on_demand_mode():
     n = 32
     sim = ScalableCluster(
         n=n,
-        params=es.ScalableParams(n=n, u=128, checksum_in_tick=False),
+        params=es.ScalableParams(n=n, u=192, checksum_in_tick=False),
     )
     sched = StormSchedule(ticks=5, n=n)
     sim.run(sched)
