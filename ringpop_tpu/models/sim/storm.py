@@ -40,6 +40,9 @@ class StormSchedule:
     n: int
     kill: np.ndarray = None
     revive: np.ndarray = None
+    # graceful leaves ([T, N] bool) or None; requires
+    # ScalableParams(enable_leave=True)
+    leave: np.ndarray = None
 
     def __post_init__(self):
         if self.kill is None:
@@ -48,8 +51,11 @@ class StormSchedule:
             self.revive = np.zeros((self.ticks, self.n), bool)
 
     def as_inputs(self) -> es.ChurnInputs:
+        # leave stays None when unused: identical pytree to plain inputs
         return es.ChurnInputs(
-            kill=jnp.asarray(self.kill), revive=jnp.asarray(self.revive)
+            kill=jnp.asarray(self.kill),
+            revive=jnp.asarray(self.revive),
+            leave=None if self.leave is None else jnp.asarray(self.leave),
         )
 
     @staticmethod

@@ -50,3 +50,25 @@ def test_checksum_on_demand_mode():
     sim.run(sched)
     cs = sim.checksums()
     assert np.unique(cs).size == 1
+
+
+def test_storm_schedule_with_leaves():
+    """A storm mixing graceful leaves with kills runs under one scan and
+    reconverges after rejoin."""
+    n = 48
+    params = es.ScalableParams(n=n, u=256, suspicion_ticks=4, enable_leave=True)
+    cluster = ScalableCluster(n=n, params=params, seed=3)
+    leave = np.zeros((50, n), bool)
+    kill = np.zeros((50, n), bool)
+    revive = np.zeros((50, n), bool)
+    leave[2, :6] = True   # 6 graceful leavers
+    kill[2, 10:13] = True  # 3 crashes
+    revive[25, :6] = True  # leavers rejoin
+    revive[25, 10:13] = True  # crashed restart
+    sched = StormSchedule(ticks=50, n=n, kill=kill, revive=revive, leave=leave)
+    m = cluster.run(sched)
+    assert int(np.asarray(m.leaves_published)[2]) == 6
+    assert int(np.asarray(m.live_nodes)[-1]) == n
+    assert int(np.asarray(m.distinct_checksums)[-1]) == 1
+    ts = np.asarray(cluster.state.truth_status)
+    assert (ts == es.ALIVE).all()
