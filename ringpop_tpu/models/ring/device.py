@@ -24,7 +24,7 @@ import numpy as np
 
 from ringpop_tpu.ops import native
 
-SENTINEL = jnp.uint64(0xFFFFFFFFFFFFFFFF)
+SENTINEL = np.uint64(0xFFFFFFFFFFFFFFFF)  # numpy: import stays device-free
 
 
 def replica_table(addresses, replica_points: int = 100) -> np.ndarray:

@@ -69,7 +69,10 @@ from ringpop_tpu.ops.record_mix import record_mix
 # incarnation: alive < suspect < faulty < leave
 ALIVE, SUSPECT, FAULTY, LEAVE = 0, 1, 2, 3
 
-NO_TARGET = jnp.int32(-1)
+# numpy scalar, not jnp: module import must not initialize a backend
+# (the ambient env registers a single-client TPU tunnel that can be
+# broken/held; device init belongs to callers)
+NO_TARGET = np.int32(-1)
 
 
 class SimParams(NamedTuple):
