@@ -104,9 +104,21 @@ def main() -> int:
 
     last_err = None
     attempts_made = 0
-    for attempt in range(max(1, RETRIES)):
+    total = max(1, RETRIES)
+    for attempt in range(total):
         attempts_made = attempt + 1
         try:
+            if attempt == total - 1 and total > 1:
+                # the TPU tunnel stayed unavailable through every retry —
+                # a CPU measurement beats an error artifact.  A failed pin
+                # degrades to one more plain attempt (keep the original
+                # tunnel error as last_err, not the pin's).
+                try:
+                    from ringpop_tpu.utils.util import pin_cpu_platform
+
+                    pin_cpu_platform()
+                except Exception:
+                    pass
             result = _measure(n, ticks)
             result["attempts"] = attempts_made
             print(json.dumps(result))
@@ -118,7 +130,7 @@ def main() -> int:
             from ringpop_tpu.utils.util import clear_jax_backends
 
             clear_jax_backends()
-            if attempt + 1 < RETRIES:
+            if attempt + 1 < total:
                 time.sleep(RETRY_SLEEP_S)
 
     print(

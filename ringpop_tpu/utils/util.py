@@ -78,3 +78,29 @@ def clear_jax_backends() -> None:
         jeb.clear_backends()
     except Exception:
         pass
+
+
+def pin_cpu_platform(n_devices=None) -> None:
+    """Clear any live JAX backends and force the CPU platform (optionally
+    with ``n_devices`` virtual devices).
+
+    Shared by the driver entry points: the multichip dryrun re-pins onto
+    virtual CPU devices, and the bench falls back to CPU when the TPU
+    tunnel stays unavailable through its retries.  Raises if the pin does
+    not take (e.g. a live backend blocked the config update).
+    """
+    import os
+
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    if n_devices is not None:
+        os.environ["JAX_NUM_CPU_DEVICES"] = str(n_devices)
+    clear_jax_backends()
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    if n_devices is not None:
+        jax.config.update("jax_num_cpu_devices", n_devices)
+    devs = jax.devices()
+    assert devs[0].platform == "cpu", devs
+    if n_devices is not None:
+        assert len(devs) >= n_devices, (len(devs), n_devices)
