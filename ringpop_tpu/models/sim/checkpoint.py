@@ -20,7 +20,10 @@ T = TypeVar("T", bound=tuple)
 
 _FORMAT_KEY = "__ringpop_tpu_state__"
 _PARAMS_KEY = "__ringpop_tpu_params__"
-_FORMAT_VERSION = 1
+# v2: incarnation fields are int32 tick stamps (engine.stamp_to_ms), not
+# int64 epoch-ms values — a v1 checkpoint's ms incarnations would be
+# silently misread as stamps, so loads reject version mismatches
+_FORMAT_VERSION = 2
 
 
 def save_state(path: str, state: Any, params: Any = None) -> None:
@@ -61,6 +64,14 @@ def load_state(path: str, state_cls: Type[T], params: Any = None) -> T:
         if saved_name != state_cls.__name__:
             raise ValueError(
                 "checkpoint holds %s, expected %s" % (saved_name, state_cls.__name__)
+            )
+        saved_version = int(meta[1]) if len(meta) > 1 else 0
+        if saved_version != _FORMAT_VERSION:
+            raise ValueError(
+                "checkpoint format v%d, this build reads v%d (incarnation "
+                "representation changed; a cross-version resume would "
+                "silently corrupt the trajectory)"
+                % (saved_version, _FORMAT_VERSION)
             )
         if params is not None and _PARAMS_KEY in data.files:
             saved_params = json.loads(str(data[_PARAMS_KEY][0]))

@@ -199,18 +199,25 @@ class SimCluster:
         return groups
 
     def membership_of(self, i: int) -> List[dict]:
-        """Node i's member list (sorted by address), host-readable."""
+        """Node i's member list (sorted by address), host-readable.
+
+        Engine stamps are converted back to the reference's epoch-ms
+        incarnation numbers at this boundary (engine.stamp_to_ms)."""
         known = np.asarray(self.state.known[i])
         status = np.asarray(self.state.status[i])
         inc = np.asarray(self.state.inc[i])
+        p = self.params
         out = []
         for j, a in enumerate(self.universe.addresses):
             if known[j]:
+                s = int(inc[j])
                 out.append(
                     {
                         "address": a,
                         "status": ce.STATUS_STRINGS[int(status[j])],
-                        "incarnationNumber": int(inc[j]),
+                        "incarnationNumber": (
+                            p.epoch_ms + (s - 1) * p.period_ms if s > 0 else 0
+                        ),
                     }
                 )
         return out

@@ -20,7 +20,14 @@ Discrete-time model and its documented deviation envelope:
   reference staggers first ticks by 0..200 ms and adapts period length;
   under a controlled schedule those only permute message interleavings).
 - Incarnation clock: ``now_ms = epoch_ms + tick_index * period_ms`` replaces
-  ``Date.now()`` so trajectories are exactly reproducible.
+  ``Date.now()`` so trajectories are exactly reproducible.  Because every
+  incarnation value lies on that grid, the engine stores incarnations as
+  int32 *stamps* (0 = unknown; stamp s > 0 <=> epoch_ms + (s-1)*period_ms)
+  — TPUs emulate 64-bit integer ops, so keeping the hot [N, N] state and
+  the segment-max combine in 32-bit lanes is the difference between the
+  chip winning and losing vs the host CPU.  The int64 ms value is
+  reconstructed (``stamp_to_ms``) only inside the dirty-row parity
+  checksum encode and at host inspection boundaries.
 - A failed direct ping triggers ping-req *within the same tick* (the
   reference's 1.5s/5s timeouts span protocol periods; the sender's gossip
   loop blocks on the exchange either way, gossip/index.js:61-87).
@@ -104,16 +111,16 @@ class SimState(NamedTuple):
     ready: jax.Array  # [N] bool (bootstrapped)
     gossip_on: jax.Array  # [N] bool
     partition: jax.Array  # [N] int32 — group id; unequal groups can't talk
-    # membership views
+    # membership views (incarnations are int32 stamps — see module docstring)
     known: jax.Array  # [N, N] bool
     status: jax.Array  # [N, N] int32
-    inc: jax.Array  # [N, N] int64
+    inc: jax.Array  # [N, N] int32 stamp
     # dissemination change table (per node, keyed by subject)
     ch_active: jax.Array  # [N, N] bool
     ch_status: jax.Array  # [N, N] int32
-    ch_inc: jax.Array  # [N, N] int64
+    ch_inc: jax.Array  # [N, N] int32 stamp
     ch_source: jax.Array  # [N, N] int32
-    ch_source_inc: jax.Array  # [N, N] int64
+    ch_source_inc: jax.Array  # [N, N] int32 stamp
     ch_pb: jax.Array  # [N, N] int32 piggyback counts
     # suspicion deadlines (absolute tick; -1 inactive)
     susp_deadline: jax.Array  # [N, N] int32
@@ -187,9 +194,26 @@ def _overrides(u_status, u_inc, c_status, c_inc):
     return alive_ov | suspect_ov | faulty_ov | leave_ov
 
 
+def stamp_to_ms(stamp: jax.Array, params: "SimParams") -> jax.Array:
+    """int32 incarnation stamp -> the reference's int64 epoch-ms value.
+
+    stamp 0 is the "never asserted" sentinel (encodes as decimal 0, exactly
+    like the reference's zero incarnation); stamp s > 0 is
+    ``epoch_ms + (s-1) * period_ms`` — the value ``Date.now()`` would have
+    produced at that protocol period."""
+    ms = (
+        jnp.int64(params.epoch_ms)
+        + (stamp.astype(jnp.int64) - 1) * params.period_ms
+    )
+    return jnp.where(stamp > 0, ms, jnp.int64(0))
+
+
 def _pack_key(inc, status):
-    """Winner-combine key: lexicographic (incarnation, status-rank)."""
-    return inc.astype(jnp.int64) * 4 + status.astype(jnp.int64)
+    """Winner-combine key: lexicographic (incarnation stamp, status-rank).
+
+    Stamps are small (< ticks + 2), so the packed key stays well inside
+    int32 — the phase-5 segment-max runs in 32-bit lanes on TPU."""
+    return inc.astype(jnp.int32) * 4 + status.astype(jnp.int32)
 
 
 def _max_piggyback(server_count: jax.Array, factor: int) -> jax.Array:
@@ -239,7 +263,7 @@ def init_state(
         )
     n = params.n
     eye = np.eye(n, dtype=bool)
-    inc0 = np.where(eye, params.epoch_ms, 0).astype(np.int64)
+    inc0 = np.where(eye, 1, 0).astype(np.int32)  # stamp 1 == epoch_ms
     rng = np.random.default_rng(seed)
     perm = np.stack([rng.permutation(n) for _ in range(n)]).astype(np.int32)
     keys = rng.integers(1, 2**32 - 1, size=(n, 2), dtype=np.uint32)
@@ -254,9 +278,9 @@ def init_state(
         inc=jnp.asarray(inc0),
         ch_active=jnp.zeros((n, n), bool),
         ch_status=jnp.zeros((n, n), jnp.int32),
-        ch_inc=jnp.zeros((n, n), jnp.int64),
+        ch_inc=jnp.zeros((n, n), jnp.int32),
         ch_source=jnp.full((n, n), -1, jnp.int32),
-        ch_source_inc=jnp.zeros((n, n), jnp.int64),
+        ch_source_inc=jnp.zeros((n, n), jnp.int32),
         ch_pb=jnp.zeros((n, n), jnp.int32),
         susp_deadline=jnp.full((n, n), -1, jnp.int32),
         perm=jnp.asarray(perm),
@@ -283,7 +307,7 @@ def compute_checksums(state: SimState, universe: ce.Universe, params: SimParams)
         universe,
         state.known,
         state.status,
-        state.inc,
+        stamp_to_ms(state.inc, params),  # int64 only inside this branch
         max_digits=params.max_digits,
     )
     return jfh.hash32_rows(bufs, lens)
@@ -324,12 +348,12 @@ def _connected(partition: jax.Array, a: jax.Array, b: jax.Array) -> jax.Array:
 
 def _apply_updates(
     state: SimState,
-    now_ms: jax.Array,
+    now: jax.Array,  # scalar int32 stamp for this tick
     recv_mask: jax.Array,  # [N, N] bool — update for (node, subject)
     u_status: jax.Array,  # [N, N] int32
-    u_inc: jax.Array,  # [N, N] int64
+    u_inc: jax.Array,  # [N, N] int32 stamp
     u_source: jax.Array,  # [N, N] int32
-    u_source_inc: jax.Array,  # [N, N] int64
+    u_source_inc: jax.Array,  # [N, N] int32 stamp
 ):
     """Vectorized Member.evaluateUpdate over (observer, subject) pairs.
 
@@ -343,7 +367,7 @@ def _apply_updates(
     # local override (refute): self claimed suspect/faulty -> alive, fresh inc
     refute = recv_mask & is_self & ((u_status == SUSPECT) | (u_status == FAULTY))
     eff_status = jnp.where(refute, ALIVE, u_status)
-    eff_inc = jnp.where(refute, now_ms, u_inc)
+    eff_inc = jnp.where(refute, now, u_inc)
 
     new_member = recv_mask & ~state.known
     gate = recv_mask & (
@@ -393,10 +417,8 @@ def tick(
     universe: ce.Universe,
 ) -> tuple[SimState, TickMetrics]:
     n = params.n
-    now_ms = (
-        jnp.int64(params.epoch_ms)
-        + (state.tick_index.astype(jnp.int64) + 1) * params.period_ms
-    )
+    # this tick's incarnation stamp: epoch_ms + tick_next*period_ms
+    now = state.tick_index + 2
     node = jnp.arange(n)[:, None]
     subject = jnp.arange(n)[None, :]
     is_self = node == subject
@@ -413,7 +435,7 @@ def tick(
     fresh_known = is_self
     known = jnp.where(rv[:, None], fresh_known, state.known)
     status = jnp.where(rv[:, None], ALIVE, state.status)
-    inc = jnp.where(rv[:, None] & is_self, now_ms, jnp.where(rv[:, None], 0, state.inc))
+    inc = jnp.where(rv[:, None] & is_self, now, jnp.where(rv[:, None], 0, state.inc))
     ready = jnp.where(rv, False, state.ready)
     ch_active = jnp.where(rv[:, None], False, state.ch_active)
     susp_deadline = jnp.where(rv[:, None], -1, state.susp_deadline)
@@ -473,13 +495,13 @@ def tick(
     rj_mask = rejoin[:, None] & is_self
     state = state._replace(
         status=jnp.where(rj_mask, ALIVE, state.status),
-        inc=jnp.where(rj_mask, now_ms, state.inc),
+        inc=jnp.where(rj_mask, now, state.inc),
         gossip_on=state.gossip_on | rejoin,
         ch_active=state.ch_active | rj_mask,
         ch_status=jnp.where(rj_mask, ALIVE, state.ch_status),
-        ch_inc=jnp.where(rj_mask, now_ms, state.ch_inc),
+        ch_inc=jnp.where(rj_mask, now, state.ch_inc),
         ch_source=jnp.where(rj_mask, node, state.ch_source),
-        ch_source_inc=jnp.where(rj_mask, now_ms, state.ch_source_inc),
+        ch_source_inc=jnp.where(rj_mask, now, state.ch_source_inc),
         ch_pb=jnp.where(rj_mask, 0, state.ch_pb),
     )
 
@@ -563,7 +585,7 @@ def tick(
     self_inc = state.inc[jnp.arange(n), jnp.arange(n)]
     state, ja_applied, _, _ = _apply_updates(
         state,
-        now_ms,
+        now,
         ja_mask,
         jnp.full((n, n), ALIVE, jnp.int32),
         jnp.broadcast_to(self_inc[None, :], (n, n)),
@@ -582,6 +604,12 @@ def tick(
     # as of the end of the previous tick (ping-sender.js:70-76 reads it at
     # message-build time, before any same-period receives land)
     advertised_checksum = state.checksum
+    # the sender's self-incarnation rides in the same ping body, read at
+    # the same build time: the phase-5/6 origin filters must compare a
+    # change's sourceIncarnationNumber against THIS value, not the
+    # post-receive one — a sender that refutes a defamation mid-tick bumps
+    # its self-incarnation AFTER its ping body was already built
+    sent_self_inc = state.inc[jnp.arange(n), jnp.arange(n)]
 
     # ---- phase 2: target selection (round-robin iterator) -------------
     participating = state.proc_alive & state.ready & state.gossip_on
@@ -648,7 +676,7 @@ def tick(
     keys = jnp.where(
         sendable & delivered[:, None],
         _pack_key(state.ch_inc, state.ch_status),
-        jnp.int64(-1),
+        jnp.int32(-1),
     )
     recv_key = jax.ops.segment_max(
         keys, seg, num_segments=n + 1, indices_are_sorted=False
@@ -666,7 +694,7 @@ def tick(
     u_source = state.ch_source[ws, subject]
     u_source_inc = state.ch_source_inc[ws, subject]
     state, applied_ping, started, _ = _apply_updates(
-        state, now_ms, recv_mask, u_status, u_inc, u_source, u_source_inc
+        state, now, recv_mask, u_status, u_inc, u_source, u_source_inc
     )
     state = state._replace(
         susp_deadline=jnp.where(
@@ -683,14 +711,13 @@ def tick(
     nrecv = jax.ops.segment_sum(
         delivered.astype(jnp.int32), seg, num_segments=n + 1
     )[:n]
-    diag_inc_5 = state.inc[jnp.arange(n), jnp.arange(n)]
     src_c = jnp.clip(state.ch_source, 0, n - 1)
     origin_hit = (
         state.ch_active
         & (state.ch_source >= 0)
         & delivered[src_c]
         & (target[src_c] == node)
-        & (state.ch_source_inc == diag_inc_5[src_c])
+        & (state.ch_source_inc == sent_self_inc[src_c])
     )
     bump_r = (nrecv[:, None] > 0) & state.ch_active
     nbump = jnp.where(bump_r, nrecv[:, None] - origin_hit.astype(jnp.int32), 0)
@@ -707,11 +734,12 @@ def tick(
 
     # ---- phase 6: responses (issueAsReceiver + full-sync) -------------
     tgt = jnp.clip(target, 0, n - 1)
-    # filter: drop changes the sender itself originated (dissemination.js:91-98)
-    sender_self_inc = state.inc[jnp.arange(n), jnp.arange(n)]
+    # filter: drop changes the sender itself originated (dissemination.js:
+    # 91-98) — matched against the ping-body incarnation (sent_self_inc)
+    cur_self_inc = state.inc[jnp.arange(n), jnp.arange(n)]
     resp_filter = (
         (state.ch_source[tgt] == node)
-        & (state.ch_source_inc[tgt] == sender_self_inc[:, None])
+        & (state.ch_source_inc[tgt] == sent_self_inc[:, None])
     )
     resp_mask = delivered[:, None] & respondable[tgt] & ~resp_filter
     any_resp_change = jnp.any(resp_mask, axis=1)
@@ -731,7 +759,7 @@ def tick(
     )
     apply_resp = resp_mask | fs_mask
     state, applied_resp, started_r, _ = _apply_updates(
-        state, now_ms, apply_resp, r_status, r_inc, r_source, r_source_inc
+        state, now, apply_resp, r_status, r_inc, r_source, r_source_inc
     )
     state = state._replace(
         susp_deadline=jnp.where(
@@ -771,12 +799,12 @@ def tick(
     sus_inc = state.inc[jnp.arange(n), tgt]  # member's current incarnation
     state, applied_sus, started_s, _ = _apply_updates(
         state,
-        now_ms,
+        now,
         sus_mask,
         jnp.full((n, n), SUSPECT, jnp.int32),
         jnp.broadcast_to(sus_inc[:, None], (n, n)),
         jnp.broadcast_to(node, (n, n)).astype(jnp.int32),
-        jnp.broadcast_to(sender_self_inc[:, None], (n, n)),
+        jnp.broadcast_to(cur_self_inc[:, None], (n, n)),
     )
     state = state._replace(
         susp_deadline=jnp.where(
@@ -793,12 +821,12 @@ def tick(
     state = state._replace(susp_deadline=jnp.where(expired, -1, state.susp_deadline))
     state, applied_faulty, _, _ = _apply_updates(
         state,
-        now_ms,
+        now,
         expired,
         jnp.full((n, n), FAULTY, jnp.int32),
         state.inc,  # member's current incarnation (suspicion.js:67-70)
         jnp.broadcast_to(node, (n, n)).astype(jnp.int32),
-        jnp.broadcast_to(sender_self_inc[:, None], (n, n)),
+        jnp.broadcast_to(cur_self_inc[:, None], (n, n)),
     )
 
     # ---- phase 9: checksums + metrics ---------------------------------

@@ -123,7 +123,12 @@ class ScalableState(NamedTuple):
     gossip_on: jax.Array  # [N] bool
     partition: jax.Array  # [N] int32 — group id; unequal groups can't talk
     truth_status: jax.Array  # [N] int32 — latest asserted status
-    truth_inc: jax.Array  # [N] int64 — latest asserted incarnation
+    # latest asserted incarnation as an int32 tick STAMP (0 = never;
+    # stamp s > 0 <=> epoch + (s-1)*200 ms) — every incarnation this
+    # engine mints lies on the discrete tick grid, and TPUs emulate
+    # 64-bit integer ops, so the [N] truth chain and the record_mix
+    # feeding every rumor delta stay in 32-bit lanes
+    truth_inc: jax.Array  # [N] int32 stamp
     # batch-rumor table
     r_active: jax.Array  # [U] bool
     r_delta: jax.Array  # [U] uint32 — checksum delta of the subject set
@@ -237,7 +242,7 @@ def init_state(params: ScalableParams, seed: int = 0) -> ScalableState:
             "into base_sum and erase real divergence" % (u, need, n)
         )
     rng = np.random.default_rng(seed)
-    inc0 = jnp.full(n, params.epoch, jnp.int64)
+    inc0 = jnp.ones(n, jnp.int32)  # stamp 1 == params.epoch
     subj = jnp.arange(n, dtype=jnp.int32)
     base = record_mix(subj, jnp.zeros(n, jnp.int32), inc0)
     return ScalableState(
@@ -265,7 +270,7 @@ def _publish_batch(
     slot: jax.Array,  # scalar int32 — pre-cleared slot for this tick
     subj_mask: jax.Array,  # [N] bool — members this event touches
     new_status: jax.Array,  # [N] int32 (per subject)
-    new_inc: jax.Array,  # [N] int64 (per subject)
+    new_inc: jax.Array,  # [N] int32 stamp (per subject)
     hearer_mask: jax.Array,  # [N] bool — nodes that know at publish time
     tick: jax.Array,
 ) -> ScalableState:
@@ -327,7 +332,7 @@ def tick(
 ) -> tuple[ScalableState, ScalableMetrics]:
     n, u = params.n, params.u
     t = state.tick_index + 1
-    now = jnp.int64(params.epoch) + t.astype(jnp.int64) * 200
+    now = t + 1  # int32 stamp == epoch + t*200 ms
     rng = state.rng
     ids = jnp.arange(n, dtype=jnp.int32)
 
@@ -361,7 +366,9 @@ def tick(
     # aging: the batched analog of the per-change piggyback drop rule
     live_count = jnp.sum(proc_alive.astype(jnp.int32))
     digits = jnp.sum(
-        live_count >= 10 ** jnp.arange(10, dtype=jnp.int64), dtype=jnp.int32
+        live_count.astype(jnp.int64)
+        >= 10 ** jnp.arange(10, dtype=jnp.int64),
+        dtype=jnp.int32,
     )
     max_age = params.piggyback_factor * digits + params.age_slack
     aged = state.r_active & (t - state.r_birth > max_age)
@@ -372,6 +379,17 @@ def tick(
     ).astype(jnp.int32)
     recycled = jnp.zeros(u, bool).at[slots].set(True)
     retired = aged | (state.r_active & recycled)
+    # a defame_slot pointer whose slot is recycled this tick would, after
+    # the slot's reuse, read an unrelated rumor's heard bit — clear it,
+    # treating the retired defamation as "aged into base" explicitly (the
+    # live defamed node already had >= 2 aware ticks to refute between
+    # aging and recycling, per the init_state capacity check)
+    ds0 = state.defame_slot
+    state = state._replace(
+        defame_slot=jnp.where(
+            (ds0 >= 0) & recycled[jnp.clip(ds0, 0, u - 1)], -1, ds0
+        )
+    )
     # fold retired deltas into the shared base (dissemination has long
     # completed by retirement age; every live node already counts them)
     base_sum = state.base_sum + jnp.sum(
@@ -544,7 +562,7 @@ def tick(
         slots[2],
         alive_subjects,
         jnp.full(n, ALIVE, jnp.int32),
-        jnp.full(n, now, jnp.int64),  # fresh incarnation (member.js:78-81)
+        jnp.full(n, now, jnp.int32),  # fresh incarnation (member.js:78-81)
         alive_subjects,
         t,
     )

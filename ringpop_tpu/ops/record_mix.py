@@ -13,11 +13,18 @@ import jax.numpy as jnp
 
 
 def record_mix(subject, status, inc):
-    """[...]-shaped int arrays -> uint32 record hash (elementwise)."""
+    """[...]-shaped int arrays -> uint32 record hash (elementwise).
+
+    ``inc`` is an int32 tick stamp from both simulator engines' hot paths;
+    int64 inputs (storm.py's ring-key mixing) still hash the high word.
+    32-bit inputs skip it so the whole mix stays in 32-bit lanes on TPU."""
     x = subject.astype(jnp.uint32) * jnp.uint32(0x9E3779B1)
     x ^= status.astype(jnp.uint32) * jnp.uint32(0x85EBCA77)
-    x ^= (inc & 0xFFFFFFFF).astype(jnp.uint32) * jnp.uint32(0xC2B2AE3D)
-    x ^= ((inc >> 32) & 0xFFFFFFFF).astype(jnp.uint32) * jnp.uint32(0x27D4EB2F)
+    x ^= inc.astype(jnp.uint32) * jnp.uint32(0xC2B2AE3D)
+    if inc.dtype.itemsize > 4:
+        x ^= ((inc >> 32) & 0xFFFFFFFF).astype(jnp.uint32) * jnp.uint32(
+            0x27D4EB2F
+        )
     x ^= x >> 15
     x *= jnp.uint32(0x2C1B3C6D)
     x ^= x >> 13

@@ -355,6 +355,12 @@ class OracleCluster:
             self._apply(t, ups, tick_next)
 
         advertised = self.checksum.copy()
+        # sender self-incarnation at ping-build time (rides in the ping
+        # body) — the phase-5/6 origin filters compare against this, not
+        # the post-receive value (engine: sent_self_inc)
+        diag_inc_sent = np.array(
+            [self._self_inc(i) for i in range(n)], np.int64
+        )
 
         # ---- phase 2: target selection ----------------------------------
         known1, status1, inc1 = self._views()
@@ -449,7 +455,7 @@ class OracleCluster:
                     ch.source >= 0
                     and delivered[ch.source]
                     and target[ch.source] == r
-                    and ch.source_inc == diag_inc_post5[ch.source]
+                    and ch.source_inc == diag_inc_sent[ch.source]
                 )
                 ch.pb += int(nrecv[r]) - int(origin_hit)
                 if ch.pb > max_pb[r]:
@@ -472,7 +478,7 @@ class OracleCluster:
             resp = {
                 j: ch
                 for j, ch in respondable[t].items()
-                if not (ch.source == s and ch.source_inc == diag_inc_post5[s])
+                if not (ch.source == s and ch.source_inc == diag_inc_sent[s])
             }
             if resp:
                 ups = [
