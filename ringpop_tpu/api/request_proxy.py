@@ -57,7 +57,37 @@ class RequestProxy:
         self.retry_schedule_s = opts.get("retrySchedule", RETRY_SCHEDULE_S)
         self.max_retries = opts.get("maxRetries", DEFAULT_MAX_RETRIES)
         self.enforce_consistency = opts.get("enforceConsistency", True)
+        # buffered-body cap forwarded to every send, overridable per
+        # request (lib/request-proxy/index.js:88-90); None = unlimited
+        self.body_limit = opts.get("bodyLimit")
         self.destroyed = False
+
+    @staticmethod
+    def _body_length(body: Any) -> int:
+        """Byte length of the body as it will ride the wire — the analog
+        of the reference buffering the raw request stream."""
+        if body is None:
+            return 0
+        if isinstance(body, (bytes, bytearray)):
+            return len(body)
+        import json
+
+        # everything else rides the channel as its JSON encoding
+        return len(json.dumps(body).encode("utf-8"))
+
+    def _check_body_limit(self, body: Any, limit: Optional[int]) -> None:
+        if limit is None:
+            return
+        length = self._body_length(body)
+        if length > limit:
+            # reference: body-module limit error -> logger.warn
+            # 'requestProxy encountered malformed body' -> sendError(res)
+            # (lib/request-proxy/index.js:93-100)
+            self.ringpop.logger.warning(
+                "requestProxy encountered malformed body",
+                extra={"limit": limit, "length": length},
+            )
+            raise errors.BodyLimitExceededError(limit=limit, length=length)
 
     # -- client side ------------------------------------------------------
 
@@ -72,10 +102,19 @@ class RequestProxy:
         timeout_s = (opts.get("timeout") or self.ringpop.proxy_req_timeout_ms) / 1000.0
         max_retries = opts.get("maxRetries", self.max_retries)
         endpoint = opts.get("endpoint", "/proxy/req")
+        self._check_body_limit(
+            req.get("body"), opts.get("bodyLimit", self.body_limit)
+        )
 
         self.ringpop.stat("increment", "requestProxy.requests.outgoing")
         attempt = 0
         while True:
+            if self.destroyed:
+                # the reference re-checks before every forwarding attempt:
+                # a proxy destroyed mid-retry aborts the in-flight send
+                # ('Channel was destroyed before forwarding attempt',
+                # test/integration/proxy-test.js:1039-1063)
+                raise errors.RequestProxyDestroyedError()
             head = {
                 "url": req.get("url"),
                 "method": req.get("method", "GET"),

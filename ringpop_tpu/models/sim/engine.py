@@ -224,6 +224,28 @@ def _max_piggyback(server_count: jax.Array, factor: int) -> jax.Array:
     return factor * count
 
 
+_COPRIME_CACHE: dict = {}
+
+
+def _coprimes_of(n: int, k: int = 128) -> np.ndarray:
+    """Up to ``k`` integers coprime to ``n``, spread evenly over [1, n).
+
+    Static per engine size (n is a compile-time constant): multipliers for
+    the affine row permutations drawn at iterator reshuffle.  n*n must fit
+    int32, which holds for every full-fidelity engine size (N^2 state caps
+    N at a few thousand)."""
+    got = _COPRIME_CACHE.get((n, k))
+    if got is None:
+        assert n < 46341, "affine reshuffle index math needs n*n < 2^31"
+        import math
+
+        cops = [a for a in range(1, n) if math.gcd(a, n) == 1]
+        step = max(1, -(-len(cops) // k))  # ceil: even spread over [1, n)
+        got = np.asarray(cops[::step][:k], np.int32)
+        _COPRIME_CACHE[(n, k)] = got
+    return got
+
+
 def _fold(rng: jax.Array, salt: int) -> jax.Array:
     """Cheap per-node key derivation: [N, 2] uint32 -> new [N, 2] uint32."""
     k0 = rng[:, 0] * np.uint32(0x9E3779B9) + np.uint32(salt)
@@ -632,15 +654,34 @@ def tick(
         participating & has_target, (state.iter_pos + first_k + 1) % n, state.iter_pos
     )
     # reshuffle permutation on wrap (membership/iterator.js:38-41).  The
-    # [N, N] argsort is the single hottest non-checksum op in the tick, and
-    # rows wrap only once per full round — skip it entirely on wrap-free
-    # ticks (the uniform draw is a pure function of state.rng, so skipping
-    # changes no other randomness)
+    # reference Fisher-Yates-shuffles the member list; any fresh pseudo-
+    # random permutation per wrapped row is inside its nondeterminism
+    # envelope.  A full [N, N] argsort here was the hottest op in the
+    # steady-state tick (a 1k-node cluster wraps ~one row per tick, firing
+    # the cond almost always), so rows are instead re-drawn as affine
+    # re-indexings of one shared hashed base permutation:
+    #   new_perm[i, j] = base[(a_i * j + b_i) mod n]
+    # with a_i drawn from the (static) coprimes of n — a permutation for
+    # every (a_i, b_i), no sort, one [N, N] gather.  base itself is an [N]
+    # argsort of fresh uniforms, so the family is re-randomized each wrap
+    # tick.  Skipped entirely on wrap-free ticks (the draws are pure
+    # functions of state.rng, so skipping changes no other randomness).
+    # The host oracle mirrors this arithmetic bitwise (parity/oracle.py).
     resh = wrapped & participating
+    coprimes = _coprimes_of(n)  # static [K] int32
 
     def _reshuffled(_):
-        shuf_rand = _uniform(state.rng, (n, n), salt=7)
-        new_perm = jnp.argsort(shuf_rand, axis=1).astype(jnp.int32)
+        base = jnp.argsort(_uniform(state.rng, (n,), salt=77)).astype(
+            jnp.int32
+        )
+        r = _uniform(state.rng, (n, 2), salt=7)
+        k_cop = np.int32(len(coprimes))
+        a = jnp.asarray(coprimes)[
+            jnp.clip((r[:, 0] * k_cop).astype(jnp.int32), 0, k_cop - 1)
+        ]
+        b = (r[:, 1] * np.float32(n)).astype(jnp.int32) % n
+        idx = (a[:, None] * jnp.arange(n, dtype=jnp.int32) + b[:, None]) % n
+        new_perm = base[idx]
         return jnp.where(resh[:, None], new_perm, state.perm)
 
     perm = jax.lax.cond(

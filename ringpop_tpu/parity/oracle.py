@@ -384,10 +384,22 @@ class OracleCluster:
         )
         resh = wrapped & participating
         if resh.any():  # engine skips the draw on wrap-free ticks too
-            shuf_rand = _np_uniform(self.rng, (n, n), salt=7)
-            new_perm = np.argsort(shuf_rand, axis=1, kind="stable").astype(
-                np.int32
-            )
+            # affine re-indexing of a hashed base permutation — mirrors
+            # engine._reshuffled bitwise (same f32 uniforms, same int math)
+            base = np.argsort(
+                _np_uniform(self.rng, (n,), salt=77), kind="stable"
+            ).astype(np.int32)
+            r = _np_uniform(self.rng, (n, 2), salt=7)
+            cops = engine._coprimes_of(n)
+            k_cop = np.int32(len(cops))
+            a = cops[
+                np.clip((r[:, 0] * k_cop).astype(np.int32), 0, k_cop - 1)
+            ]
+            b = (r[:, 1] * np.float32(n)).astype(np.int32) % n
+            idx = (
+                a[:, None] * np.arange(n, dtype=np.int32) + b[:, None]
+            ) % n
+            new_perm = base[idx]
             self.perm = np.where(resh[:, None], new_perm, self.perm)
         valid_send = target >= 0
 

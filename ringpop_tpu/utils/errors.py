@@ -24,7 +24,13 @@ class RingpopError(Exception):
         super().__init__(message)
 
     def to_dict(self) -> Dict[str, Any]:
-        return {"type": self.type, "message": str(self), **self.fields}
+        out = {"type": self.type, "message": str(self), **self.fields}
+        status = getattr(self, "status_code", None)
+        if status is not None:
+            # the reference's sendError maps err.statusCode onto the HTTP
+            # response (request-proxy/index.js sendError: statusCode || 500)
+            out["statusCode"] = status
+        return out
 
 
 class AppRequiredError(RingpopError):
@@ -135,6 +141,17 @@ class KeysDivergedError(RingpopError):
 class RequestProxyDestroyedError(RingpopError):
     type = "ringpop.request-proxy.destroyed"
     template = "Request proxy was destroyed before it could proxy your request"
+
+
+class BodyLimitExceededError(RingpopError):
+    """The node `body` module's limit error: the reference forwards request
+    bodies through body(req, res, {limit: opts.bodyLimit}, ...)
+    (lib/request-proxy/index.js:88-90) and an oversized body fails the
+    forward with a 413 'request entity too large'."""
+
+    type = "ringpop.request-proxy.body-limit"
+    template = "request entity too large (limit {limit}, got {length})"
+    status_code = 413
 
 
 class RedundantLeaveError(RingpopError):

@@ -228,3 +228,163 @@ def test_sharding_handler_no_sk_serves_locally(cluster):
         sender.whoami(), "/app/nosk", head={}, body={}
     )
     assert body["servedBy"] == sender.whoami()
+
+
+def test_body_limit_enforced_and_at_limit_passes(cluster):
+    """Oversized buffered bodies fail the forward with the body-module's
+    413 (lib/request-proxy/index.js:88-100); a body exactly at the limit
+    forwards fine (proxy-test.js 'proxies big json').  Like the
+    reference, enforcement is sender-side only: handleRequest
+    (index.js:168-229) never re-checks the limit on the receive path."""
+    c = cluster(n=2)
+    wire_echo_handlers(c)
+    sender, dest = c.node(0), c.node(1)
+    key = key_owned_by(c, dest, tag="bl")
+
+    big = "x" * 512
+    limit = len('"%s"' % big)  # serialized length, like the raw stream
+    res = sender.proxy_req(
+        {
+            "keys": [key],
+            "dest": dest.whoami(),
+            "req": {"url": "/b", "body": big},
+            "bodyLimit": limit,
+        }
+    )
+    assert res["body"]["handledBy"] == dest.whoami()
+
+    with pytest.raises(errors.BodyLimitExceededError) as ei:
+        sender.proxy_req(
+            {
+                "keys": [key],
+                "dest": dest.whoami(),
+                "req": {"url": "/b", "body": big + "y"},
+                "bodyLimit": limit,
+            }
+        )
+    assert ei.value.fields["limit"] == limit
+    assert ei.value.fields["length"] > limit
+
+
+def test_max_retries_zero_fails_fast(cluster):
+    """maxRetries=0: a failed first attempt raises immediately with no
+    retry (proxy-test.js requestProxyMaxRetries:0)."""
+    c = cluster(n=2)
+    sender = c.node(0)
+    before = _stat_count(sender, "requestProxy.retry.attempted")
+    with pytest.raises(errors.MaxRetriesExceededError):
+        sender.proxy_req(
+            {
+                "keys": ["k"],
+                "dest": "127.0.0.1:1",
+                "req": {"url": "/x"},
+                "maxRetries": 0,
+            }
+        )
+    assert _stat_count(sender, "requestProxy.retry.attempted") == before
+    assert _stat_count(sender, "requestProxy.retry.failed") >= 1
+
+
+def test_max_retries_five_exhaustion_counts_attempts(cluster):
+    """maxRetries=5 against a permanently-dead owner retries exactly 5
+    times, then fails (proxy-test.js requestProxyMaxRetries:5)."""
+    c = cluster(n=2)
+    sender = c.node(0)
+    sender.request_proxy.retry_schedule_s = [0.0]
+    # a key that re-looks-up to a dead address every time: phantom member
+    # added to the SENDER's ring only
+    phantom = "127.0.0.1:19997"
+    sender.ring.add_server(phantom)
+    key = None
+    for i in range(10000):
+        k = "ex-%d" % i
+        if sender.lookup(k) == phantom:
+            key = k
+            break
+    assert key is not None
+    before = _stat_count(sender, "requestProxy.retry.attempted")
+    with pytest.raises(errors.MaxRetriesExceededError) as ei:
+        sender.proxy_req(
+            {
+                "keys": [key],
+                "dest": phantom,
+                "req": {"url": "/x"},
+                "maxRetries": 5,
+            }
+        )
+    assert ei.value.fields["maxRetries"] == 5
+    assert _stat_count(sender, "requestProxy.retry.attempted") - before == 5
+
+
+def test_destroy_mid_retry_aborts_forwarding(cluster):
+    """A proxy destroyed between attempts aborts the in-flight retry
+    ('Channel was destroyed before forwarding attempt',
+    proxy-test.js:1039-1063)."""
+    c = cluster(n=2)
+    sender = c.node(0)
+    sender.request_proxy.retry_schedule_s = [0.0]
+    remote = c.node(1).whoami()
+
+    def destroy_then_relookup(keys, dest):
+        # destroyed between attempts; re-route lands on a REMOTE owner so
+        # the loop re-enters its pre-attempt destroyed check
+        sender.request_proxy.destroy()
+        return remote
+
+    sender.request_proxy._relookup = destroy_then_relookup
+    with pytest.raises(errors.RequestProxyDestroyedError):
+        sender.proxy_req(
+            {"keys": ["k"], "dest": "127.0.0.1:1", "req": {"url": "/x"}}
+        )
+
+
+def test_keys_diverged_through_full_retry_path(cluster):
+    """Divergent keys abort at the retry re-lookup inside proxy_req, not
+    just in _relookup directly (send.js:91-104)."""
+    c = cluster(n=3)
+    sender = c.node(0)
+    k1 = key_owned_by(c, c.node(1), tag="fd1")
+    k2 = key_owned_by(c, c.node(2), tag="fd2")
+    sender.request_proxy.retry_schedule_s = [0.0]
+    with pytest.raises(errors.KeysDivergedError) as ei:
+        sender.proxy_req(
+            {
+                "keys": [k1, k2],
+                "dest": "127.0.0.1:1",  # first attempt fails -> re-lookup
+                "req": {"url": "/x"},
+            }
+        )
+    assert sorted(ei.value.fields["keys"]) == sorted([k1, k2])
+
+
+def test_forwarded_head_fidelity(cluster):
+    """The routing envelope carries url, method, headers, httpVersion,
+    the sender's checksum, and the keys (util.js:22-35)."""
+    c = cluster(n=2)
+    sender, dest = c.node(0), c.node(1)
+    key = key_owned_by(c, dest, tag="hf")
+    seen = {}
+
+    def handler(req, res, head):
+        seen.update(head)
+        res.end({"ok": True})
+
+    dest.on("request", handler)
+    sender.proxy_req(
+        {
+            "keys": [key],
+            "dest": dest.whoami(),
+            "req": {
+                "url": "/fidelity?q=1",
+                "method": "PUT",
+                "headers": {"x-app": "v"},
+                "httpVersion": "1.0",
+            },
+        }
+    )
+    assert seen["url"] == "/fidelity?q=1"
+    assert seen["method"] == "PUT"
+    assert seen["headers"] == {"x-app": "v"}
+    assert seen["httpVersion"] == "1.0"
+    assert seen["ringpopKeys"] == [key]
+    assert seen["ringpopChecksum"] == sender.membership.checksum
