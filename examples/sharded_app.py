@@ -1,0 +1,78 @@
+"""Runnable sharded-app example: the sharding handler in front of an app
+endpoint (the analog of /root/reference/examples/tchannel-forwarding.js).
+
+Three nodes form a ring; each registers an app endpoint ``hello`` behind a
+``RingpopHandler``.  A request carrying a shard key (``sk`` header) sent to
+ANY node is answered by the key's ring owner — relayed transparently when
+that owner is another node.
+
+Run it:
+
+    JAX_PLATFORMS=cpu PYTHONPATH=. python examples/sharded_app.py
+"""
+
+import threading
+
+from ringpop_tpu.api.handler import RingpopHandler
+from ringpop_tpu.api.ringpop import Ringpop
+from ringpop_tpu.net.channel import Channel
+
+
+class App:
+    def __init__(self, name: str):
+        self.name = name
+        self.channel = Channel("127.0.0.1:0")
+        host_port = self.channel.listen()
+        self.ringpop = Ringpop(
+            "example-app",
+            host_port,
+            channel=self.channel,
+            options={"autoGossip": False},
+        )
+
+        def hello(head, body):
+            # (headers, body) -> answered by the sk owner, wherever the
+            # request entered the cluster
+            return None, "hello from %s for %s" % (self.name, head.get("sk"))
+
+        RingpopHandler(self.ringpop, hello, "hello").register()
+
+    def bootstrap(self, hosts):
+        self.ringpop.bootstrap(hosts)
+
+    def whoami(self):
+        return self.ringpop.whoami()
+
+
+def main():
+    apps = [App("app%d" % i) for i in range(3)]
+    hosts = [a.whoami() for a in apps]
+
+    threads = [
+        threading.Thread(target=a.bootstrap, args=(hosts,)) for a in apps
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(30.0)
+    for _ in range(40):  # gossip until every node shares one checksum
+        for a in apps:
+            a.ringpop.gossip.tick()
+        if len({a.ringpop.membership.checksum for a in apps}) == 1:
+            break
+    print("cluster converged:", ", ".join(hosts))
+
+    entry = apps[0]
+    for sk in ("alpha", "bravo", "charlie", "delta"):
+        owner = entry.ringpop.lookup(sk)
+        _, body = entry.channel.request(
+            entry.whoami(), "hello", head={"sk": sk}, body=None
+        )
+        print("sk=%-8s owner=%s -> %r" % (sk, owner, body))
+
+    for a in apps:
+        a.ringpop.destroy()
+
+
+if __name__ == "__main__":
+    main()

@@ -109,10 +109,14 @@ class RequestProxy:
         self.ringpop.stat("increment", "requestProxy.requests.outgoing")
         attempt = 0
         while True:
-            if self.destroyed:
-                # the reference re-checks before every forwarding attempt:
-                # a proxy destroyed mid-retry aborts the in-flight send
-                # ('Channel was destroyed before forwarding attempt',
+            if self.destroyed or getattr(
+                self.ringpop.channel, "destroyed", False
+            ):
+                # the reference re-checks before every forwarding attempt —
+                # a proxy OR channel destroyed mid-retry aborts the
+                # in-flight send rather than burning the retry schedule
+                # against a dead channel ('Channel was destroyed before
+                # forwarding attempt', send.js:228-234,
                 # test/integration/proxy-test.js:1039-1063)
                 raise errors.RequestProxyDestroyedError()
             head = {

@@ -455,6 +455,7 @@ class Ringpop(EventEmitter):
         self.suspicion.stop_all()
         self.membership_update_rollup.destroy()
         self.tracers.destroy()
+        self.request_proxy.destroy()
         if self.channel is not None:
             self.channel.destroy()
         self.destroyed = True

@@ -124,8 +124,11 @@ class SimState(NamedTuple):
     ch_pb: jax.Array  # [N, N] int32 piggyback counts
     # suspicion deadlines (absolute tick; -1 inactive)
     susp_deadline: jax.Array  # [N, N] int32
-    # iterator state
-    perm: jax.Array  # [N, N] int32 — per-node member iteration order
+    # iterator state, stored INVERSE: perm_inv[i, m] = position of member m
+    # in node i's iteration order.  Target selection then needs only
+    # elementwise walk-rank math + one argmin — no [N, N] gathers (TPU
+    # gathers of permuted columns are far costlier than a row reduction)
+    perm_inv: jax.Array  # [N, N] int32
     iter_pos: jax.Array  # [N] int32
     # per-node PRNG keys
     rng: jax.Array  # [N, 2] uint32
@@ -227,8 +230,9 @@ def _max_piggyback(server_count: jax.Array, factor: int) -> jax.Array:
 _COPRIME_CACHE: dict = {}
 
 
-def _coprimes_of(n: int, k: int = 128) -> np.ndarray:
-    """Up to ``k`` integers coprime to ``n``, spread evenly over [1, n).
+def _coprimes_of(n: int, k: int = 128):
+    """(coprimes, modular inverses): up to ``k`` integers coprime to ``n``,
+    spread evenly over [1, n), plus their inverses mod n.
 
     Static per engine size (n is a compile-time constant): multipliers for
     the affine row permutations drawn at iterator reshuffle.  n*n must fit
@@ -241,7 +245,11 @@ def _coprimes_of(n: int, k: int = 128) -> np.ndarray:
 
         cops = [a for a in range(1, n) if math.gcd(a, n) == 1]
         step = max(1, -(-len(cops) // k))  # ceil: even spread over [1, n)
-        got = np.asarray(cops[::step][:k], np.int32)
+        chosen = cops[::step][:k]
+        got = (
+            np.asarray(chosen, np.int32),
+            np.asarray([pow(a, -1, n) for a in chosen], np.int32),
+        )
         _COPRIME_CACHE[(n, k)] = got
     return got
 
@@ -288,6 +296,7 @@ def init_state(
     inc0 = np.where(eye, 1, 0).astype(np.int32)  # stamp 1 == epoch_ms
     rng = np.random.default_rng(seed)
     perm = np.stack([rng.permutation(n) for _ in range(n)]).astype(np.int32)
+    perm_inv = np.argsort(perm, axis=1).astype(np.int32)  # same walk order
     keys = rng.integers(1, 2**32 - 1, size=(n, 2), dtype=np.uint32)
     state = SimState(
         tick_index=jnp.int32(0),
@@ -305,7 +314,7 @@ def init_state(
         ch_source_inc=jnp.zeros((n, n), jnp.int32),
         ch_pb=jnp.zeros((n, n), jnp.int32),
         susp_deadline=jnp.full((n, n), -1, jnp.int32),
-        perm=jnp.asarray(perm),
+        perm_inv=jnp.asarray(perm_inv),
         iter_pos=jnp.zeros(n, jnp.int32),
         rng=jnp.asarray(keys),
         checksum=jnp.zeros(n, jnp.uint32),
@@ -640,16 +649,17 @@ def tick(
         & ((state.status == ALIVE) | (state.status == SUSPECT))
         & ~is_self
     )
-    # walk perm starting at iter_pos, pick first pingable
-    k = jnp.arange(n)[None, :]
-    pos = (state.iter_pos[:, None] + k) % n
-    cand = jnp.take_along_axis(state.perm, pos, axis=1)  # [N, N] member order
-    cand_pingable = jnp.take_along_axis(pingable, cand, axis=1)
-    first_k = jnp.argmax(cand_pingable, axis=1).astype(jnp.int32)
-    has_target = jnp.any(cand_pingable, axis=1)
-    target = jnp.take_along_axis(cand, first_k[:, None], axis=1)[:, 0]
+    # first pingable member in walk order == the pingable member with the
+    # smallest walk rank; rank is elementwise from the stored inverse
+    # permutation, so the whole selection is one [N, N] mod/compare plus a
+    # row argmin — no gathers
+    walk_rank = (state.perm_inv - state.iter_pos[:, None]) % n
+    masked_rank = jnp.where(pingable, walk_rank, n)
+    first_k = jnp.min(masked_rank, axis=1).astype(jnp.int32)
+    has_target = first_k < n
+    target = jnp.argmin(masked_rank, axis=1).astype(jnp.int32)
     target = jnp.where(participating & has_target, target, NO_TARGET)
-    wrapped = (state.iter_pos + first_k) >= n
+    wrapped = has_target & ((state.iter_pos + first_k) >= n)
     iter_pos = jnp.where(
         participating & has_target, (state.iter_pos + first_k + 1) % n, state.iter_pos
     )
@@ -668,26 +678,34 @@ def tick(
     # functions of state.rng, so skipping changes no other randomness).
     # The host oracle mirrors this arithmetic bitwise (parity/oracle.py).
     resh = wrapped & participating
-    coprimes = _coprimes_of(n)  # static [K] int32
+    coprimes, coprime_invs = _coprimes_of(n)  # static [K] int32 each
 
     def _reshuffled(_):
+        # perm[i, j] = base[(a_i*j + b_i) mod n]  (oracle materializes this
+        # directly); stored inverse: perm_inv[i, m] =
+        # a_i^-1 * (base_inv[m] - b_i) mod n — all elementwise
         base = jnp.argsort(_uniform(state.rng, (n,), salt=77)).astype(
             jnp.int32
         )
+        base_inv = (
+            jnp.zeros(n, jnp.int32)
+            .at[base]
+            .set(jnp.arange(n, dtype=jnp.int32))
+        )
         r = _uniform(state.rng, (n, 2), salt=7)
         k_cop = np.int32(len(coprimes))
-        a = jnp.asarray(coprimes)[
-            jnp.clip((r[:, 0] * k_cop).astype(jnp.int32), 0, k_cop - 1)
-        ]
+        a_idx = jnp.clip((r[:, 0] * k_cop).astype(jnp.int32), 0, k_cop - 1)
+        a_inv = jnp.asarray(coprime_invs)[a_idx]
         b = (r[:, 1] * np.float32(n)).astype(jnp.int32) % n
-        idx = (a[:, None] * jnp.arange(n, dtype=jnp.int32) + b[:, None]) % n
-        new_perm = base[idx]
-        return jnp.where(resh[:, None], new_perm, state.perm)
+        idx = (
+            a_inv[:, None] * ((base_inv[None, :] - b[:, None]) % n)
+        ) % n
+        return jnp.where(resh[:, None], idx, state.perm_inv)
 
-    perm = jax.lax.cond(
-        jnp.any(resh), _reshuffled, lambda _: state.perm, operand=None
+    perm_inv = jax.lax.cond(
+        jnp.any(resh), _reshuffled, lambda _: state.perm_inv, operand=None
     )
-    state = state._replace(perm=perm, iter_pos=iter_pos)
+    state = state._replace(perm_inv=perm_inv, iter_pos=iter_pos)
 
     valid_send = target >= 0
 

@@ -390,7 +390,7 @@ class OracleCluster:
                 _np_uniform(self.rng, (n,), salt=77), kind="stable"
             ).astype(np.int32)
             r = _np_uniform(self.rng, (n, 2), salt=7)
-            cops = engine._coprimes_of(n)
+            cops, _ = engine._coprimes_of(n)
             k_cop = np.int32(len(cops))
             a = cops[
                 np.clip((r[:, 0] * k_cop).astype(np.int32), 0, k_cop - 1)

@@ -388,3 +388,23 @@ def test_forwarded_head_fidelity(cluster):
     assert seen["httpVersion"] == "1.0"
     assert seen["ringpopKeys"] == [key]
     assert seen["ringpopChecksum"] == sender.membership.checksum
+
+
+def test_channel_destroy_mid_retry_aborts_forwarding(cluster):
+    """The real reference path: the CHANNEL dying mid-retry (ringpop
+    destroyed / channel.quit()) aborts the forward instead of burning the
+    whole retry schedule against a dead channel (send.js:228-234)."""
+    c = cluster(n=2)
+    sender = c.node(0)
+    sender.request_proxy.retry_schedule_s = [0.0]
+    remote = c.node(1).whoami()
+
+    def destroy_ringpop_then_relookup(keys, dest):
+        sender.destroy()  # destroys channel AND proxy, like production
+        return remote
+
+    sender.request_proxy._relookup = destroy_ringpop_then_relookup
+    with pytest.raises(errors.RequestProxyDestroyedError):
+        sender.proxy_req(
+            {"keys": ["k"], "dest": "127.0.0.1:1", "req": {"url": "/x"}}
+        )

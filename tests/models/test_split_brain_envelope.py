@@ -1,0 +1,145 @@
+"""Split-brain deviation envelope: scalable engine vs full engine, measured.
+
+The scalable engine keeps ONE global truth chain, so a partitioned side's
+suspicions are cancelled the moment the accused side's refute lands —
+where the reference (and the full [N, N] engine, parity-pinned against the
+host oracle) lets the cut-off side escalate suspect -> faulty and merge
+views only after the heal (docstring, engine_scalable.py).  These tests
+bound that deviation with numbers instead of prose: both engines run the
+same scenario SHAPE (split one tenth of the cluster away for > the
+suspicion window, then heal) and must agree on the qualitative
+convergence shape —
+
+- the split produces cross-side false suspects on both engines,
+- both sides keep making progress during the split,
+- after the heal both engines reconverge to a single all-alive view
+  within a bounded number of ticks, with every false mark refuted.
+
+The measured difference — the full engine marks cross-side FAULTY during
+the split while the scalable engine's refutes cancel first — is asserted
+here as the envelope's edge, and the numbers are recorded in COVERAGE.md.
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from ringpop_tpu.models.sim import engine, engine_scalable as es
+from ringpop_tpu.models.sim.cluster import EventSchedule, SimCluster
+
+
+def run_full_engine_split(n=1024, split_frac=0.1, split_ticks=35, heal_ticks=60):
+    """Full engine: partition `split_frac` of nodes away, heal, measure."""
+    params = engine.SimParams(n=n, checksum_mode="fast")
+    sim = SimCluster(n=n, params=params)
+    sim.bootstrap()
+    assert sim.run_until_converged(40) > 0
+
+    cut = int(n * split_frac)
+    part = np.zeros(n, np.int32)
+    part[:cut] = 1
+
+    sched = EventSchedule(ticks=split_ticks, n=n)
+    sched.partition[0] = part
+    m_split = sim.run(sched)
+
+    # cross-side faulty marks at split end: majority side's view of the cut
+    status = np.asarray(sim.state.status)
+    faulty_marks = int(
+        (status[cut:, :cut] == engine.FAULTY).sum()
+    )
+    suspect_marks = int((status[cut:, :cut] == engine.SUSPECT).sum())
+
+    heal = EventSchedule(ticks=heal_ticks, n=n)
+    heal.partition[0] = np.zeros(n, np.int32)
+    m_heal = sim.run(heal)
+    converged_at = next(
+        (i + 1 for i, c in enumerate(np.asarray(m_heal.converged)) if c), -1
+    )
+    status = np.asarray(sim.state.status)
+    return {
+        "suspects_during_split": int(np.asarray(m_split.suspects_marked).sum()),
+        "faulty_marks_at_heal": faulty_marks,
+        "suspect_marks_at_heal": suspect_marks,
+        "reconverge_ticks": converged_at,
+        "residual_bad_marks": int((status >= engine.SUSPECT).sum()),
+    }
+
+
+def run_scalable_split(n=100_000, split_frac=0.1, split_ticks=35, heal_ticks=80):
+    params = es.ScalableParams(n=n, u=512, suspicion_ticks=25)
+    state = es.init_state(params, seed=0)
+    step = jax.jit(functools.partial(es.tick, params=params))
+
+    cut = int(n * split_frac)
+    part = np.zeros(n, np.int32)
+    part[:cut] = 1
+    quiet = es.ChurnInputs.quiet(n)
+
+    susp = refutes = faulties = 0
+    inp = es.ChurnInputs(
+        kill=jnp.zeros(n, bool),
+        revive=jnp.zeros(n, bool),
+        partition=jnp.asarray(part),
+    )
+    for i in range(split_ticks):
+        state, m = step(state, inp if i == 0 else quiet._replace(partition=None))
+        susp += int(m.suspects_published)
+        refutes += int(m.refutes_published)
+        faulties += int(m.faulties_published)
+    truth_mid = np.asarray(state.truth_status)
+
+    heal_inp = es.ChurnInputs(
+        kill=jnp.zeros(n, bool),
+        revive=jnp.zeros(n, bool),
+        partition=jnp.zeros(n, jnp.int32),
+    )
+    reconverge_ticks = -1
+    for i in range(heal_ticks):
+        state, m = step(state, heal_inp if i == 0 else quiet)
+        refutes += int(m.refutes_published)
+        if reconverge_ticks < 0 and int(m.distinct_checksums) == 1:
+            reconverge_ticks = i + 1
+    truth_end = np.asarray(state.truth_status)
+    return {
+        "suspects_during_split": susp,
+        "refutes": refutes,
+        "faulties_published": faulties,
+        "bad_truth_at_heal": int((truth_mid >= es.SUSPECT).sum()),
+        "reconverge_ticks": reconverge_ticks,
+        "residual_bad_marks": int((truth_end >= es.SUSPECT).sum()),
+    }
+
+
+@pytest.mark.slow
+def test_split_brain_envelope_full_vs_scalable():
+    full = run_full_engine_split(n=1024)
+    scal = run_scalable_split(n=100_000)
+
+    # both engines: the split manufactures false suspects
+    assert full["suspects_during_split"] > 0
+    assert scal["suspects_during_split"] > 0
+
+    # ENVELOPE EDGE, asserted: the full engine escalates cross-side
+    # suspicions to FAULTY during a >suspicion_ticks split (reference
+    # behavior)...
+    assert full["faulty_marks_at_heal"] > 0, (
+        "full engine should have escalated cross-side suspects to faulty "
+        "during a 35-tick split (suspicion window 25)"
+    )
+    # ...while the scalable engine's single truth chain lets refutes
+    # cancel suspicions before the faulty batch fires for LIVE nodes
+    assert scal["refutes"] > 0
+    assert scal["residual_bad_marks"] == 0
+
+    # after heal: both reconverge to one view with no bad marks left
+    assert full["reconverge_ticks"] > 0, full
+    assert full["residual_bad_marks"] == 0
+    assert scal["reconverge_ticks"] > 0, scal
+
+    # record the measured shape for COVERAGE.md maintenance
+    print("ENVELOPE full:", full)
+    print("ENVELOPE scalable:", scal)
