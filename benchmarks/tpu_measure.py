@@ -1,0 +1,238 @@
+#!/usr/bin/env python3
+"""One-shot TPU measurement sweep: grab the (single-client) axon tunnel
+once and capture every chip-gated number in a single session —
+
+  A. headline 1k-node tick rate, fast + farmhash-parity checksum modes
+  B. hash32_rows Pallas kernel vs lax.scan lowering at the parity
+     workload shape (SURVEY §2 native table)
+  C. 100k-node epidemic broadcast, k=3 ping-req fanout, 5% packet loss
+     (BASELINE.md north-star row 3: "runs in-jit on TPU")
+  D. 1M-node churn storm, 10% fail/rejoin (north-star row 4: < 60 s)
+
+Each phase is independently guarded; results stream as JSON lines and the
+combined dict lands in RESULTS_TPU_r03.json.  The tunnel is intermittently
+held by another client, so backend init retries with backoff first.
+"""
+
+from __future__ import annotations
+
+import functools
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+OUT_PATH = os.environ.get("TPU_MEASURE_OUT", "RESULTS_TPU_r03.json")
+RETRIES = int(os.environ.get("TPU_MEASURE_RETRIES", "90"))
+SLEEP_S = float(os.environ.get("TPU_MEASURE_SLEEP_S", "20"))
+
+
+def wait_for_tpu() -> str:
+    import jax
+
+    from ringpop_tpu.utils.util import clear_jax_backends
+
+    for attempt in range(RETRIES):
+        try:
+            plat = jax.devices()[0].platform
+            if plat == "tpu":
+                return plat
+        except Exception as e:  # backend init failure: tunnel held
+            print(
+                json.dumps({"wait": attempt, "err": str(e)[:100]}),
+                file=sys.stderr,
+            )
+        clear_jax_backends()
+        time.sleep(SLEEP_S)
+    raise RuntimeError("TPU tunnel never became available")
+
+
+def phase_headline(results: dict) -> None:
+    import jax
+    import numpy as np
+
+    from ringpop_tpu.models.sim import engine
+    from ringpop_tpu.models.sim.cluster import EventSchedule, SimCluster
+
+    n, ticks = 1024, 32
+    for mode in ("fast", "farmhash"):
+        sim = SimCluster(n=n, params=engine.SimParams(n=n, checksum_mode=mode))
+        sim.bootstrap()
+        sched = EventSchedule(ticks=ticks, n=n)
+        sim.run(sched)
+        jax.block_until_ready(sim.state)
+        t0 = time.perf_counter()
+        metrics = sim.run(sched)
+        jax.block_until_ready(sim.state)
+        dt = time.perf_counter() - t0
+        results["headline_%s" % mode] = {
+            "node_ticks_per_sec": round(n * ticks / dt, 1),
+            "ms_per_tick": round(dt / ticks * 1e3, 2),
+            "vs_realtime_baseline": round((n * ticks / dt) / (n * 5.0), 2),
+            "converged": bool(np.asarray(metrics.converged)[-1]),
+        }
+
+
+def phase_pallas_vs_scan(results: dict) -> None:
+    import jax
+    import numpy as np
+
+    from ringpop_tpu.models.sim import engine
+    from ringpop_tpu.models.sim.cluster import SimCluster
+    from ringpop_tpu.ops import checksum_encode as ce
+    from ringpop_tpu.ops import jax_farmhash as jfh
+
+    # the real parity workload: 1k converged membership rows (~40 KB each)
+    n = 1024
+    sim = SimCluster(
+        n=n, params=engine.SimParams(n=n, checksum_mode="fast")
+    )
+    sim.bootstrap()
+    for _ in range(3):
+        sim.step()
+    bufs, lens = ce.membership_rows(
+        sim.universe,
+        sim.state.known,
+        sim.state.status,
+        engine.stamp_to_ms(sim.state.inc, sim.params),
+        max_digits=sim.params.max_digits,
+    )
+    bufs = jax.block_until_ready(bufs)
+    row_bytes = int(bufs.shape[1])
+    want = None
+    for impl in ("scan", "pallas"):
+        try:
+            fn = jax.jit(functools.partial(jfh.hash32_rows, impl=impl))
+            out = jax.block_until_ready(fn(bufs, lens))
+            t0 = time.perf_counter()
+            reps = 10
+            for _ in range(reps):
+                out = fn(bufs, lens)
+            out = jax.block_until_ready(out)
+            dt = (time.perf_counter() - t0) / reps
+            if want is None:
+                want = np.asarray(out)
+            else:
+                assert (np.asarray(out) == want).all(), (
+                    "pallas/scan hash mismatch"
+                )
+            results["hash32_rows_%s" % impl] = {
+                "ms": round(dt * 1e3, 2),
+                "rows": n,
+                "row_bytes": row_bytes,
+                "mb_per_s": round(n * row_bytes / dt / 1e6, 1),
+            }
+        except Exception as e:
+            results["hash32_rows_%s" % impl] = {"error": str(e)[:300]}
+
+
+def phase_epidemic_100k(results: dict) -> None:
+    import jax
+    import numpy as np
+
+    from ringpop_tpu.models.sim import engine_scalable as es
+
+    n, ticks = 100_000, 60
+    params = es.ScalableParams(n=n, u=512, packet_loss=0.05)
+    state = es.init_state(params, seed=0)
+    step = jax.jit(functools.partial(es.tick, params=params))
+    state, m = step(state, es.ChurnInputs.quiet(n))  # compile
+    jax.block_until_ready(state)
+    t0 = time.perf_counter()
+    susp = refutes = 0
+    for _ in range(ticks):
+        state, m = step(state, es.ChurnInputs.quiet(n))
+        susp += int(m.suspects_published)
+        refutes += int(m.refutes_published)
+    jax.block_until_ready(state)
+    dt = time.perf_counter() - t0
+    results["epidemic_100k_5pct_loss"] = {
+        "node_ticks_per_sec": round(n * ticks / dt, 1),
+        "ms_per_tick": round(dt / ticks * 1e3, 2),
+        "elapsed_s": round(dt, 2),
+        "false_suspects": susp,
+        "refutes": refutes,
+        "permanent_faulty": int(
+            (np.asarray(state.truth_status) == es.FAULTY).sum()
+        ),
+    }
+
+
+def phase_storm_1m(results: dict) -> None:
+    import jax
+    import numpy as np
+
+    from ringpop_tpu.models.sim import engine_scalable as es
+    from ringpop_tpu.models.sim.storm import ScalableCluster, StormSchedule
+
+    n, ticks = 1_000_000, 60
+    for in_tick in (True, False):
+        key = "storm_1m" + ("" if in_tick else "_deferred_checksums")
+        try:
+            params = es.ScalableParams(n=n, u=512, checksum_in_tick=in_tick)
+            sched = StormSchedule.churn_storm(
+                ticks, n, fraction=0.10, fail_tick=2, seed=0
+            )
+            cluster = ScalableCluster(n=n, params=params, seed=0)
+            t0 = time.perf_counter()
+            cluster.run(sched)
+            jax.block_until_ready(cluster.state)
+            cold = time.perf_counter() - t0
+
+            cluster2 = ScalableCluster(n=n, params=params, seed=0)
+            t0 = time.perf_counter()
+            metrics = cluster2.run(sched)
+            cs = es.compute_checksums(cluster2.state, params)
+            cs = jax.block_until_ready(cs)
+            warm = time.perf_counter() - t0
+            live = np.asarray(cluster2.state.proc_alive)
+            ncs = np.unique(np.asarray(cs)[live]).size
+            results[key] = {
+                "n": n,
+                "ticks": ticks,
+                "cold_s": round(cold, 2),
+                "warm_s": round(warm, 2),
+                "under_60s": bool(warm < 60.0),
+                "converged": bool(ncs == 1),
+                "distinct_checksums": int(ncs),
+                "full_coverage_final": bool(
+                    np.asarray(metrics.full_coverage)[-1]
+                ),
+            }
+        except Exception as e:
+            results[key] = {"error": str(e)[:300]}
+        print(json.dumps({key: results.get(key)}), flush=True)
+
+
+def main() -> int:
+    import ringpop_tpu  # noqa: F401  (x64 config before backend init)
+
+    plat = wait_for_tpu()
+    import jax
+
+    results: dict = {
+        "platform": plat,
+        "device": str(jax.devices()[0]),
+    }
+    for name, fn in (
+        ("headline", phase_headline),
+        ("pallas_vs_scan", phase_pallas_vs_scan),
+        ("epidemic_100k", phase_epidemic_100k),
+        ("storm_1m", phase_storm_1m),
+    ):
+        try:
+            fn(results)
+        except Exception as e:
+            results["%s_error" % name] = str(e)[:400]
+        print(json.dumps({name: "done"}), flush=True)
+
+    with open(OUT_PATH, "w") as f:
+        json.dump(results, f, indent=1)
+    print(json.dumps(results))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -356,13 +356,12 @@ def _checksums_where(
     The farmhash-parity string build + hash is by far the hottest op in the
     tick; a row's checksum only changes when its VIEW changed, so unchanged
     rows reuse the cache and a fully-quiet tick skips the whole encode+hash
-    graph at runtime (``lax.cond``).  Fast mode is cheap enough to always
-    recompute.  Correctness is pinned by the lockstep parity suite, which
-    asserts bit-equality against the host oracle on every tick of every
-    scenario.
+    graph at runtime (``lax.cond``).  Fast mode uses the same dirty gating
+    (recomputing an unchanged row reproduces the cached sum bit-for-bit,
+    so skipping is trajectory-neutral).  Correctness is pinned by the
+    lockstep parity suite, which asserts bit-equality against the host
+    oracle on every tick of every scenario.
     """
-    if params.checksum_mode == "fast":
-        return compute_checksums(state, universe, params)
 
     def recompute(_):
         fresh = compute_checksums(state, universe, params)
@@ -391,8 +390,8 @@ def _apply_updates(
     Returns (state', applied [N,N] bool, applied_status, applied_inc).
     """
     n = state.known.shape[0]
-    node = jnp.arange(n)[:, None]
-    subject = jnp.arange(n)[None, :]
+    node = jnp.arange(n, dtype=jnp.int32)[:, None]
+    subject = jnp.arange(n, dtype=jnp.int32)[None, :]
     is_self = node == subject
 
     # local override (refute): self claimed suspect/faulty -> alive, fresh inc
@@ -450,8 +449,8 @@ def tick(
     n = params.n
     # this tick's incarnation stamp: epoch_ms + tick_next*period_ms
     now = state.tick_index + 2
-    node = jnp.arange(n)[:, None]
-    subject = jnp.arange(n)[None, :]
+    node = jnp.arange(n, dtype=jnp.int32)[:, None]
+    subject = jnp.arange(n, dtype=jnp.int32)[None, :]
     is_self = node == subject
     tick_next = state.tick_index + 1
 
@@ -461,37 +460,37 @@ def tick(
         # SIGCONT: process returns with its pre-stop state intact
         proc_alive = proc_alive | inputs.resume
     partition = jnp.where(inputs.partition >= 0, inputs.partition, state.partition)
-    # revive resets a node to fresh state (process restart)
+    # revive resets a node to fresh state (process restart); rare, so the
+    # [N, N] view resets are cond-gated off the common tick
     rv = inputs.revive & ~state.proc_alive
-    fresh_known = is_self
-    known = jnp.where(rv[:, None], fresh_known, state.known)
-    status = jnp.where(rv[:, None], ALIVE, state.status)
-    inc = jnp.where(rv[:, None] & is_self, now, jnp.where(rv[:, None], 0, state.inc))
-    ready = jnp.where(rv, False, state.ready)
-    ch_active = jnp.where(rv[:, None], False, state.ch_active)
-    susp_deadline = jnp.where(rv[:, None], -1, state.susp_deadline)
-    # a restarted process gossips again even if it had left before dying
-    gossip_on = state.gossip_on | rv
-
     state = state._replace(
         proc_alive=proc_alive,
         partition=partition,
-        known=known,
-        status=status,
-        inc=inc,
-        ready=ready,
-        ch_active=ch_active,
-        susp_deadline=susp_deadline,
-        gossip_on=gossip_on,
+        ready=jnp.where(rv, False, state.ready),
+        # a restarted process gossips again even if it had left pre-crash
+        gossip_on=state.gossip_on | rv,
         tick_index=tick_next,
     )
+
+    def _revive_reset(state):
+        return state._replace(
+            known=jnp.where(rv[:, None], is_self, state.known),
+            status=jnp.where(rv[:, None], ALIVE, state.status),
+            inc=jnp.where(
+                rv[:, None] & is_self, now, jnp.where(rv[:, None], 0, state.inc)
+            ),
+            ch_active=jnp.where(rv[:, None], False, state.ch_active),
+            susp_deadline=jnp.where(rv[:, None], -1, state.susp_deadline),
+        )
+
+    state = jax.lax.cond(jnp.any(rv), _revive_reset, lambda s: s, state)
 
     # ---- phase 0.5: graceful leave ------------------------------------
     # the node marks itself leave at its CURRENT incarnation (makeLeave,
     # membership/index.js:192), records the change, and stops gossiping;
     # the change disseminates via its ping responses
     if inputs.leave is not None:
-        diag = jnp.arange(n)
+        diag = jnp.arange(n, dtype=jnp.int32)
         self_status = state.status[diag, diag]
         lv = (
             inputs.leave
@@ -515,113 +514,134 @@ def tick(
         )
 
     # rejoin of a left node: alive with a fresh incarnation, gossip back on
-    # (server/admin/member.js:44-51) — no cluster-join round needed
-    diag = jnp.arange(n)
+    # (server/admin/member.js:44-51) — no cluster-join round needed; the
+    # [N, N] writes are cond-gated (rejoins are operator events)
+    diag = jnp.arange(n, dtype=jnp.int32)
     rejoin = (
         inputs.join
         & state.proc_alive
         & state.ready
         & (state.status[diag, diag] == LEAVE)
     )
-    rj_mask = rejoin[:, None] & is_self
-    state = state._replace(
-        status=jnp.where(rj_mask, ALIVE, state.status),
-        inc=jnp.where(rj_mask, now, state.inc),
-        gossip_on=state.gossip_on | rejoin,
-        ch_active=state.ch_active | rj_mask,
-        ch_status=jnp.where(rj_mask, ALIVE, state.ch_status),
-        ch_inc=jnp.where(rj_mask, now, state.ch_inc),
-        ch_source=jnp.where(rj_mask, node, state.ch_source),
-        ch_source_inc=jnp.where(rj_mask, now, state.ch_source_inc),
-        ch_pb=jnp.where(rj_mask, 0, state.ch_pb),
-    )
+
+    def _rejoin_write(state):
+        rj_mask = rejoin[:, None] & is_self
+        return state._replace(
+            status=jnp.where(rj_mask, ALIVE, state.status),
+            inc=jnp.where(rj_mask, now, state.inc),
+            gossip_on=state.gossip_on | rejoin,
+            ch_active=state.ch_active | rj_mask,
+            ch_status=jnp.where(rj_mask, ALIVE, state.ch_status),
+            ch_inc=jnp.where(rj_mask, now, state.ch_inc),
+            ch_source=jnp.where(rj_mask, node, state.ch_source),
+            ch_source_inc=jnp.where(rj_mask, now, state.ch_source_inc),
+            ch_pb=jnp.where(rj_mask, 0, state.ch_pb),
+        )
+
+    state = jax.lax.cond(jnp.any(rejoin), _rejoin_write, lambda s: s, state)
 
     # ---- phase 1: join/bootstrap --------------------------------------
     # Joiners (join input, or revived nodes) contact join_size ready nodes,
     # merge their full views (join-sender.js + join-response-merge), and the
     # contacted nodes makeAlive(joiner) (server/protocol/join.js:126).
+    # Joins are rare (bootstrap / revive / rejoin ticks), so the whole
+    # block — a [N, N] top-k, a 3-step merge scan, and a scatter loop —
+    # runs under lax.cond and costs nothing on the steady-state tick.
+    # (The jrand draw is a pure function of state.rng + salt; skipping it
+    # changes no other randomness.)
     joiner = (inputs.join | rv) & state.proc_alive & ~state.ready
-    # any live process answers /protocol/join — including nodes that are
-    # themselves mid-bootstrap (the reference's simultaneous tick-cluster
-    # bootstrap relies on this; handleJoin never checks readiness)
-    join_candidates = state.proc_alive
-    can_join_mask = (
-        joiner[:, None]
-        & join_candidates[None, :]
-        & ~is_self
-        & _connected(partition, node, subject)
-    )
-    jrand = _uniform(state.rng, (n, n), salt=101)
-    jscore = jnp.where(can_join_mask, jrand, 2.0)
-    # take up to join_size targets per joiner (top-k, not a full sort)
-    neg_jtop, jorder = jax.lax.top_k(-jscore, params.join_size)
-    jvalid = -neg_jtop < 1.5  # real candidates
 
-    # merge targets' views into joiner via key-max over targets
-    def merge_joins(carry, k):
-        known_j, status_j, inc_j = carry
-        tgt = jorder[:, k]
-        ok = jvalid[:, k] & joiner
-        t_known = state.known[tgt]
-        t_status = state.status[tgt]
-        t_inc = state.inc[tgt]
-        take = ok[:, None] & t_known
-        better = take & (
-            ~known_j | (_pack_key(t_inc, t_status) > _pack_key(inc_j, status_j))
+    def _join_phase(state):
+        # any live process answers /protocol/join — including nodes that
+        # are themselves mid-bootstrap (the reference's simultaneous
+        # tick-cluster bootstrap relies on this; handleJoin never checks
+        # readiness)
+        join_candidates = state.proc_alive
+        can_join_mask = (
+            joiner[:, None]
+            & join_candidates[None, :]
+            & ~is_self
+            & _connected(partition, node, subject)
         )
-        return (
-            (known_j | take, jnp.where(better, t_status, status_j), jnp.where(better, t_inc, inc_j)),
-            None,
+        jrand = _uniform(state.rng, (n, n), salt=101)
+        jscore = jnp.where(can_join_mask, jrand, 2.0)
+        # take up to join_size targets per joiner (top-k, not a full sort)
+        neg_jtop, jorder = jax.lax.top_k(-jscore, params.join_size)
+        jvalid = -neg_jtop < 1.5  # real candidates
+
+        # merge targets' views into joiner via key-max over targets
+        def merge_joins(carry, k):
+            known_j, status_j, inc_j = carry
+            tgt = jorder[:, k]
+            ok = jvalid[:, k] & joiner
+            t_known = state.known[tgt]
+            t_status = state.status[tgt]
+            t_inc = state.inc[tgt]
+            take = ok[:, None] & t_known
+            better = take & (
+                ~known_j | (_pack_key(t_inc, t_status) > _pack_key(inc_j, status_j))
+            )
+            return (
+                (known_j | take, jnp.where(better, t_status, status_j), jnp.where(better, t_inc, inc_j)),
+                None,
+            )
+
+        (jk, js, ji), _ = jax.lax.scan(
+            merge_joins,
+            (state.known, state.status, state.inc),
+            jnp.arange(params.join_size),
+        )
+        joined = joiner & jnp.any(jvalid, axis=1)
+        # don't let merged views downgrade the joiner's own liveness
+        keep_self = is_self & joined[:, None]
+        merged_known = jnp.where(joined[:, None], jk, state.known)
+        merged_status = jnp.where(keep_self, ALIVE, jnp.where(joined[:, None], js, state.status))
+        merged_inc = jnp.where(keep_self, state.inc, jnp.where(joined[:, None], ji, state.inc))
+        # joiner records every learned member as a change (set handler,
+        # on_membership_event.js:58)
+        learned = joined[:, None] & merged_known & ~is_self
+        state = state._replace(
+            known=merged_known,
+            status=merged_status,
+            inc=merged_inc,
+            ready=state.ready | joined,
+            ch_active=state.ch_active | learned,
+            ch_status=jnp.where(learned, merged_status, state.ch_status),
+            ch_inc=jnp.where(learned, merged_inc, state.ch_inc),
+            ch_source=jnp.where(learned, node, state.ch_source),
+            ch_source_inc=jnp.where(
+                learned, merged_inc[jnp.arange(n), jnp.arange(n)][:, None], state.ch_source_inc
+            ),
+            ch_pb=jnp.where(learned, 0, state.ch_pb),
         )
 
-    (jk, js, ji), _ = jax.lax.scan(
-        merge_joins,
-        (state.known, state.status, state.inc),
-        jnp.arange(params.join_size),
-    )
-    joined = joiner & jnp.any(jvalid, axis=1)
-    # don't let merged views downgrade the joiner's own liveness
-    keep_self = is_self & joined[:, None]
-    merged_known = jnp.where(joined[:, None], jk, state.known)
-    merged_status = jnp.where(keep_self, ALIVE, jnp.where(joined[:, None], js, state.status))
-    merged_inc = jnp.where(keep_self, state.inc, jnp.where(joined[:, None], ji, state.inc))
-    # joiner records every learned member as a change (set handler,
-    # on_membership_event.js:58)
-    learned = joined[:, None] & merged_known & ~is_self
-    state = state._replace(
-        known=merged_known,
-        status=merged_status,
-        inc=merged_inc,
-        ready=state.ready | joined,
-        ch_active=state.ch_active | learned,
-        ch_status=jnp.where(learned, merged_status, state.ch_status),
-        ch_inc=jnp.where(learned, merged_inc, state.ch_inc),
-        ch_source=jnp.where(learned, node, state.ch_source),
-        ch_source_inc=jnp.where(
-            learned, merged_inc[jnp.arange(n), jnp.arange(n)][:, None], state.ch_source_inc
-        ),
-        ch_pb=jnp.where(learned, 0, state.ch_pb),
-    )
+        # contacted nodes makeAlive(joiner): scatter alive into targets
+        ja_mask = jnp.zeros((n, n), bool)
 
-    # contacted nodes makeAlive(joiner): scatter alive(joiner) into targets
-    ja_mask = jnp.zeros((n, n), bool)
+        def scatter_join_alive(k, m):
+            tgt = jorder[:, k]
+            ok = jvalid[:, k] & joined
+            upd = jnp.zeros((n, n), bool).at[tgt, jnp.arange(n)].set(ok, mode="drop")
+            return m | upd
 
-    def scatter_join_alive(k, m):
-        tgt = jorder[:, k]
-        ok = jvalid[:, k] & joined
-        upd = jnp.zeros((n, n), bool).at[tgt, jnp.arange(n)].set(ok, mode="drop")
-        return m | upd
+        ja_mask = jax.lax.fori_loop(0, params.join_size, scatter_join_alive, ja_mask)
+        self_inc = state.inc[jnp.arange(n), jnp.arange(n)]
+        state, ja_applied, _, _ = _apply_updates(
+            state,
+            now,
+            ja_mask,
+            jnp.full((n, n), ALIVE, jnp.int32),
+            jnp.broadcast_to(self_inc[None, :], (n, n)),
+            jnp.broadcast_to(subject, (n, n)).astype(jnp.int32),  # source = joiner
+            jnp.broadcast_to(self_inc[None, :], (n, n)),
+        )
+        return state, joined, ja_applied
 
-    ja_mask = jax.lax.fori_loop(0, params.join_size, scatter_join_alive, ja_mask)
-    self_inc = state.inc[jnp.arange(n), jnp.arange(n)]
-    state, ja_applied, _, _ = _apply_updates(
+    state, joined, ja_applied = jax.lax.cond(
+        jnp.any(joiner),
+        _join_phase,
+        lambda s: (s, jnp.zeros(n, bool), jnp.zeros((n, n), bool)),
         state,
-        now,
-        ja_mask,
-        jnp.full((n, n), ALIVE, jnp.int32),
-        jnp.broadcast_to(self_inc[None, :], (n, n)),
-        jnp.broadcast_to(subject, (n, n)).astype(jnp.int32),  # source = joiner
-        jnp.broadcast_to(self_inc[None, :], (n, n)),
     )
 
     # rows whose VIEW changed so far this tick (revive reset, leave/rejoin
@@ -731,34 +751,48 @@ def tick(
     delivered = valid_send & tgt_ok & conn & ~loss
 
     # ---- phase 5: receivers apply ping changes ------------------------
+    # the segment-max winner-combine + apply runs only when some delivered
+    # ping actually CARRIES changes; on a converged quiet tick every
+    # change table is empty and the whole block cond-skips
     seg = jnp.where(delivered, target, n)  # undelivered -> dropped segment
-    keys = jnp.where(
-        sendable & delivered[:, None],
-        _pack_key(state.ch_inc, state.ch_status),
-        jnp.int32(-1),
-    )
-    recv_key = jax.ops.segment_max(
-        keys, seg, num_segments=n + 1, indices_are_sorted=False
-    )[:n]
-    recv_mask = recv_key >= 0
-    # winning sender (lowest index among ties) to recover source fields
-    is_winner = (keys == recv_key[jnp.clip(target, 0, n - 1)]) & sendable & delivered[:, None]
-    sender_ids = jnp.broadcast_to(node, (n, n))
-    winner_sender = jax.ops.segment_min(
-        jnp.where(is_winner, sender_ids, n), seg, num_segments=n + 1
-    )[:n]
-    ws = jnp.clip(winner_sender, 0, n - 1)
-    u_status = (recv_key % 4).astype(jnp.int32)
-    u_inc = recv_key // 4
-    u_source = state.ch_source[ws, subject]
-    u_source_inc = state.ch_source_inc[ws, subject]
-    state, applied_ping, started, _ = _apply_updates(
-        state, now, recv_mask, u_status, u_inc, u_source, u_source_inc
-    )
-    state = state._replace(
-        susp_deadline=jnp.where(
-            started, tick_next + params.suspicion_ticks, state.susp_deadline
+    msg_content = sendable & delivered[:, None]
+
+    def _receive_phase(state):
+        keys = jnp.where(
+            msg_content,
+            _pack_key(state.ch_inc, state.ch_status),
+            jnp.int32(-1),
         )
+        recv_key = jax.ops.segment_max(
+            keys, seg, num_segments=n + 1, indices_are_sorted=False
+        )[:n]
+        recv_mask = recv_key >= 0
+        # winning sender (lowest index among ties) recovers source fields
+        is_winner = (keys == recv_key[jnp.clip(target, 0, n - 1)]) & msg_content
+        sender_ids = jnp.broadcast_to(node, (n, n))
+        winner_sender = jax.ops.segment_min(
+            jnp.where(is_winner, sender_ids, n), seg, num_segments=n + 1
+        )[:n]
+        ws = jnp.clip(winner_sender, 0, n - 1)
+        u_status = (recv_key % 4).astype(jnp.int32)
+        u_inc = recv_key // 4
+        u_source = state.ch_source[ws, subject]
+        u_source_inc = state.ch_source_inc[ws, subject]
+        state, applied_ping, started, _ = _apply_updates(
+            state, now, recv_mask, u_status, u_inc, u_source, u_source_inc
+        )
+        state = state._replace(
+            susp_deadline=jnp.where(
+                started, tick_next + params.suspicion_ticks, state.susp_deadline
+            )
+        )
+        return state, applied_ping
+
+    state, applied_ping = jax.lax.cond(
+        jnp.any(msg_content),
+        _receive_phase,
+        lambda s: (s, jnp.zeros((n, n), bool)),
+        state,
     )
     dirty = dirty | jnp.any(applied_ping, axis=1)
 
@@ -767,23 +801,38 @@ def tick(
     # 147-160), so a change does not burn budget on pings from the sender
     # that originated it.  A change has exactly one recorded origin, hence
     # at most one of this tick's pinging senders can be filtered for it.
+    # Cond-gated: with no active changes anywhere there is nothing to bump.
     nrecv = jax.ops.segment_sum(
         delivered.astype(jnp.int32), seg, num_segments=n + 1
     )[:n]
-    src_c = jnp.clip(state.ch_source, 0, n - 1)
-    origin_hit = (
-        state.ch_active
-        & (state.ch_source >= 0)
-        & delivered[src_c]
-        & (target[src_c] == node)
-        & (state.ch_source_inc == sent_self_inc[src_c])
+
+    def _receiver_bump(state):
+        src_c = jnp.clip(state.ch_source, 0, n - 1)
+        origin_hit = (
+            state.ch_active
+            & (state.ch_source >= 0)
+            & delivered[src_c]
+            & (target[src_c] == node)
+            & (state.ch_source_inc == sent_self_inc[src_c])
+        )
+        bump_r = (nrecv[:, None] > 0) & state.ch_active
+        nbump = jnp.where(
+            bump_r, nrecv[:, None] - origin_hit.astype(jnp.int32), 0
+        )
+        ch_pb = state.ch_pb + nbump
+        over_r = state.ch_active & (ch_pb > max_pb[:, None])
+        respondable = bump_r & ~over_r
+        state = state._replace(
+            ch_pb=ch_pb, ch_active=state.ch_active & ~over_r
+        )
+        return state, respondable
+
+    state, respondable = jax.lax.cond(
+        jnp.any(state.ch_active),
+        _receiver_bump,
+        lambda s: (s, jnp.zeros((n, n), bool)),
+        state,
     )
-    bump_r = (nrecv[:, None] > 0) & state.ch_active
-    nbump = jnp.where(bump_r, nrecv[:, None] - origin_hit.astype(jnp.int32), 0)
-    ch_pb = state.ch_pb + nbump
-    over_r = state.ch_active & (ch_pb > max_pb[:, None])
-    respondable = bump_r & ~over_r
-    state = state._replace(ch_pb=ch_pb, ch_active=state.ch_active & ~over_r)
 
     # mid-tick checksums (receivers respond with post-update checksums);
     # only rows whose view changed since last tick's cache are rehashed
@@ -793,99 +842,154 @@ def tick(
 
     # ---- phase 6: responses (issueAsReceiver + full-sync) -------------
     tgt = jnp.clip(target, 0, n - 1)
-    # filter: drop changes the sender itself originated (dissemination.js:
-    # 91-98) — matched against the ping-body incarnation (sent_self_inc)
     cur_self_inc = state.inc[jnp.arange(n), jnp.arange(n)]
-    resp_filter = (
-        (state.ch_source[tgt] == node)
-        & (state.ch_source_inc[tgt] == sent_self_inc[:, None])
+    # a response can only exist where the target holds respondable changes
+    # or its checksum disagrees with the ping body's — cond-gate the row
+    # gathers + apply off the converged quiet tick
+    resp_possible = delivered & (
+        jnp.any(respondable, axis=1)[tgt]
+        | (mid_checksum[tgt] != advertised_checksum)
     )
-    resp_mask = delivered[:, None] & respondable[tgt] & ~resp_filter
-    any_resp_change = jnp.any(resp_mask, axis=1)
-    # full-sync: no changes to send back AND checksums differ
-    # (sender's checksum rides in the ping body, ping-sender.js:70-76)
-    full_sync = delivered & ~any_resp_change & (
-        mid_checksum[tgt] != advertised_checksum
-    )
-    fs_mask = full_sync[:, None] & state.known[tgt]
-    r_status = jnp.where(fs_mask, state.status[tgt], state.ch_status[tgt])
-    r_inc = jnp.where(fs_mask, state.inc[tgt], state.ch_inc[tgt])
-    r_source = jnp.where(
-        fs_mask, jnp.broadcast_to(target[:, None], (n, n)), state.ch_source[tgt]
-    )
-    r_source_inc = jnp.where(
-        fs_mask, state.inc[tgt, tgt][:, None], state.ch_source_inc[tgt]
-    )
-    apply_resp = resp_mask | fs_mask
-    state, applied_resp, started_r, _ = _apply_updates(
-        state, now, apply_resp, r_status, r_inc, r_source, r_source_inc
-    )
-    state = state._replace(
-        susp_deadline=jnp.where(
-            started_r, tick_next + params.suspicion_ticks, state.susp_deadline
+
+    def _response_phase(state):
+        # filter: drop changes the sender itself originated
+        # (dissemination.js:91-98) — matched against the ping-body
+        # incarnation (sent_self_inc)
+        resp_filter = (
+            (state.ch_source[tgt] == node)
+            & (state.ch_source_inc[tgt] == sent_self_inc[:, None])
         )
+        resp_mask = delivered[:, None] & respondable[tgt] & ~resp_filter
+        any_resp_change = jnp.any(resp_mask, axis=1)
+        # full-sync: no changes to send back AND checksums differ
+        # (sender's checksum rides in the ping body, ping-sender.js:70-76)
+        full_sync = delivered & ~any_resp_change & (
+            mid_checksum[tgt] != advertised_checksum
+        )
+        fs_mask = full_sync[:, None] & state.known[tgt]
+        r_status = jnp.where(fs_mask, state.status[tgt], state.ch_status[tgt])
+        r_inc = jnp.where(fs_mask, state.inc[tgt], state.ch_inc[tgt])
+        r_source = jnp.where(
+            fs_mask, jnp.broadcast_to(target[:, None], (n, n)), state.ch_source[tgt]
+        )
+        r_source_inc = jnp.where(
+            fs_mask, state.inc[tgt, tgt][:, None], state.ch_source_inc[tgt]
+        )
+        apply_resp = resp_mask | fs_mask
+        state, applied_resp, started_r, _ = _apply_updates(
+            state, now, apply_resp, r_status, r_inc, r_source, r_source_inc
+        )
+        state = state._replace(
+            susp_deadline=jnp.where(
+                started_r, tick_next + params.suspicion_ticks, state.susp_deadline
+            )
+        )
+        return state, applied_resp, full_sync
+
+    state, applied_resp, full_sync = jax.lax.cond(
+        jnp.any(resp_possible),
+        _response_phase,
+        lambda s: (s, jnp.zeros((n, n), bool), jnp.zeros(n, bool)),
+        state,
     )
 
     # ---- phase 7: ping-req (indirect probe) ---------------------------
+    # only nodes whose DIRECT ping failed probe indirectly; on a healthy
+    # steady-state tick nobody does, so the [N, N] top-k and the whole
+    # suspect-apply run under lax.cond (draws are salt-pure, skip-safe)
     need_pr = valid_send & ~delivered
-    pr_rand = _uniform(state.rng, (n, n), salt=29)
-    pr_ok = (
-        pingable
-        & (subject != target[:, None])
-        & need_pr[:, None]
-    )
-    pr_score = jnp.where(pr_ok, pr_rand, 2.0)
-    neg_prtop, pr_sel = jax.lax.top_k(-pr_score, params.ping_req_size)
-    pr_valid = -neg_prtop < 1.5
 
-    m_alive = state.proc_alive[pr_sel]
-    m_conn = partition[pr_sel] == partition[:, None]
-    loss1 = _uniform(state.rng, (n, params.ping_req_size), salt=31) < params.packet_loss
-    responder = pr_valid & m_alive & m_conn & ~loss1  # intermediary reachable
-    t_alive = jnp.where(need_pr, state.proc_alive[tgt], False)
-    t_conn = partition[pr_sel] == partition[tgt][:, None]
-    loss2 = _uniform(state.rng, (n, params.ping_req_size), salt=37) < params.packet_loss
-    reached = responder & t_alive[:, None] & t_conn & ~loss2
-
-    any_responded = jnp.any(responder, axis=1)
-    target_reached = jnp.any(reached, axis=1)
-    mark_suspect = need_pr & any_responded & ~target_reached
-    ping_req_count = jnp.sum(
-        jnp.where(need_pr[:, None], pr_valid, False).astype(jnp.int32)
-    )
-
-    sus_mask = jnp.zeros((n, n), bool).at[jnp.arange(n), tgt].set(mark_suspect)
-    sus_inc = state.inc[jnp.arange(n), tgt]  # member's current incarnation
-    state, applied_sus, started_s, _ = _apply_updates(
-        state,
-        now,
-        sus_mask,
-        jnp.full((n, n), SUSPECT, jnp.int32),
-        jnp.broadcast_to(sus_inc[:, None], (n, n)),
-        jnp.broadcast_to(node, (n, n)).astype(jnp.int32),
-        jnp.broadcast_to(cur_self_inc[:, None], (n, n)),
-    )
-    state = state._replace(
-        susp_deadline=jnp.where(
-            started_s, tick_next + params.suspicion_ticks, state.susp_deadline
+    def _ping_req_phase(state):
+        pr_rand = _uniform(state.rng, (n, n), salt=29)
+        pr_ok = (
+            pingable
+            & (subject != target[:, None])
+            & need_pr[:, None]
         )
+        pr_score = jnp.where(pr_ok, pr_rand, 2.0)
+        neg_prtop, pr_sel = jax.lax.top_k(-pr_score, params.ping_req_size)
+        pr_valid = -neg_prtop < 1.5
+
+        m_alive = state.proc_alive[pr_sel]
+        m_conn = partition[pr_sel] == partition[:, None]
+        loss1 = _uniform(state.rng, (n, params.ping_req_size), salt=31) < params.packet_loss
+        responder = pr_valid & m_alive & m_conn & ~loss1  # intermediary ok
+        t_alive = jnp.where(need_pr, state.proc_alive[tgt], False)
+        t_conn = partition[pr_sel] == partition[tgt][:, None]
+        loss2 = _uniform(state.rng, (n, params.ping_req_size), salt=37) < params.packet_loss
+        reached = responder & t_alive[:, None] & t_conn & ~loss2
+
+        any_responded = jnp.any(responder, axis=1)
+        target_reached = jnp.any(reached, axis=1)
+        mark_suspect = need_pr & any_responded & ~target_reached
+        ping_req_count = jnp.sum(
+            jnp.where(need_pr[:, None], pr_valid, False),
+            dtype=jnp.int32,
+        )
+
+        sus_mask = jnp.zeros((n, n), bool).at[jnp.arange(n), tgt].set(mark_suspect)
+        sus_inc = state.inc[jnp.arange(n), tgt]  # member's current inc
+        state, applied_sus, started_s, _ = _apply_updates(
+            state,
+            now,
+            sus_mask,
+            jnp.full((n, n), SUSPECT, jnp.int32),
+            jnp.broadcast_to(sus_inc[:, None], (n, n)),
+            jnp.broadcast_to(node, (n, n)).astype(jnp.int32),
+            jnp.broadcast_to(cur_self_inc[:, None], (n, n)),
+        )
+        state = state._replace(
+            susp_deadline=jnp.where(
+                started_s, tick_next + params.suspicion_ticks, state.susp_deadline
+            )
+        )
+        return state, applied_sus, ping_req_count
+
+    state, applied_sus, ping_req_count = jax.lax.cond(
+        jnp.any(need_pr),
+        _ping_req_phase,
+        lambda s: (s, jnp.zeros((n, n), bool), jnp.int32(0)),
+        state,
     )
 
     # ---- phase 8: suspicion expiry ------------------------------------
-    expired = (
+    # active suspicion deadlines exist only while suspects are in flight;
+    # the expiry scan + faulty-apply is cond-gated off the common tick.
+    # The gate mirrors the inner mask's participating filter exactly — a
+    # due deadline held by a dead/stopped/left observer must not latch the
+    # gate true forever (the deadline itself is kept: a SIGCONT-resumed
+    # observer's suspicions expire then, like the reference's timers)
+    any_deadline = jnp.any(
         (state.susp_deadline >= 0)
         & (state.susp_deadline <= tick_next)
         & participating[:, None]
     )
-    state = state._replace(susp_deadline=jnp.where(expired, -1, state.susp_deadline))
-    state, applied_faulty, _, _ = _apply_updates(
+
+    def _expiry_phase(state):
+        expired = (
+            (state.susp_deadline >= 0)
+            & (state.susp_deadline <= tick_next)
+            & participating[:, None]
+        )
+        state = state._replace(
+            susp_deadline=jnp.where(expired, -1, state.susp_deadline)
+        )
+        state, applied_faulty, _, _ = _apply_updates(
+            state,
+            now,
+            expired,
+            jnp.full((n, n), FAULTY, jnp.int32),
+            state.inc,  # member's current incarnation (suspicion.js:67-70)
+            jnp.broadcast_to(node, (n, n)).astype(jnp.int32),
+            jnp.broadcast_to(cur_self_inc[:, None], (n, n)),
+        )
+        return state, applied_faulty
+
+    state, applied_faulty = jax.lax.cond(
+        any_deadline,
+        _expiry_phase,
+        lambda s: (s, jnp.zeros((n, n), bool)),
         state,
-        now,
-        expired,
-        jnp.full((n, n), FAULTY, jnp.int32),
-        state.inc,  # member's current incarnation (suspicion.js:67-70)
-        jnp.broadcast_to(node, (n, n)).astype(jnp.int32),
-        jnp.broadcast_to(cur_self_inc[:, None], (n, n)),
     )
 
     # ---- phase 9: checksums + metrics ---------------------------------
