@@ -98,9 +98,38 @@ def _measure(n: int, ticks: int) -> dict:
     }
 
 
+def _reexec_if_cpu_fallback() -> bool:
+    """Detect the SILENT tunnel-held mode and retry in a fresh process.
+
+    Two distinct failure modes exist when another client holds the axon
+    tunnel: backend init RAISES (handled by the retry loop in main), or
+    discovery silently falls back to CPU.  The silent mode is only
+    recoverable from a new interpreter (utils.util.reexec_retry).
+    Returns True when this process should proceed with a CPU measurement
+    (budget exhausted -> marked fallback).
+    """
+    import jax
+
+    try:
+        if jax.devices()[0].platform == "tpu":
+            return False
+    except Exception:
+        return False  # raising mode: main()'s retry loop owns it
+    from ringpop_tpu.utils.util import reexec_retry
+
+    reexec_retry("BENCH_REEXEC_ATTEMPT", RETRIES, RETRY_SLEEP_S, __file__)
+    return True  # budget exhausted: measure CPU, marked via "fallback"
+
+
 def main() -> int:
     n = int(os.environ.get("BENCH_N", "1024"))
     ticks = int(os.environ.get("BENCH_TICKS", "32"))
+
+    cpu_fallback = False
+    if not os.environ.get("BENCH_ALLOW_CPU") and "cpu" not in os.environ.get(
+        "JAX_PLATFORMS", ""
+    ):  # explicit CPU pin = intentional, not a tunnel fallback
+        cpu_fallback = _reexec_if_cpu_fallback()
 
     last_err = None
     attempts_made = 0
@@ -122,8 +151,12 @@ def main() -> int:
                 except Exception:
                     pass
             result = _measure(n, ticks)
-            result["attempts"] = attempts_made
-            if pinned_cpu:
+            result["attempts"] = attempts_made + int(
+                os.environ.get("BENCH_REEXEC_ATTEMPT", "0")
+            )
+            if pinned_cpu or (
+                cpu_fallback and result.get("platform") != "tpu"
+            ):
                 # explicit marker: this number is the CPU floor recorded
                 # because the TPU tunnel outlasted every retry — artifact
                 # consumers must not mistake it for the TPU headline
@@ -149,7 +182,8 @@ def main() -> int:
                 "vs_baseline": 0.0,
                 "error": "%s: %s"
                 % (type(last_err).__name__, str(last_err)[:400]),
-                "attempts": attempts_made,
+                "attempts": attempts_made
+                + int(os.environ.get("BENCH_REEXEC_ATTEMPT", "0")),
             }
         )
     )

@@ -30,23 +30,37 @@ SLEEP_S = float(os.environ.get("TPU_MEASURE_SLEEP_S", "20"))
 
 
 def wait_for_tpu() -> str:
+    """Grab the axon tunnel, retrying in a FRESH process each time.
+
+    When the tunnel is held by another client, backend discovery silently
+    falls back to CPU and JAX memoizes the plugin failure — an in-process
+    clear_backends + retry re-reads the cached failure in 0 ms and never
+    recovers.  The only reliable retry is a new interpreter, so this
+    re-execs itself (attempt counter in the environment) until the tunnel
+    opens or the budget runs out."""
     import jax
 
-    from ringpop_tpu.utils.util import clear_jax_backends
+    from ringpop_tpu.utils.util import reexec_retry
 
-    for attempt in range(RETRIES):
-        try:
-            plat = jax.devices()[0].platform
-            if plat == "tpu":
-                return plat
-        except Exception as e:  # backend init failure: tunnel held
-            print(
-                json.dumps({"wait": attempt, "err": str(e)[:100]}),
-                file=sys.stderr,
-            )
-        clear_jax_backends()
-        time.sleep(SLEEP_S)
-    raise RuntimeError("TPU tunnel never became available")
+    try:
+        plat = jax.devices()[0].platform
+    except Exception as e:  # init raised (the other transient mode)
+        print(json.dumps({"init_err": str(e)[:120]}), file=sys.stderr)
+        plat = "cpu"
+    if plat == "tpu":
+        return plat
+    print(
+        json.dumps(
+            {
+                "wait": os.environ.get("TPU_MEASURE_ATTEMPT", "0"),
+                "platform": plat,
+            }
+        ),
+        file=sys.stderr,
+        flush=True,
+    )
+    if reexec_retry("TPU_MEASURE_ATTEMPT", RETRIES, SLEEP_S, __file__) is False:
+        raise RuntimeError("TPU tunnel never became available")
 
 
 def phase_headline(results: dict) -> None:

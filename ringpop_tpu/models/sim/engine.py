@@ -730,17 +730,34 @@ def tick(
     valid_send = target >= 0
 
     # ---- phase 3: sender piggyback selection (issueAsSender) ----------
-    server_count = jnp.sum(
-        state.known & ((state.status == ALIVE) | (state.status == SUSPECT)),
-        axis=1,
-    ).astype(jnp.int32)
-    max_pb = _max_piggyback(server_count, params.piggyback_factor)  # [N]
-    bump = valid_send[:, None] & state.ch_active
-    ch_pb = state.ch_pb + bump.astype(jnp.int32)
-    over = state.ch_active & (ch_pb > max_pb[:, None])
-    ch_active = state.ch_active & ~over
-    sendable = bump & ~over  # message content mask [sender, subject]
-    state = state._replace(ch_pb=ch_pb, ch_active=ch_active)
+    # nothing to select or bump when every change table is empty (the
+    # converged steady state) — cond-gated like the other rare phases
+    def _sender_piggyback(state):
+        server_count = jnp.sum(
+            state.known
+            & ((state.status == ALIVE) | (state.status == SUSPECT)),
+            axis=1,
+        ).astype(jnp.int32)
+        max_pb = _max_piggyback(server_count, params.piggyback_factor)
+        bump = valid_send[:, None] & state.ch_active
+        ch_pb = state.ch_pb + bump.astype(jnp.int32)
+        over = state.ch_active & (ch_pb > max_pb[:, None])
+        sendable = bump & ~over  # message content mask [sender, subject]
+        state = state._replace(
+            ch_pb=ch_pb, ch_active=state.ch_active & ~over
+        )
+        return state, sendable, max_pb
+
+    state, sendable, max_pb = jax.lax.cond(
+        jnp.any(state.ch_active),
+        _sender_piggyback,
+        lambda s: (
+            s,
+            jnp.zeros((n, n), bool),
+            jnp.zeros(n, jnp.int32),
+        ),
+        state,
+    )
 
     # ---- phase 4: delivery mask ---------------------------------------
     loss = _uniform(state.rng, (n,), salt=13) < params.packet_loss

@@ -80,6 +80,29 @@ def clear_jax_backends() -> None:
         pass
 
 
+def reexec_retry(env_var: str, retries: int, sleep_s: float, script: str):
+    """Retry a driver script in a FRESH interpreter via os.execve.
+
+    When another client holds the single-client axon tunnel, JAX backend
+    discovery silently falls back to CPU and memoizes the plugin failure —
+    an in-process clear_backends + retry re-reads the cached failure in
+    0 ms and can never recover.  The only reliable retry is a new process.
+    Returns False when the retry budget is exhausted (caller decides how
+    to degrade); otherwise sleeps and never returns (execve).
+    """
+    import os
+    import sys
+    import time
+
+    attempt = int(os.environ.get(env_var, "0"))
+    if attempt + 1 >= max(1, retries):
+        return False
+    time.sleep(sleep_s)
+    env = dict(os.environ)
+    env[env_var] = str(attempt + 1)
+    os.execve(sys.executable, [sys.executable, os.path.abspath(script)], env)
+
+
 def pin_cpu_platform(n_devices=None) -> None:
     """Clear any live JAX backends and force the CPU platform (optionally
     with ``n_devices`` virtual devices).

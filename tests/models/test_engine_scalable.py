@@ -315,3 +315,38 @@ def test_graceful_leave_and_rejoin_at_scale():
     for _ in range(25):
         state, m = step(state, es.ChurnInputs.quiet(n))
     assert int(m.distinct_checksums) == 1
+
+
+def test_checksum_matmul_limbs_match_numpy_reference():
+    """The MXU limb-matmul checksum must equal the direct mod-2^32 sum
+    base_sum + Σ_{heard ∩ active} r_delta, computed independently in
+    numpy — including wrap-around of large deltas."""
+    n, u = 257, 256  # odd n exercises chunk padding
+    params = es.ScalableParams(n=n, u=u)
+    state = es.init_state(params, seed=11)
+    rng = np.random.default_rng(5)
+    # adversarial rumor table: huge deltas to force uint32 wrap, random
+    # active set, random heard bits
+    state = state._replace(
+        r_active=jnp.asarray(rng.random(u) < 0.7),
+        r_delta=jnp.asarray(
+            rng.integers(0, 2**32, size=u, dtype=np.uint32)
+        ),
+        heard=jnp.asarray(
+            rng.integers(0, 2**32, size=(n, u // 32), dtype=np.uint32)
+        ),
+        base_sum=jnp.uint32(0xDEADBEEF),
+    )
+    got = np.asarray(es.compute_checksums(state, params))
+
+    active = np.asarray(state.r_active)
+    delta = np.asarray(state.r_delta)
+    heard = np.asarray(state.heard)
+    want = np.zeros(n, np.uint32)
+    for i in range(n):
+        total = np.uint64(0xDEADBEEF)
+        for r in range(u):
+            if active[r] and (heard[i, r // 32] >> np.uint32(r % 32)) & 1:
+                total += np.uint64(delta[r])
+        want[i] = np.uint32(total & np.uint64(0xFFFFFFFF))
+    assert (got == want).all(), np.flatnonzero(got != want)[:5]
