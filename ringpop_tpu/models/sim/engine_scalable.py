@@ -301,7 +301,11 @@ def _publish_batch(
     )
 
 
-def compute_checksums(state: ScalableState, params: ScalableParams) -> jax.Array:
+def compute_checksums(
+    state: ScalableState,
+    params: ScalableParams,
+    _chunk_rows: int = 65536,
+) -> jax.Array:
     """checksum(i) = base_sum + Σ over active rumors i heard of r_delta.
 
     The per-node sum is computed as a matmul on 8-bit limbs of the deltas:
@@ -337,7 +341,7 @@ def compute_checksums(state: ScalableState, params: ScalableParams) -> jax.Array
         )
 
     n = state.heard.shape[0]
-    chunk = max(1, min(n, 65536))
+    chunk = max(1, min(n, _chunk_rows))
     pad = (-n) % chunk
     rows = state.heard
     if pad:

@@ -100,7 +100,13 @@ def reexec_retry(env_var: str, retries: int, sleep_s: float, script: str):
     time.sleep(sleep_s)
     env = dict(os.environ)
     env[env_var] = str(attempt + 1)
-    os.execve(sys.executable, [sys.executable, os.path.abspath(script)], env)
+    os.execve(
+        sys.executable,
+        # forward the original flags — a re-exec must not silently
+        # continue with defaults
+        [sys.executable, os.path.abspath(script)] + sys.argv[1:],
+        env,
+    )
 
 
 def pin_cpu_platform(n_devices=None) -> None:

@@ -338,6 +338,10 @@ def test_checksum_matmul_limbs_match_numpy_reference():
         base_sum=jnp.uint32(0xDEADBEEF),
     )
     got = np.asarray(es.compute_checksums(state, params))
+    # chunked path with padding: 257 rows in 64-row chunks pads the last
+    # chunk; padded rows must contribute nothing
+    got_padded = np.asarray(es.compute_checksums(state, params, _chunk_rows=64))
+    assert (got_padded == got).all()
 
     active = np.asarray(state.r_active)
     delta = np.asarray(state.r_delta)
