@@ -125,15 +125,17 @@ def main() -> int:
     n = int(os.environ.get("BENCH_N", "1024"))
     ticks = int(os.environ.get("BENCH_TICKS", "32"))
 
-    cpu_fallback = False
-    if not os.environ.get("BENCH_ALLOW_CPU") and "cpu" not in os.environ.get(
-        "JAX_PLATFORMS", ""
-    ):  # explicit CPU pin = intentional, not a tunnel fallback
-        cpu_fallback = _reexec_if_cpu_fallback()
+    # snapshot BEFORE anything mutates the env: pin_cpu_platform() on the
+    # last-resort path writes JAX_PLATFORMS=cpu, which must not be
+    # mistaken for a user's intentional CPU pin by the fallback marker
+    intentional_cpu = bool(os.environ.get("BENCH_ALLOW_CPU")) or (
+        "cpu" in os.environ.get("JAX_PLATFORMS", "")
+    )
+    if not intentional_cpu:
+        _reexec_if_cpu_fallback()
 
     last_err = None
     attempts_made = 0
-    pinned_cpu = False
     total = max(1, RETRIES)
     for attempt in range(total):
         attempts_made = attempt + 1
@@ -147,17 +149,13 @@ def main() -> int:
                     from ringpop_tpu.utils.util import pin_cpu_platform
 
                     pin_cpu_platform()
-                    pinned_cpu = True
                 except Exception:
                     pass
             result = _measure(n, ticks)
             result["attempts"] = attempts_made + int(
                 os.environ.get("BENCH_REEXEC_ATTEMPT", "0")
             )
-            if result.get("platform") != "tpu" and not (
-                os.environ.get("BENCH_ALLOW_CPU")
-                or "cpu" in os.environ.get("JAX_PLATFORMS", "")
-            ):
+            if result.get("platform") != "tpu" and not intentional_cpu:
                 # explicit marker: this number is a CPU measurement taken
                 # because the TPU tunnel was unavailable (any path: pinned
                 # last-resort, exhausted re-exec budget, or a silent

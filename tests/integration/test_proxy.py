@@ -408,3 +408,68 @@ def test_channel_destroy_mid_retry_aborts_forwarding(cluster):
         sender.proxy_req(
             {"keys": ["k"], "dest": "127.0.0.1:1", "req": {"url": "/x"}}
         )
+
+
+def test_response_status_and_headers_propagate(cluster):
+    """The remote handler's statusCode and headers ride back through the
+    proxy envelope (request-proxy/index.js onResponse: responseHead)."""
+    c = cluster(n=2)
+    sender, dest = c.node(0), c.node(1)
+    key = key_owned_by(c, dest, tag="rs")
+
+    def handler(req, res, head):
+        res.end({"made": "it"}, status=201, headers={"x-served": "yes"})
+
+    dest.on("request", handler)
+    res = sender.proxy_req(
+        {"keys": [key], "dest": dest.whoami(), "req": {"url": "/s"}}
+    )
+    assert res["statusCode"] == 201
+    assert res["headers"] == {"x-served": "yes"}
+    assert res["body"] == {"made": "it"}
+
+
+def test_handle_or_proxy_all_partial_failure(cluster):
+    """One dead owner must not poison the other groups: its entry carries
+    `error`, the rest carry `res` (index.js:609-667 per-group callbacks)."""
+    c = cluster(n=3)
+    wire_echo_handlers(c)
+    sender, healthy, doomed = c.node(0), c.node(1), c.node(2)
+    k_ok = key_owned_by(c, healthy, tag="pf-ok")
+    k_bad = key_owned_by(c, doomed, tag="pf-bad")
+    sender.request_proxy.retry_schedule_s = [0.0]
+    doomed.destroy()  # owner is gone; ring on sender still maps to it
+    results = sender.handle_or_proxy_all([k_ok, k_bad], {"url": "/pf"})
+    by_dest = {r["dest"]: r for r in results}
+    ok = by_dest[healthy.whoami()]
+    assert ok["res"]["body"]["handledBy"] == healthy.whoami()
+    bad = by_dest[doomed.whoami()]
+    assert "error" in bad and "res" not in bad
+
+
+def test_proxy_endpoint_override(cluster):
+    """opts.endpoint replaces /proxy/req (send.js channelOpts.endpoint) —
+    e.g. routing to a custom registered handler."""
+    c = cluster(n=2)
+    sender, dest = c.node(0), c.node(1)
+    key = key_owned_by(c, dest, tag="ep")
+    seen = {}
+
+    def custom(head, body):
+        seen["head"] = head
+        return None, {"via": "custom"}
+
+    dest.channel.register("/custom/endpoint", custom)
+    res = sender.proxy_req(
+        {
+            "keys": [key],
+            "dest": dest.whoami(),
+            "req": {"url": "/x"},
+            "endpoint": "/custom/endpoint",
+        }
+    )
+    # a custom endpoint's handler answers with its raw body (the
+    # {statusCode, headers, body} envelope is built by /proxy/req's own
+    # handler, not the channel)
+    assert res == {"via": "custom"}
+    assert seen["head"]["ringpopKeys"] == [key]
