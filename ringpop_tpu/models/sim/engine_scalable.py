@@ -210,6 +210,15 @@ def _perm(key: jax.Array, n: int, salt: int) -> jax.Array:
     ).astype(jnp.int32)
 
 
+# NOTE (round-3 measurement): replacing the per-round argsort partner
+# permutations with affine re-indexings of one shared base (analytic
+# inverses, one argsort total) looked like an obvious win — index
+# GENERATION is 7x cheaper — but the full exchange ran 2-3x SLOWER on
+# this image's CPU at both 100k and 1M, reproducibly, with identical
+# shapes/dtypes and equally-uniform index values.  The argsort variant
+# stays; sorts are also fast on TPU.
+
+
 def _pack_mask(bits: jax.Array) -> jax.Array:
     """[U] bool -> [U/32] uint32, bit r of word r//32 = bits[r]."""
     u = bits.shape[0]

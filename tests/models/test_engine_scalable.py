@@ -151,6 +151,12 @@ def test_rumor_expiry_drops_active():
     params, state, step = make(n=8, suspicion_ticks=1000, age_slack=0)
     kill = jnp.zeros(8, bool).at[2].set(True)
     state, _ = step(state, es.ChurnInputs(kill=kill, revive=jnp.zeros(8, bool)))
+    # detection is evidence-based: tick until some live node's direct
+    # ping draws the dead node and its ping-req evidence lands
+    for _ in range(10):
+        if int(jnp.sum(state.r_active)) >= 1:
+            break
+        state, _ = step(state, es.ChurnInputs.quiet(8))
     assert int(jnp.sum(state.r_active)) >= 1
     # max age = 15 * digits(live=7 -> 1) + 0 = 15 ticks
     state, ms = run_ticks(state, step, 20, 8)
