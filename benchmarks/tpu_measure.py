@@ -142,6 +142,49 @@ def phase_pallas_vs_scan(results: dict) -> None:
             results["hash32_rows_%s" % impl] = {"error": str(e)[:300]}
 
 
+def phase_encode_impls(results: dict) -> None:
+    """Checksum-string encode: scatter vs gather on the chip (the encode,
+    not the hash, dominates parity-mode recomputes; CPU prefers scatter
+    4x — device scatters may invert that)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from ringpop_tpu.models.sim.cluster import default_addresses
+    from ringpop_tpu.ops import checksum_encode as ce
+
+    n = 1024
+    u = ce.Universe.from_addresses(default_addresses(n))
+    pres = jnp.ones((n, n), bool)
+    stat = jnp.zeros((n, n), jnp.int32)
+    inc = jnp.full((n, n), 1414142122274, jnp.int64)
+    want = None
+    for impl in ("scatter", "gather"):
+        try:
+            f = jax.jit(
+                lambda p, s, i, impl=impl: ce.membership_rows(
+                    u, p, s, i, max_digits=14, impl=impl
+                )
+            )
+            out = jax.block_until_ready(f(pres, stat, inc))
+            t0 = time.perf_counter()
+            for _ in range(5):
+                out = f(pres, stat, inc)
+            out = jax.block_until_ready(out)
+            dt = (time.perf_counter() - t0) / 5
+            if want is None:
+                want = np.asarray(out[0])
+            else:
+                lens = np.asarray(out[1])
+                assert (
+                    np.asarray(out[0])[:, : lens.min()]
+                    == want[:, : lens.min()]
+                ).all()
+            results["encode_%s" % impl] = {"ms": round(dt * 1e3, 2)}
+        except Exception as e:
+            results["encode_%s" % impl] = {"error": str(e)[:300]}
+
+
 def phase_epidemic_100k(results: dict) -> None:
     import jax
     import numpy as np
@@ -233,6 +276,7 @@ def main() -> int:
     for name, fn in (
         ("headline", phase_headline),
         ("pallas_vs_scan", phase_pallas_vs_scan),
+        ("encode_impls", phase_encode_impls),
         ("epidemic_100k", phase_epidemic_100k),
         ("storm_1m", phase_storm_1m),
     ):

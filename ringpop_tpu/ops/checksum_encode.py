@@ -135,6 +135,7 @@ def membership_rows(
     max_digits: int = MAX_DIGITS,
     width: Optional[int] = None,
     chunk: int = 64,
+    impl: str = "scatter",
 ):
     """Build per-row membership checksum strings; returns (buf [B,W] uint8,
     lens [B] int32), ready for ops.jax_farmhash.hash32_rows.
@@ -143,7 +144,18 @@ def membership_rows(
     shrinks buffers but is only sound if the caller guarantees every
     incarnation number has at most that many decimal digits — a wider value
     would silently corrupt the string (offsets account for the true digit
-    count while bytes past ``max_digits`` are never written)."""
+    count while bytes past ``max_digits`` are never written).
+
+    ``impl``: 'scatter' (default) scatters each member segment's bytes to
+    its cumsum offset — measured 4x faster than 'gather' on this image's
+    CPU (713 vs 3048 ms for 1024 full 36 KB rows).  'gather' derives every
+    output byte's source via searchsorted over the offset cumsum — no
+    scatter anywhere; kept as the TPU candidate (device scatters serialize
+    there) and A/B'd on hardware by benchmarks/tpu_measure.py."""
+    if impl == "gather":
+        return _membership_rows_gather(
+            universe, present, status, incarnation, max_digits, width, chunk
+        )
     width = width or universe.member_row_width(max_digits)
     A = universe.addr_width
     addr_bytes = jnp.asarray(universe.addr_bytes)
@@ -197,26 +209,113 @@ def membership_rows(
         )
         return _scatter_rows(width, positions, values), total
 
+    return _chunked_rows(
+        one_row, present, status, incarnation, chunk, width, universe.n
+    )
+
+
+def _chunked_rows(one_row, present, status, incarnation, chunk, width, n):
+    """vmap ``one_row`` over rows, in ``chunk``-row ``lax.map`` slabs when
+    the batch is large — bounds the [chunk, N, S] intermediates (shared by
+    both encoder forms)."""
     B = present.shape[0]
     if B <= chunk:
-        bufs, lens = jax.vmap(lambda p, s, i: one_row((p, s, i)))(
+        return jax.vmap(lambda p, s, i: one_row((p, s, i)))(
             present, status, incarnation
         )
-    else:
-        pad = (-B) % chunk
-        p = jnp.pad(present, ((0, pad), (0, 0)))
-        s = jnp.pad(status, ((0, pad), (0, 0)))
-        i = jnp.pad(incarnation, ((0, pad), (0, 0)))
-        p = p.reshape(-1, chunk, universe.n)
-        s = s.reshape(-1, chunk, universe.n)
-        i = i.reshape(-1, chunk, universe.n)
-        bufs, lens = jax.lax.map(
-            lambda args: jax.vmap(lambda pp, ss, ii: one_row((pp, ss, ii)))(*args),
-            (p, s, i),
+    pad = (-B) % chunk
+    p = jnp.pad(present, ((0, pad), (0, 0)))
+    s = jnp.pad(status, ((0, pad), (0, 0)))
+    i = jnp.pad(incarnation, ((0, pad), (0, 0)))
+    bufs, lens = jax.lax.map(
+        lambda args: jax.vmap(lambda pp, ss, ii: one_row((pp, ss, ii)))(*args),
+        (
+            p.reshape(-1, chunk, n),
+            s.reshape(-1, chunk, n),
+            i.reshape(-1, chunk, n),
+        ),
+    )
+    return bufs.reshape(-1, width)[:B], lens.reshape(-1)[:B]
+
+
+def _membership_rows_gather(
+    universe: Universe,
+    present: jax.Array,  # [B, N] bool
+    status: jax.Array,  # [B, N] int codes
+    incarnation: jax.Array,  # [B, N] int64
+    max_digits: int = MAX_DIGITS,
+    width: Optional[int] = None,
+    chunk: int = 64,
+):
+    """Gather-form encoder: output byte b of a row belongs to the member
+    whose [offset, offset+seg_len) interval contains b (binary search over
+    the inclusive-cumsum of segment ends), then resolves to an address
+    byte, a status byte, an ASCII digit of the incarnation, or ';' from
+    its position within the segment.  No scatter anywhere — the scatter
+    formulation serializes on both CPU and TPU, and at 1k nodes the
+    encode (not the hash) dominated the parity-mode recompute."""
+    width = width or universe.member_row_width(max_digits)
+    A = universe.addr_width
+    n = universe.n
+    addr_bytes = jnp.asarray(universe.addr_bytes)  # [N, A]
+    addr_len = jnp.asarray(universe.addr_len)  # [N]
+    status_bytes = jnp.asarray(STATUS_BYTES)
+    status_len = jnp.asarray(STATUS_LEN)
+    b_pos = jnp.arange(width, dtype=jnp.int32)  # [W]
+
+    def one_row(args):
+        pres, stat, inc = args
+        stat = stat.astype(jnp.int32)
+        pres_i = pres.astype(jnp.int32)
+        slen = status_len[stat]
+        dlen = _ndigits(inc)
+        seg_len = (addr_len + slen + dlen + 1) * pres_i
+        ends = jnp.cumsum(seg_len)  # inclusive: segment m covers
+        offset = ends - seg_len  # [offset[m], ends[m])
+        total = jnp.maximum(ends[-1] - jnp.int32(1), 0) * (
+            pres_i.sum() > 0
+        ).astype(jnp.int32)
+
+        # member owning each byte: first m with ends[m] > b (empty
+        # segments have ends[m] == offset of the next, so they never win)
+        m = jnp.searchsorted(ends, b_pos, side="right").astype(jnp.int32)
+        mc = jnp.clip(m, 0, n - 1)
+        local = b_pos - offset[mc]
+        al = addr_len[mc]
+        sl = slen[mc]
+        dl = dlen[mc]
+        st = stat[mc]
+
+        # segment-relative positions
+        s_off = local - al  # status byte index
+        d_off = s_off - sl  # digit index
+        is_addr = local < al
+        is_status = (s_off >= 0) & (s_off < sl)
+        is_digit = (d_off >= 0) & (d_off < dl)
+
+        byte_addr = addr_bytes[mc, jnp.clip(local, 0, A - 1)]
+        byte_status = status_bytes[st, jnp.clip(s_off, 0, _STATUS_W - 1)]
+        # per-member digit table ([N, D] divisions) instead of a division
+        # per output byte ([W] of them)
+        val_d = _digit_bytes(inc, dlen, max_digits)
+        byte_digit = val_d[mc, jnp.clip(d_off, 0, max_digits - 1)]
+
+        out = jnp.where(
+            is_addr,
+            byte_addr,
+            jnp.where(
+                is_status,
+                byte_status,
+                jnp.where(is_digit, byte_digit, jnp.uint8(ord(";"))),
+            ),
         )
-        bufs = bufs.reshape(-1, width)[:B]
-        lens = lens.reshape(-1)[:B]
-    return bufs, lens
+        # zero past the final separator-free length
+        out = jnp.where(b_pos < total, out, jnp.uint8(0))
+        return out, total
+
+    return _chunked_rows(
+        one_row, present, status, incarnation, chunk, width, n
+    )
 
 
 def ring_rows(

@@ -72,3 +72,38 @@ def test_ring_rows_match_host_strings():
         got = bytes(bufs[b, : lens[b]]).decode()
         assert got == want
         assert fh.hash32(got) == fh.hash32(want)
+
+
+def test_gather_impl_matches_scatter_impl():
+    """The gather-form encoder (TPU candidate) must produce byte-identical
+    strings to the scatter form on adversarial inputs: empty rows, full
+    rows, every status, and incarnation digit counts from 1 to 18."""
+    import numpy as np
+
+    from ringpop_tpu.models.sim.cluster import default_addresses
+
+    rng = np.random.default_rng(42)
+    for n, B in ((16, 10), (128, 33)):
+        u = ce.Universe.from_addresses(default_addresses(n))
+        pres = rng.random((B, n)) < 0.6
+        pres[0] = False
+        pres[1] = True
+        stat = rng.integers(0, 4, (B, n)).astype(np.int32)
+        inc = rng.choice(
+            [0, 1, 9, 10, 99, 1414142122274, 999999999999999999],
+            size=(B, n),
+        ).astype(np.int64)
+        a = ce.membership_rows(
+            u, jnp.asarray(pres), jnp.asarray(stat), jnp.asarray(inc),
+            impl="scatter",
+        )
+        # chunk=8 < B forces the lax.map chunked path in both impls
+        b = ce.membership_rows(
+            u, jnp.asarray(pres), jnp.asarray(stat), jnp.asarray(inc),
+            impl="gather", chunk=8,
+        )
+        la, lb = np.asarray(a[1]), np.asarray(b[1])
+        assert (la == lb).all()
+        ba, bb = np.asarray(a[0]), np.asarray(b[0])
+        for r in range(B):
+            assert (ba[r, : la[r]] == bb[r, : la[r]]).all(), (n, r)
