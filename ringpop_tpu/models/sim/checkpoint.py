@@ -20,6 +20,10 @@ T = TypeVar("T", bound=tuple)
 
 _FORMAT_KEY = "__ringpop_tpu_state__"
 _PARAMS_KEY = "__ringpop_tpu_params__"
+# params that tune performance without touching the trajectory — a resume
+# may change these freely, and a checkpoint from a build predating one of
+# them must still load (its absence on either side is ignored)
+_TRAJECTORY_NEUTRAL_PARAMS = frozenset({"dirty_batch", "checksum_in_tick"})
 # v2: incarnation fields are int32 tick stamps (engine.stamp_to_ms), not
 # int64 epoch-ms values — a v1 checkpoint's ms incarnations would be
 # silently misread as stamps, so loads reject version mismatches
@@ -78,6 +82,9 @@ def load_state(path: str, state_cls: Type[T], params: Any = None) -> T:
             current = json.loads(
                 json.dumps(dict(params._asdict()), sort_keys=True)
             )
+            for neutral in _TRAJECTORY_NEUTRAL_PARAMS:
+                saved_params.pop(neutral, None)
+                current.pop(neutral, None)
             if saved_params != current:
                 diff = {
                     k: (saved_params.get(k), current.get(k))

@@ -184,3 +184,25 @@ def test_suspend_resume_n16():
 def test_bootstrap_n128():
     n = 128
     run_lockstep(n, join_all(n) + quiet(n, 24))
+
+
+def test_dirty_batch_boundary_n16():
+    """dirty_batch=4 at n=16 forces BOTH checksum recompute paths — the
+    bounded gather/encode/scatter batch (n_dirty <= 4) and the full
+    recompute fallback (dissemination waves dirty > 4 rows) — through the
+    kill/revive lifecycle, lockstep-checked against the oracle each tick."""
+    n = 16
+    params = engine.SimParams(n=n, checksum_mode="farmhash", dirty_batch=4)
+    kill = np.zeros(n, bool)
+    kill[7] = True
+    revive = np.zeros(n, bool)
+    revive[7] = True
+    sched = (
+        join_all(n)
+        + quiet(n, 12)
+        + [{"kill": kill}]
+        + quiet(n, 34)
+        + [{"revive": revive}]
+        + quiet(n, 12)
+    )
+    run_lockstep(n, sched, params=params)
