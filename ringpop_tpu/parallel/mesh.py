@@ -225,3 +225,148 @@ class ShardedSim:
 
     def checksums(self) -> np.ndarray:
         return np.asarray(self.state.checksum)
+
+
+# ---------------------------------------------------------------------------
+# Scalable (rumor-table) engine over the mesh — the 1M-on-v5e-8 path.
+# Node-indexed arrays shard over the mesh; the bounded rumor table, rng,
+# and base_sum are tiny and replicate.  The gossip exchange's permutation
+# gathers become all-to-alls over ICI; the limb-matmul checksum shards by
+# rows with the [U, 4] limb table replicated.
+# ---------------------------------------------------------------------------
+
+
+# node-indexed ScalableState fields (sharded); everything else — the
+# bounded [U] rumor table, the scalar clock/base, the rng — replicates.
+# Decided by NAME, not shape: u == n would make shape checks ambiguous
+_SCALABLE_NODE_FIELDS = frozenset(
+    {
+        "proc_alive",
+        "gossip_on",
+        "partition",
+        "truth_status",
+        "truth_inc",
+        "heard",
+        "susp_subject",
+        "susp_since",
+        "defame_slot",
+        "checksum",
+    }
+)
+
+
+def scalable_state_shardings(mesh: Mesh, params):
+    from ringpop_tpu.models.sim import engine_scalable as es
+
+    axis = _node_axis(mesh)
+    abstract = jax.eval_shape(lambda: es.init_state(params))
+    return type(abstract)(
+        **{
+            f: NamedSharding(
+                mesh,
+                P(axis, *([None] * (getattr(abstract, f).ndim - 1)))
+                if f in _SCALABLE_NODE_FIELDS
+                else P(),
+            )
+            for f in abstract._fields
+        }
+    )
+
+
+class ShardedStorm:
+    """ScalableCluster over a device mesh: one SPMD program per tick/scan.
+
+    The driver behind the 1M churn-storm north-star's v5e-8 configuration:
+    same step/run surface as
+    :class:`ringpop_tpu.models.sim.storm.ScalableCluster`, with every
+    node-indexed array ``P("nodes")``-sharded and the trajectory bitwise
+    equal to the single-device engine (tests/parallel/test_mesh.py)."""
+
+    def __init__(self, n, mesh=None, params=None, seed: int = 0):
+        from ringpop_tpu.models.sim import engine_scalable as es
+
+        self.mesh = mesh if mesh is not None else make_mesh()
+        self.params = params or es.ScalableParams(n=n)
+        if self.params.n != n:
+            self.params = self.params._replace(n=n)
+        if n % self.mesh.devices.size:
+            raise ValueError(
+                "n=%d not divisible by mesh size %d"
+                % (n, self.mesh.devices.size)
+            )
+        self._st_sh = scalable_state_shardings(self.mesh, self.params)
+        self.state = jax.device_put(
+            es.init_state(self.params, seed=seed), self._st_sh
+        )
+        m_fields = len(es.ScalableMetrics._fields)
+        self._m_sh = jax.tree.map(
+            lambda _: NamedSharding(self.mesh, P()),
+            es.ScalableMetrics(*[0] * m_fields),
+        )
+        # jitted fns are built per input-pytree structure: ChurnInputs'
+        # optional partition/leave change the arg tree, and in_shardings
+        # frozen to the quiet() shape would reject them
+        self._ticks: dict = {}
+        self._scans: dict = {}
+
+    def _input_shardings(self, inputs, leading_time_axis: bool):
+        axis = _node_axis(self.mesh)
+        spec = P(None, axis) if leading_time_axis else P(axis)
+        return jax.tree.map(
+            lambda _: NamedSharding(self.mesh, spec), inputs
+        )
+
+    def _structure_key(self, inputs):
+        return (inputs.partition is None, inputs.leave is None)
+
+    def step(self, inputs=None):
+        from ringpop_tpu.models.sim import engine_scalable as es
+
+        if inputs is None:
+            inputs = es.ChurnInputs.quiet(self.params.n)
+        key = self._structure_key(inputs)
+        tick = self._ticks.get(key)
+        if tick is None:
+            fn = functools.partial(es.tick, params=self.params)
+            tick = self._ticks[key] = jax.jit(
+                fn,
+                in_shardings=(
+                    self._st_sh,
+                    self._input_shardings(inputs, False),
+                ),
+                out_shardings=(self._st_sh, self._m_sh),
+            )
+        self.state, m = tick(self.state, inputs)
+        return jax.tree.map(np.asarray, m)
+
+    def run(self, schedule):
+        from ringpop_tpu.models.sim import engine_scalable as es
+
+        inputs = schedule.as_inputs()
+        key = self._structure_key(inputs)
+        scan = self._scans.get(key)
+        if scan is None:
+
+            def scanned(state, inp):
+                def body(st, i):
+                    return es.tick(st, i, self.params)
+
+                return jax.lax.scan(body, state, inp)
+
+            scan = self._scans[key] = jax.jit(
+                scanned,
+                in_shardings=(
+                    self._st_sh,
+                    self._input_shardings(inputs, True),
+                ),
+                out_shardings=(self._st_sh, self._m_sh),
+            )
+        self.state, ms = scan(self.state, inputs)
+        return jax.tree.map(np.asarray, ms)
+
+    def checksums(self) -> np.ndarray:
+        from ringpop_tpu.models.sim import engine_scalable as es
+
+        if not bool(self.params.checksum_in_tick):
+            return np.asarray(es.compute_checksums(self.state, self.params))
+        return np.asarray(self.state.checksum)
