@@ -300,6 +300,153 @@ class JaxSimBackend:
         pass
 
 
+class ScalableSimBackend:
+    """The tick-cluster command surface over the O(N·U) rumor engine —
+    interactive operation of 100k-class clusters (the full-fidelity
+    jax-sim backend's [N, N] state caps out around a few thousand).
+
+    Scale adaptations, stated honestly:
+
+    - node identity is the integer index (labels ``node<i>``); there is
+      no per-node membership list to print, so ``stats`` reports cluster
+      aggregates (live count, active rumors, coverage, distinct views),
+    - ``suspend`` maps to ``kill``: the rumor engine models process death
+      + fresh restart (the reference rebuilds a restarted node via join
+      anyway); SIGSTOP-with-state-intact is the full engine's domain,
+    - ``lookup`` serves from the device ring over integer ids
+      (storm.build_ring), hashing the key with FarmHash32 like the
+      reference's ring.
+    - per-tick snapshots materialize an N-entry dict for the convergence
+      display; fine to ~200k interactively — beyond that, drive the
+      engine with benchmarks/storm_1m.py instead.
+    """
+
+    MAX_INTERACTIVE_N = 200_000
+
+    def __init__(self, n: int, **storm_kw):
+        from ringpop_tpu.models.sim.storm import ScalableCluster
+
+        if n > self.MAX_INTERACTIVE_N:
+            raise ValueError(
+                "jax-sim-scalable caps interactive use at %d nodes "
+                "(per-tick host snapshots); use benchmarks/storm_1m.py "
+                "for larger runs" % self.MAX_INTERACTIVE_N
+            )
+        self.n = n
+        self.cluster = ScalableCluster(n=n, **storm_kw)
+        self.hosts = ["node%d" % i for i in range(n)]
+        # view-keyed ring cache (like JaxSimBackend): converged lookups
+        # sort the N*R table once, not per command
+        self._ring_cache: Dict[bytes, tuple] = {}
+
+    def start(self) -> None:
+        pass  # the rumor engine starts converged-alive (no join round)
+
+    def tick_all(self) -> Dict[str, Optional[int]]:
+        import numpy as np
+
+        self.cluster.step()
+        cs = self.cluster.checksums()
+        alive = np.asarray(self.cluster.state.proc_alive)
+        return {
+            hp: (int(cs[i]) if alive[i] else None)
+            for i, hp in enumerate(self.hosts)
+        }
+
+    def join_all(self) -> None:
+        pass  # idem: membership is the integer universe, always joined
+
+    def stats_all(self) -> Dict[str, Any]:
+        import jax.numpy as jnp
+        import numpy as np
+
+        from ringpop_tpu.models.sim import engine_scalable as es
+
+        st = self.cluster.state
+        alive = np.asarray(st.proc_alive)
+        cs = self.cluster.checksums()
+        return {
+            "cluster": {
+                "n": self.n,
+                "live_nodes": int(alive.sum()),
+                "active_rumors": int(np.asarray(jnp.sum(st.r_active))),
+                "distinct_checksums": int(np.unique(cs[alive]).size),
+                "suspects_in_truth": int(
+                    (np.asarray(st.truth_status) == es.SUSPECT).sum()
+                ),
+                "faulty_in_truth": int(
+                    (np.asarray(st.truth_status) == es.FAULTY).sum()
+                ),
+                "ring_checksum": self.cluster.ring_checksum(),
+            }
+        }
+
+    def lookup(self, key, node: int = 0) -> Optional[str]:
+        import jax.numpy as jnp
+        import numpy as np
+
+        from ringpop_tpu.models.ring import device as ringdev
+        from ringpop_tpu.models.sim import engine_scalable as es
+        from ringpop_tpu.models.sim.storm import (
+            build_ring,
+            device_replica_hashes,
+        )
+        from ringpop_tpu.ops import farmhash32 as fh
+
+        st = self.cluster.state
+        in_ring_np = np.asarray(st.proc_alive) & (
+            np.asarray(st.truth_status) <= es.SUSPECT
+        )
+        cache_key = in_ring_np.tobytes()
+        cached = self._ring_cache.get(cache_key)
+        if cached is None:
+            reps = device_replica_hashes(
+                self.n, self.cluster.replica_points
+            )
+            ring = build_ring(reps, jnp.asarray(in_ring_np))
+            n_points = int(in_ring_np.sum()) * self.cluster.replica_points
+            if len(self._ring_cache) >= 8:
+                self._ring_cache.pop(next(iter(self._ring_cache)))
+            self._ring_cache[cache_key] = cached = (ring, n_points)
+        ring, n_points = cached
+        if n_points == 0:
+            return None
+        # storm.build_ring shares models/ring/device.py's table layout
+        # (hash<<32|owner, sentinel-padded, sorted) — one lookup helper
+        owner = int(
+            ringdev.lookup(ring, n_points, jnp.uint32(fh.hash32(str(key))))
+        )
+        return self.hosts[owner] if owner >= 0 else None
+
+    def kill(self, i: int) -> None:
+        # like JaxSimBackend (SimCluster.kill), fault injection rides one
+        # protocol period: the event IS a tick with the kill input set
+        import jax.numpy as jnp
+
+        from ringpop_tpu.models.sim import engine_scalable as es
+
+        kill = jnp.zeros(self.n, bool).at[i].set(True)
+        self.cluster.step(
+            es.ChurnInputs(kill=kill, revive=jnp.zeros(self.n, bool))
+        )
+
+    def suspend(self, i: int) -> None:
+        self.kill(i)  # documented: SIGSTOP semantics are full-engine-only
+
+    def revive(self, i: int) -> None:
+        import jax.numpy as jnp
+
+        from ringpop_tpu.models.sim import engine_scalable as es
+
+        rv = jnp.zeros(self.n, bool).at[i].set(True)
+        self.cluster.step(
+            es.ChurnInputs(kill=jnp.zeros(self.n, bool), revive=rv)
+        )
+
+    def destroy(self) -> None:
+        pass
+
+
 class TickCluster:
     """Backend-agnostic driver with the tick-cluster command surface.
 
@@ -320,7 +467,13 @@ class TickCluster:
             return TickCluster(LiveBackend(n, **kw))
         if backend == "jax-sim":
             return TickCluster(JaxSimBackend(n, **kw))
-        raise ValueError("unknown backend %r (live | jax-sim)" % backend)
+        if backend == "jax-sim-scalable":
+            kw.pop("base_port", None)  # integer-id universe: no ports
+            return TickCluster(ScalableSimBackend(n, **kw))
+        raise ValueError(
+            "unknown backend %r (live | jax-sim | jax-sim-scalable)"
+            % backend
+        )
 
     def start(self) -> None:
         self.backend.start()
@@ -442,7 +595,9 @@ def main(argv=None) -> int:
     )
     p.add_argument("-n", type=int, default=5, help="number of nodes")
     p.add_argument(
-        "--backend", choices=("live", "jax-sim"), default="live"
+        "--backend",
+        choices=("live", "jax-sim", "jax-sim-scalable"),
+        default="live",
     )
     p.add_argument("--base-port", type=int, default=3000)
     p.add_argument(

@@ -207,3 +207,60 @@ def test_jax_sim_lookup_matches_host_ring():
     assert all(
         tc.backend.lookup("probe-%d" % i) != victim for i in range(30)
     )
+
+
+def test_scalable_backend_commands():
+    """The jax-sim-scalable backend (O(N·U) engine) drives the same
+    command surface: tick/kill/revive/stats/lookup at a node count the
+    [N,N] backend could not host interactively."""
+    import json as _json
+
+    tc = TickCluster.create("jax-sim-scalable", 512)
+    tc.start()
+    tc.tick()
+    assert tc.converged()  # rumor engine starts converged-alive
+
+    out = tc.run_command("k 37")
+    assert "killed" in out
+    for _ in range(60):
+        tc.tick()
+        groups = tc.checksum_groups()
+        if None in groups and sum(1 for c in groups if c is not None) == 1:
+            break
+    groups = tc.checksum_groups()
+    assert groups.get(None) == ["node37"]
+
+    stats = _json.loads(tc.run_command("s"))
+    assert stats["cluster"]["live_nodes"] == 511
+    assert stats["cluster"]["n"] == 512
+    assert "ring_checksum" in stats["cluster"]
+
+    # lookup serves from the live device ring; a key's owner is live
+    out = tc.run_command("w somekey")
+    owner = out.split("-> ")[1]
+    assert owner.startswith("node") and owner != "node37"
+
+    tc.run_command("K 37")
+    for _ in range(80):
+        tc.tick()
+        if tc.converged() and None not in tc.checksum_groups():
+            break
+    assert tc.converged() and None not in tc.checksum_groups()
+    assert "CONVERGED" in tc.format_groups()
+
+
+def test_scalable_backend_lookup_excludes_dead_owner():
+    """After a kill disseminates to faulty, the dead node's replica points
+    leave the ring: lookups never route to it (ring rebalance)."""
+    tc = TickCluster.create("jax-sim-scalable", 64)
+    tc.start()
+    tc.run_command("k 5")
+    for _ in range(80):
+        tc.tick()
+        stats = tc.backend.stats_all()["cluster"]
+        if stats["faulty_in_truth"] >= 1:
+            break
+    assert tc.backend.stats_all()["cluster"]["faulty_in_truth"] >= 1
+    for i in range(50):
+        owner = tc.backend.lookup("key-%d" % i)
+        assert owner != "node5"
