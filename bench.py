@@ -122,6 +122,12 @@ def _reexec_if_cpu_fallback() -> bool:
 
 
 def main() -> int:
+    # repo-pointing PYTHONPATH entries break the axon discovery helper
+    # (silent CPU fallback); our own imports ride the script-dir sys.path
+    from ringpop_tpu.utils.util import scrub_repo_pythonpath
+
+    scrub_repo_pythonpath(os.path.dirname(os.path.abspath(__file__)))
+
     n = int(os.environ.get("BENCH_N", "1024"))
     ticks = int(os.environ.get("BENCH_TICKS", "32"))
 

@@ -264,6 +264,14 @@ def phase_storm_1m(results: dict) -> None:
 
 
 def main() -> int:
+    # repo-pointing PYTHONPATH entries break the axon discovery helper
+    # (silent CPU fallback); imports ride the sys.path.insert above
+    from ringpop_tpu.utils.util import scrub_repo_pythonpath
+
+    scrub_repo_pythonpath(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    )
+
     import ringpop_tpu  # noqa: F401  (x64 config before backend init)
 
     plat = wait_for_tpu()

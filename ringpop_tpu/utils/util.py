@@ -80,6 +80,31 @@ def clear_jax_backends() -> None:
         pass
 
 
+def scrub_repo_pythonpath(repo_root: str) -> None:
+    """Remove repo-pointing entries from PYTHONPATH before backend init.
+
+    The axon tunnel's TPU discovery helper inherits PYTHONPATH and fails
+    when it points into this repo — jax then silently falls back to CPU.
+    Shared by the driver entry points (bench.py, tpu_measure.py), which
+    put the repo on sys.path themselves; non-repo entries are preserved
+    for re-exec'd children that may rely on them."""
+    import os
+
+    pp = os.environ.get("PYTHONPATH")
+    if not pp:
+        return
+    root = os.path.abspath(repo_root)
+    kept = [
+        e
+        for e in pp.split(os.pathsep)
+        if e and not os.path.abspath(e).startswith(root)
+    ]
+    if kept:
+        os.environ["PYTHONPATH"] = os.pathsep.join(kept)
+    else:
+        os.environ.pop("PYTHONPATH", None)
+
+
 def reexec_retry(env_var: str, retries: int, sleep_s: float, script: str):
     """Retry a driver script in a FRESH interpreter via os.execve.
 
