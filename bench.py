@@ -78,12 +78,8 @@ def _measure(n: int, ticks: int) -> dict:
     import jax
 
     rate, elapsed, metrics = _mode_rate(n, ticks, "fast")
-    # parity mode: bit-exact reference FarmHash32 string checksums in the
-    # same compiled tick (dirty-row cached) — the north-star configuration
-    parity_rate, _, _ = _mode_rate(n, ticks, "farmhash")
-
     baseline = n * 5.0  # real-time reference: 5 protocol periods/s/node
-    return {
+    result = {
         "metric": "swim_node_protocol_periods_per_sec_1k",
         "value": round(rate, 1),
         "unit": "node-ticks/s",
@@ -92,10 +88,25 @@ def _measure(n: int, ticks: int) -> dict:
         "ticks": ticks,
         "elapsed_s": round(elapsed, 3),
         "converged": bool(np.asarray(metrics.converged)[-1]),
-        "parity_mode_node_ticks_per_sec": round(parity_rate, 1),
-        "parity_mode_vs_baseline": round(parity_rate / baseline, 2),
         "platform": jax.devices()[0].platform,
     }
+    # parity mode: bit-exact reference FarmHash32 string checksums in the
+    # same compiled tick (dirty-row cached) — the north-star config.  Not
+    # allowed to sink the whole artifact: the tunneled chip's remote
+    # compile helper occasionally 500s on large graphs, and a fast-mode
+    # number with a parity_error beats an error-only artifact.
+    try:
+        parity_rate, _, _ = _mode_rate(n, ticks, "farmhash")
+        result["parity_mode_node_ticks_per_sec"] = round(parity_rate, 1)
+        result["parity_mode_vs_baseline"] = round(parity_rate / baseline, 2)
+    except Exception as exc:
+        if _is_transient(exc):
+            raise  # retryable backend failures keep the retry semantics
+        result["parity_error"] = "%s: %s" % (
+            type(exc).__name__,
+            str(exc)[:300],
+        )
+    return result
 
 
 def _reexec_if_cpu_fallback() -> bool:
