@@ -327,7 +327,11 @@ def init_state(
         rng=jnp.asarray(keys),
         checksum=jnp.zeros(n, jnp.uint32),
     )
-    if universe is not None:
+    # Fast mode never touches the universe in compute_checksums, so the
+    # cache can (and must) be seeded even without one — a fast-mode caller
+    # omitting universe would otherwise see stale zero checksums for rows
+    # the dirty-gated tick never recomputes.
+    if universe is not None or params.checksum_mode == "fast":
         state = state._replace(
             checksum=compute_checksums(state, universe, params)
         )
@@ -737,6 +741,14 @@ def tick(
     # tick.  Skipped entirely on wrap-free ticks (the draws are pure
     # functions of state.rng, so skipping changes no other randomness).
     # The host oracle mirrors this arithmetic bitwise (parity/oracle.py).
+    # Deviation envelope caveat: rows that wrap on the SAME tick share one
+    # base permutation, so their walk orders are affinely correlated
+    # (the reference Fisher-Yates-shuffles each node independently).  In
+    # steady state ~one row wraps per tick and the correlation is moot;
+    # after a synchronized mass wrap (e.g. right after bootstrap, where
+    # all iter_pos start equal) correlated walks can skew target-selection
+    # collision statistics for a few rounds until wrap ticks desynchronize
+    # (rows wrap at iter_pos + first_k >= n, and first_k varies per row).
     resh = wrapped & participating
     coprimes, coprime_invs = _coprimes_of(n)  # static [K] int32 each
 
@@ -770,15 +782,21 @@ def tick(
     valid_send = target >= 0
 
     # ---- phase 3: sender piggyback selection (issueAsSender) ----------
+    # max_pb is hoisted OUT of the phase-3 cond: the receiver-side bump in
+    # phase 5.5 reuses it, and while phase 5 can only create changes when
+    # phase 3 produced sendable content TODAY, a future phase inserted
+    # between them would otherwise inherit an all-zero max_pb from the
+    # skipped cond and instantly retire every new change.  The [N] digit
+    # count from an [N, N] reduce is cheap at this engine's n <= a few k.
+    server_count = jnp.sum(
+        state.known & ((state.status == ALIVE) | (state.status == SUSPECT)),
+        axis=1,
+    ).astype(jnp.int32)
+    max_pb = _max_piggyback(server_count, params.piggyback_factor)
+
     # nothing to select or bump when every change table is empty (the
     # converged steady state) — cond-gated like the other rare phases
     def _sender_piggyback(state):
-        server_count = jnp.sum(
-            state.known
-            & ((state.status == ALIVE) | (state.status == SUSPECT)),
-            axis=1,
-        ).astype(jnp.int32)
-        max_pb = _max_piggyback(server_count, params.piggyback_factor)
         bump = valid_send[:, None] & state.ch_active
         ch_pb = state.ch_pb + bump.astype(jnp.int32)
         over = state.ch_active & (ch_pb > max_pb[:, None])
@@ -786,16 +804,12 @@ def tick(
         state = state._replace(
             ch_pb=ch_pb, ch_active=state.ch_active & ~over
         )
-        return state, sendable, max_pb
+        return state, sendable
 
-    state, sendable, max_pb = jax.lax.cond(
+    state, sendable = jax.lax.cond(
         jnp.any(state.ch_active),
         _sender_piggyback,
-        lambda s: (
-            s,
-            jnp.zeros((n, n), bool),
-            jnp.zeros(n, jnp.int32),
-        ),
+        lambda s: (s, jnp.zeros((n, n), bool)),
         state,
     )
 

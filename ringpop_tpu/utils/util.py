@@ -105,6 +105,44 @@ def scrub_repo_pythonpath(repo_root: str) -> None:
         os.environ.pop("PYTHONPATH", None)
 
 
+def wait_for_tpu(
+    script: str,
+    env_var: str = "TPU_WAIT_ATTEMPT",
+    retries: int = 90,
+    sleep_s: float = 20.0,
+) -> str:
+    """Grab the (single-client) axon tunnel, retrying in a FRESH process.
+
+    When the tunnel is held by another client, backend discovery silently
+    falls back to CPU and JAX memoizes the plugin failure — only a new
+    interpreter can retry (see reexec_retry).  Shared by the chip-gated
+    drivers (tpu_measure.py, prof scripts); raises RuntimeError when the
+    retry budget is exhausted so callers can degrade to a marked artifact.
+    """
+    import json as _json
+    import os
+    import sys as _sys
+
+    import jax
+
+    try:
+        plat = jax.devices()[0].platform
+    except Exception as e:  # init raised (the other transient mode)
+        print(_json.dumps({"init_err": str(e)[:120]}), file=_sys.stderr)
+        plat = "cpu"
+    if plat == "tpu":
+        return plat
+    print(
+        _json.dumps(
+            {"wait": os.environ.get(env_var, "0"), "platform": plat}
+        ),
+        file=_sys.stderr,
+        flush=True,
+    )
+    if reexec_retry(env_var, retries, sleep_s, script) is False:
+        raise RuntimeError("TPU tunnel never became available")
+
+
 def reexec_retry(env_var: str, retries: int, sleep_s: float, script: str):
     """Retry a driver script in a FRESH interpreter via os.execve.
 

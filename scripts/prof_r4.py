@@ -1,0 +1,160 @@
+#!/usr/bin/env python3
+"""Round-4 per-op cost decomposition of the 1M scalable-engine tick on TPU.
+
+The round-3 storm numbers (RESULTS_TPU_r03.json) say 1.28 s/tick of
+non-checksum work and ~0.75 s/tick attributed to compute_checksums at
+N=1M, U=512 — but a traffic estimate puts the checksum limb-matmul at
+~10 ms.  Before optimizing, measure where the tick actually goes:
+argsorts (4 partner perms + 4 argsort-inverses per tick), the [1M,16]
+row gathers, the distinct-checksum sort, the publish record_mix chains,
+and compute_checksums itself.
+
+Prints one JSON dict; also writes PROF_R4.json.
+"""
+
+from __future__ import annotations
+
+import functools
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+OUT = os.environ.get("PROF_R4_OUT", "PROF_R4.json")
+
+
+def wait_for_tpu():
+    from ringpop_tpu.utils.util import wait_for_tpu as _wait
+
+    return _wait(__file__, "PROF_R4_ATTEMPT", 90, 20.0)
+
+
+def timeit(fn, *args, reps=5):
+    import jax
+
+    out = jax.block_until_ready(fn(*args))  # compile
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        out = fn(*args)
+    jax.block_until_ready(out)
+    return (time.perf_counter() - t0) / reps * 1e3  # ms
+
+
+def main() -> int:
+    from ringpop_tpu.utils.util import scrub_repo_pythonpath
+
+    scrub_repo_pythonpath(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    )
+    import ringpop_tpu  # noqa: F401
+
+    plat = wait_for_tpu()
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from ringpop_tpu.models.sim import engine_scalable as es
+
+    n, u = 1_000_000, 512
+    w = u // 32
+    res = {"platform": plat, "device": str(jax.devices()[0]), "n": n, "u": u}
+
+    key = jnp.asarray([0x12345678, 0x9ABCDEF0], jnp.uint32)
+    r = es._rand_u32(key, (n,), 7)
+    ids = jnp.arange(n, dtype=jnp.int32)
+    heard = es._rand_u32(key, (n, w), 11)
+    perm_host = np.random.default_rng(0).permutation(n).astype(np.int32)
+    perm = jnp.asarray(perm_host)
+
+    # 1. one partner permutation: argsort of [N] uint32
+    f_perm = jax.jit(lambda k: es._perm(k, n, 0xA11CE))
+    res["perm_argsort_ms"] = timeit(f_perm, key)
+
+    # 2. batched: all 4 perms in one [4, N] argsort
+    def four_perms(k):
+        rr = es._rand_u32(k, (4, n), 3)
+        return jnp.argsort(
+            rr ^ jnp.arange(n, dtype=jnp.uint32)[None, :], axis=-1
+        )
+
+    res["perm_argsort_x4_batched_ms"] = timeit(jax.jit(four_perms), key)
+
+    # 3. inverse: argsort vs scatter
+    res["inv_argsort_ms"] = timeit(jax.jit(jnp.argsort), perm)
+    f_scat = jax.jit(
+        lambda p: jnp.zeros(n, jnp.int32).at[p].set(ids, unique_indices=True)
+    )
+    res["inv_scatter_ms"] = timeit(f_scat, perm)
+
+    # 4. row gather [1M, 16] by permutation
+    f_gather = jax.jit(lambda h, p: h[p])
+    res["gather_rows_ms"] = timeit(f_gather, heard, perm)
+
+    # 5. distinct sort: jnp.sort of [N] uint32
+    res["sort_u32_ms"] = timeit(jax.jit(jnp.sort), r)
+
+    # 6. popcount metrics block
+    f_pop = jax.jit(lambda h: jnp.sum(es._popcount(h), axis=1))
+    res["popcount_rows_ms"] = timeit(f_pop, heard)
+
+    # 7. compute_checksums at 1M (full recompute, the in-tick cost)
+    params = es.ScalableParams(n=n, u=u, checksum_in_tick=True)
+    state = es.init_state(params, seed=0)
+    f_cs = jax.jit(functools.partial(es.compute_checksums, params=params))
+    res["compute_checksums_ms"] = timeit(f_cs, state)
+
+    # 8. record_mix over [N] (x2 per publish, 3 publishes per tick)
+    from ringpop_tpu.ops.record_mix import record_mix
+
+    f_mix = jax.jit(
+        lambda s, i: record_mix(ids, s, i)
+    )
+    res["record_mix_ms"] = timeit(
+        f_mix, jnp.zeros(n, jnp.int32), jnp.ones(n, jnp.int32)
+    )
+
+    # 9. full quiet tick, both checksum modes
+    for in_tick in (True, False):
+        p2 = es.ScalableParams(n=n, u=u, checksum_in_tick=in_tick)
+        st = es.init_state(p2, seed=0)
+        step = jax.jit(functools.partial(es.tick, params=p2))
+        quiet = es.ChurnInputs.quiet(n)
+        st, _ = step(st, quiet)  # compile + settle
+        jax.block_until_ready(st)
+        t0 = time.perf_counter()
+        reps = 5
+        for _ in range(reps):
+            st, _ = step(st, quiet)
+        jax.block_until_ready(st)
+        res["tick_quiet_ms_%s" % ("intick" if in_tick else "deferred")] = (
+            (time.perf_counter() - t0) / reps * 1e3
+        )
+
+    # 10. tick with 10% dead (storm steady state: direct fails every tick)
+    st = es.init_state(params, seed=0)
+    step = jax.jit(functools.partial(es.tick, params=params))
+    kill = jnp.asarray(np.arange(n) % 10 == 3)
+    st, _ = step(st, es.ChurnInputs(kill=kill, revive=jnp.zeros(n, bool)))
+    jax.block_until_ready(st)
+    quiet = es.ChurnInputs.quiet(n)
+    st, _ = step(st, quiet)
+    jax.block_until_ready(st)
+    t0 = time.perf_counter()
+    for _ in range(5):
+        st, _ = step(st, quiet)
+    jax.block_until_ready(st)
+    res["tick_10pct_dead_ms"] = (time.perf_counter() - t0) / 5 * 1e3
+
+    for k, v in sorted(res.items()):
+        if isinstance(v, float):
+            res[k] = round(v, 2)
+    with open(OUT, "w") as f:
+        json.dump(res, f, indent=1)
+    print(json.dumps(res))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
