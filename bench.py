@@ -38,6 +38,10 @@ import numpy as np
 
 RETRIES = int(os.environ.get("BENCH_RETRIES", "10"))
 RETRY_SLEEP_S = float(os.environ.get("BENCH_RETRY_SLEEP_S", "30"))
+# fresh-process retries for a parity-phase compile-helper failure (the
+# round-3 artifact regression: one HTTP 500 recorded as parity_error with
+# no second attempt, where n=64 parity had compiled fine minutes before)
+PARITY_RETRIES = int(os.environ.get("BENCH_PARITY_RETRIES", "4"))
 
 # Transient TPU-tunnel / backend failures worth retrying; anything else
 # (shape errors, engine bugs) fails fast.
@@ -49,9 +53,18 @@ _TRANSIENT_MARKERS = (
     "ABORTED",
 )
 
+# The axon tunnel's remote-compile helper intermittently 500s on large
+# graphs (transient tunnel state, not a verdict on the graph — the same
+# parity graph has compiled on-chip before and after such failures).
+_COMPILE_HELPER_MARKERS = ("remote_compile", "tpu_compile_helper")
+
 
 def _is_transient(exc: BaseException) -> bool:
     return any(m in str(exc) for m in _TRANSIENT_MARKERS)
+
+
+def _is_compile_helper_500(exc: BaseException) -> bool:
+    return any(m in str(exc) for m in _COMPILE_HELPER_MARKERS)
 
 
 def _mode_rate(n: int, ticks: int, mode: str) -> tuple:
@@ -74,10 +87,28 @@ def _mode_rate(n: int, ticks: int, mode: str) -> tuple:
     return n * ticks / elapsed, elapsed, metrics
 
 
+def _mode_rate_retry(n: int, ticks: int, mode: str) -> tuple:
+    """_mode_rate with in-process backoff for compile-helper 500s (the
+    tunnel's remote-compile helper fails intermittently on graphs that
+    compile fine seconds later).  Transient backend errors re-raise
+    immediately — main()'s retry loop owns those."""
+    exc = None
+    for backoff in (0.0, 10.0, 25.0):
+        if backoff:
+            time.sleep(backoff)
+        try:
+            return _mode_rate(n, ticks, mode)
+        except Exception as e:
+            exc = e
+            if _is_transient(exc) or not _is_compile_helper_500(exc):
+                raise
+    raise exc
+
+
 def _measure(n: int, ticks: int) -> dict:
     import jax
 
-    rate, elapsed, metrics = _mode_rate(n, ticks, "fast")
+    rate, elapsed, metrics = _mode_rate_retry(n, ticks, "fast")
     baseline = n * 5.0  # real-time reference: 5 protocol periods/s/node
     result = {
         "metric": "swim_node_protocol_periods_per_sec_1k",
@@ -95,17 +126,50 @@ def _measure(n: int, ticks: int) -> dict:
     # allowed to sink the whole artifact: the tunneled chip's remote
     # compile helper occasionally 500s on large graphs, and a fast-mode
     # number with a parity_error beats an error-only artifact.
-    try:
-        parity_rate, _, _ = _mode_rate(n, ticks, "farmhash")
-        result["parity_mode_node_ticks_per_sec"] = round(parity_rate, 1)
-        result["parity_mode_vs_baseline"] = round(parity_rate / baseline, 2)
-    except Exception as exc:
-        if _is_transient(exc):
-            raise  # retryable backend failures keep the retry semantics
-        result["parity_error"] = "%s: %s" % (
-            type(exc).__name__,
-            str(exc)[:300],
-        )
+    tries = 0
+    exc = None
+    for backoff in (0.0, 10.0, 25.0):  # in-process tries with backoff
+        if backoff:
+            time.sleep(backoff)
+        tries += 1
+        try:
+            parity_rate, _, _ = _mode_rate(n, ticks, "farmhash")
+            result["parity_mode_node_ticks_per_sec"] = round(parity_rate, 1)
+            result["parity_mode_vs_baseline"] = round(
+                parity_rate / baseline, 2
+            )
+            return result
+        except Exception as e:
+            exc = e
+            if _is_transient(exc):
+                raise  # retryable backend failures keep the retry semantics
+            if not _is_compile_helper_500(exc):
+                break  # real graph/engine error: no point retrying
+    # in-process budget exhausted on a compile-helper 500: a FRESH
+    # interpreter re-submits the compile through a clean tunnel session
+    # (the fast-mode number is re-measured there — itself protected by
+    # _mode_rate_retry — and the artifact prints once, at the end of
+    # whichever process finally succeeds)
+    if _is_compile_helper_500(exc):
+        from ringpop_tpu.utils.util import reexec_retry
+
+        if (
+            reexec_retry(
+                "BENCH_PARITY_ATTEMPT", PARITY_RETRIES, 20.0, __file__
+            )
+            is not False
+        ):  # pragma: no cover — execve does not return
+            raise AssertionError("unreachable")
+    result["parity_error"] = "%s: %s" % (
+        type(exc).__name__,
+        str(exc)[:300],
+    )
+    # actual parity attempts across every process of this run: each
+    # re-exec'd predecessor exhausted its full in-process budget (only
+    # compile-helper 500s re-exec; other errors break out above)
+    result["parity_attempts"] = tries + 3 * int(
+        os.environ.get("BENCH_PARITY_ATTEMPT", "0")
+    )
     return result
 
 

@@ -102,9 +102,9 @@ def test_batch_publish_delta_and_hearers():
     subj_mask = jnp.zeros(8, bool).at[1].set(True).at[6].set(True)
     hearers = jnp.zeros(8, bool).at[0].set(True)
     new_status = jnp.full(8, es.SUSPECT, jnp.int32)
-    state2 = es._publish_batch(
-        state, jnp.int32(5), subj_mask, new_status, state.truth_inc,
-        hearers, jnp.int32(1),
+    state2, csum2 = es._publish_batch(
+        state, state.checksum, jnp.int32(5), subj_mask, new_status,
+        state.truth_inc, hearers, jnp.int32(1),
     )
     assert bool(state2.r_active[5])
     # truth advanced only for the subjects
@@ -126,6 +126,8 @@ def test_batch_publish_delta_and_hearers():
     cs = np.asarray(es.compute_checksums(state2, params))
     assert cs[0] == np.uint32((int(state2.base_sum) + int(want)) & 0xFFFFFFFF)
     assert cs[3] == np.uint32(state2.base_sum)
+    # the incrementally-returned checksums agree with the recompute
+    assert (np.asarray(csum2) == cs).all()
 
 
 def test_mass_churn_does_not_overflow_table():
@@ -360,3 +362,45 @@ def test_checksum_matmul_limbs_match_numpy_reference():
                 total += np.uint64(delta[r])
         want[i] = np.uint32(total & np.uint64(0xFFFFFFFF))
     assert (got == want).all(), np.flatnonzero(got != want)[:5]
+
+
+def test_incremental_checksum_matches_recompute_through_churn():
+    """state.checksum (incrementally maintained in-tick) must equal the
+    full O(N*U) recompute bit-for-bit on EVERY tick of a churny run:
+    kill wave, suspicion expiry, revive, refutes, packet loss, and a
+    partition that forces the rare retirement-adjustment path (a revived
+    node isolated so it cannot re-hear an old rumor before the rumor
+    ages into base_sum — its checksum must still gain the fold's delta)."""
+    n = 64
+    # u >= slots_per_tick * (max_age + 2): digits(64)=2 -> 15*2+8=38 -> 120
+    params = es.ScalableParams(n=n, u=160, packet_loss=0.05)
+    state = es.init_state(params, seed=3)
+    step = jax.jit(functools.partial(es.tick, params=params))
+    victims = np.zeros(n, bool)
+    victims[[3, 9, 17]] = True
+    part_iso = np.zeros(n, np.int32) - 1
+    part_iso[[3, 9, 17]] = 1  # isolate the revived nodes
+    part_heal = np.zeros(n, np.int32)  # everyone back to group 0
+    for t in range(110):
+        kill = jnp.asarray(victims if t == 4 else np.zeros(n, bool))
+        revive = jnp.asarray(victims if t == 12 else np.zeros(n, bool))
+        if t == 12:
+            inputs = es.ChurnInputs(
+                kill=kill, revive=revive, partition=jnp.asarray(part_iso)
+            )
+        elif t == 95:
+            inputs = es.ChurnInputs(
+                kill=kill, revive=revive, partition=jnp.asarray(part_heal)
+            )
+        else:
+            inputs = es.ChurnInputs(kill=kill, revive=revive)
+        state, m = step(state, inputs)
+        want = np.asarray(es.compute_checksums(state, params))
+        got = np.asarray(state.checksum)
+        assert (got == want).all(), (
+            "tick %d: %d rows diverge" % (t, int((got != want).sum()))
+        )
+        # the gated distinct-count metric agrees with a host recount
+        live = np.asarray(state.proc_alive)
+        assert int(m.distinct_checksums) == np.unique(got[live]).size
+    assert int(m.distinct_checksums) == 1  # healed and reconverged
