@@ -1,0 +1,84 @@
+"""BatchedSimClusters — B independent full-fidelity clusters, ONE program.
+
+At tick-cluster scale (n ~ 1k) the full [N, N] engine's ops are a few MB
+each and a single cluster leaves the chip >90% idle — the tick is op-
+overhead-bound, not bandwidth-bound (RESULTS.md, PROF_R4.json).  Batching
+B clusters on a leading axis via ``jax.vmap`` turns every [N, N] op into a
+[B, N, N] op at the same op count, so aggregate throughput scales toward
+the hardware roofline while each cluster's trajectory remains EXACTLY the
+single-cluster trajectory for its seed (vmap is semantics-preserving;
+asserted in tests/models/test_batched.py).
+
+This is the analog of running B tick-cluster harnesses side by side
+(/root/reference/scripts/tick-cluster.js spawns one OS process per node;
+B clusters means B*N processes for the reference — the batched simulator
+runs them all in one compiled scan).
+
+The engine's rare-phase conds are disabled (``gate_phases=False``): under
+vmap a ``lax.cond`` lowers to a run-both ``select`` anyway, and the
+straight-line program fuses better.  Trajectories are unaffected (the
+two settings are bitwise-identical; tests/models/test_sim.py).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ringpop_tpu.models.sim import engine
+from ringpop_tpu.models.sim.cluster import EventSchedule, default_addresses
+from ringpop_tpu.ops import checksum_encode as ce
+
+
+class BatchedSimClusters:
+    def __init__(
+        self,
+        b: int,
+        n: int,
+        params: Optional[engine.SimParams] = None,
+        seed: int = 0,
+    ):
+        self.b, self.n = b, n
+        addresses = default_addresses(n)
+        self.universe = ce.Universe.from_addresses(addresses)
+        base = params or engine.SimParams(n=n, checksum_mode="fast")
+        self.params = base._replace(n=n, gate_phases=False)
+        states: List[engine.SimState] = [
+            engine.init_state(self.params, seed=seed + i, universe=self.universe)
+            for i in range(b)
+        ]
+        # [B, ...] leading axis on every state field
+        self.state = jax.tree.map(lambda *xs: jnp.stack(xs), *states)
+
+        step = functools.partial(
+            engine.tick, params=self.params, universe=self.universe
+        )
+        vstep = jax.vmap(step, in_axes=(0, None))
+
+        @jax.jit
+        def _scanned(state, inputs):
+            return jax.lax.scan(vstep, state, inputs)
+
+        self._scanned = _scanned
+        self._vtick = jax.jit(vstep)
+
+    def bootstrap(self) -> engine.TickMetrics:
+        inputs = engine.TickInputs.quiet(self.n)._replace(
+            join=jnp.ones(self.n, bool)
+        )
+        self.state, m = self._vtick(self.state, inputs)
+        return jax.tree.map(np.asarray, m)
+
+    def run(self, schedule: EventSchedule) -> engine.TickMetrics:
+        """Scan the same [T, N] event schedule through every cluster;
+        metrics come back [T, B]-shaped."""
+        self.state, ms = self._scanned(self.state, schedule.as_inputs())
+        return jax.tree.map(np.asarray, ms)
+
+    def checksums(self) -> np.ndarray:
+        """[B, N] per-cluster membership checksums."""
+        return np.asarray(self.state.checksum)

@@ -108,6 +108,17 @@ class SimParams(NamedTuple):
     # the throughput mode; the serial 20-byte FarmHash block walk over a
     # ~40KB string per node per tick is the single hottest op otherwise.
     checksum_mode: str = "farmhash"
+    # True: rare phases (revive, rejoin, join, reshuffle, piggyback,
+    # apply, responses, ping-req, expiry) run under lax.cond and cost
+    # nothing on ticks with nothing to do — the right call on CPU, where
+    # skipped work is pure savings.  False: the same phases run
+    # unconditionally as straight-line code.  Every gated branch is a
+    # masked no-op on empty inputs (that WAS the engine before the
+    # round-3 cond refactor, and the draws inside are salt-pure), so the
+    # two settings are bitwise-identical in trajectory; on TPU the cond
+    # boundaries block fusion and serialize the program, and vmapped
+    # multi-cluster batching turns conds into run-both selects anyway.
+    gate_phases: bool = True
 
 
 class SimState(NamedTuple):
@@ -484,6 +495,16 @@ def _apply_updates(
     return new_state, gate, start_t, stop_t
 
 
+def _phase(gate: bool, pred, true_fn, false_fn, *ops):
+    """``lax.cond`` when ``gate`` (the CPU-friendly skip) else the true
+    branch unconditionally (the TPU-friendly straight line).  Safe only
+    because every gated phase is a masked no-op on empty inputs and its
+    random draws are salt-pure — see SimParams.gate_phases."""
+    if gate:
+        return jax.lax.cond(pred, true_fn, false_fn, *ops)
+    return true_fn(*ops)
+
+
 def tick(
     state: SimState,
     inputs: TickInputs,
@@ -491,6 +512,7 @@ def tick(
     universe: ce.Universe,
 ) -> tuple[SimState, TickMetrics]:
     n = params.n
+    gate = params.gate_phases  # static: picks cond vs straight-line phases
     # this tick's incarnation stamp: epoch_ms + tick_next*period_ms
     now = state.tick_index + 2
     node = jnp.arange(n, dtype=jnp.int32)[:, None]
@@ -527,7 +549,7 @@ def tick(
             susp_deadline=jnp.where(rv[:, None], -1, state.susp_deadline),
         )
 
-    state = jax.lax.cond(jnp.any(rv), _revive_reset, lambda s: s, state)
+    state = _phase(gate, jnp.any(rv), _revive_reset, lambda s: s, state)
 
     # ---- phase 0.5: graceful leave ------------------------------------
     # the node marks itself leave at its CURRENT incarnation (makeLeave,
@@ -582,7 +604,7 @@ def tick(
             ch_pb=jnp.where(rj_mask, 0, state.ch_pb),
         )
 
-    state = jax.lax.cond(jnp.any(rejoin), _rejoin_write, lambda s: s, state)
+    state = _phase(gate, jnp.any(rejoin), _rejoin_write, lambda s: s, state)
 
     # ---- phase 1: join/bootstrap --------------------------------------
     # Joiners (join input, or revived nodes) contact join_size ready nodes,
@@ -681,7 +703,8 @@ def tick(
         )
         return state, joined, ja_applied
 
-    state, joined, ja_applied = jax.lax.cond(
+    state, joined, ja_applied = _phase(
+        gate,
         jnp.any(joiner),
         _join_phase,
         lambda s: (s, jnp.zeros(n, bool), jnp.zeros((n, n), bool)),
@@ -774,8 +797,9 @@ def tick(
         ) % n
         return jnp.where(resh[:, None], idx, state.perm_inv)
 
-    perm_inv = jax.lax.cond(
-        jnp.any(resh), _reshuffled, lambda _: state.perm_inv, operand=None
+    perm_inv = _phase(
+        gate,
+        jnp.any(resh), _reshuffled, lambda _: state.perm_inv, None
     )
     state = state._replace(perm_inv=perm_inv, iter_pos=iter_pos)
 
@@ -806,7 +830,8 @@ def tick(
         )
         return state, sendable
 
-    state, sendable = jax.lax.cond(
+    state, sendable = _phase(
+        gate,
         jnp.any(state.ch_active),
         _sender_piggyback,
         lambda s: (s, jnp.zeros((n, n), bool)),
@@ -859,7 +884,8 @@ def tick(
         )
         return state, applied_ping
 
-    state, applied_ping = jax.lax.cond(
+    state, applied_ping = _phase(
+        gate,
         jnp.any(msg_content),
         _receive_phase,
         lambda s: (s, jnp.zeros((n, n), bool)),
@@ -898,7 +924,8 @@ def tick(
         )
         return state, respondable
 
-    state, respondable = jax.lax.cond(
+    state, respondable = _phase(
+        gate,
         jnp.any(state.ch_active),
         _receiver_bump,
         lambda s: (s, jnp.zeros((n, n), bool)),
@@ -957,7 +984,8 @@ def tick(
         )
         return state, applied_resp, full_sync
 
-    state, applied_resp, full_sync = jax.lax.cond(
+    state, applied_resp, full_sync = _phase(
+        gate,
         jnp.any(resp_possible),
         _response_phase,
         lambda s: (s, jnp.zeros((n, n), bool), jnp.zeros(n, bool)),
@@ -1016,7 +1044,8 @@ def tick(
         )
         return state, applied_sus, ping_req_count
 
-    state, applied_sus, ping_req_count = jax.lax.cond(
+    state, applied_sus, ping_req_count = _phase(
+        gate,
         jnp.any(need_pr),
         _ping_req_phase,
         lambda s: (s, jnp.zeros((n, n), bool), jnp.int32(0)),
@@ -1056,7 +1085,8 @@ def tick(
         )
         return state, applied_faulty
 
-    state, applied_faulty = jax.lax.cond(
+    state, applied_faulty = _phase(
+        gate,
         any_deadline,
         _expiry_phase,
         lambda s: (s, jnp.zeros((n, n), bool)),

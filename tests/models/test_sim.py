@@ -136,3 +136,37 @@ def test_revive_rejoins():
     for i in range(4):
         statuses = {m["address"]: m["status"] for m in c.membership_of(i)}
         assert statuses[victim] == "alive", (i, statuses)
+
+
+def test_gate_phases_off_is_bitwise_identical():
+    """gate_phases=False (straight-line phases, the TPU/vmap setting) must
+    reproduce the gated engine's trajectory bit-for-bit: every gated
+    branch is a masked no-op on empty inputs and its draws are salt-pure
+    (SimParams.gate_phases)."""
+    import numpy as np
+
+    n = 48
+    results = {}
+    for gate in (True, False):
+        p = engine.SimParams(
+            n=n,
+            checksum_mode="farmhash",
+            gate_phases=gate,
+            packet_loss=0.05,
+            suspicion_ticks=6,
+        )
+        sim = SimCluster(n=n, params=p, seed=2)
+        sim.bootstrap()
+        sched = EventSchedule(ticks=40, n=n)
+        sched.kill[7, 3] = True
+        sched.revive[24, 3] = True
+        m = sim.run(sched)
+        results[gate] = (sim.state, m)
+    st_t, m_t = results[True]
+    st_f, m_f = results[False]
+    for f in st_t._fields:
+        a, b = np.asarray(getattr(st_t, f)), np.asarray(getattr(st_f, f))
+        assert (a == b).all(), "state field %s diverges" % f
+    for f in m_t._fields:
+        a, b = np.asarray(getattr(m_t, f)), np.asarray(getattr(m_f, f))
+        assert (a == b).all(), "metric %s diverges" % f
