@@ -181,7 +181,20 @@ def _measure(n: int, ticks: int) -> dict:
     if platform == "tpu" and os.environ.get("BENCH_BATCHED", "1") != "0":
         b = int(os.environ.get("BENCH_BATCH_B", "8"))
         try:
-            agg, agg_el, agg_conv = _batched_rate(b, n, ticks)
+            agg = None
+            exc = None
+            for backoff in (0.0, 10.0, 25.0):  # helper-500 backoff, like
+                if backoff:  # every other measured config
+                    time.sleep(backoff)
+                try:
+                    agg, agg_el, agg_conv = _batched_rate(b, n, ticks)
+                    break
+                except Exception as e:
+                    exc = e
+                    if _is_transient(e) or not _is_compile_helper_500(e):
+                        raise
+            if agg is None:
+                raise exc
             result["batched_clusters"] = b
             result["batched_aggregate_node_ticks_per_sec"] = round(agg, 1)
             result["batched_per_cluster_node_ticks_per_sec"] = round(

@@ -165,29 +165,34 @@ def phase_epidemic_100k(results: dict) -> None:
     from ringpop_tpu.models.sim import engine_scalable as es
 
     n, ticks = 100_000, 60
-    params = es.ScalableParams(n=n, u=512, packet_loss=0.05)
-    state = es.init_state(params, seed=0)
-    step = jax.jit(functools.partial(es.tick, params=params))
-    state, m = step(state, es.ChurnInputs.quiet(n))  # compile
-    jax.block_until_ready(state)
-    t0 = time.perf_counter()
-    susp = refutes = 0
-    for _ in range(ticks):
-        state, m = step(state, es.ChurnInputs.quiet(n))
-        susp += int(m.suspects_published)
-        refutes += int(m.refutes_published)
-    jax.block_until_ready(state)
-    dt = time.perf_counter() - t0
-    results["epidemic_100k_5pct_loss"] = {
-        "node_ticks_per_sec": round(n * ticks / dt, 1),
-        "ms_per_tick": round(dt / ticks * 1e3, 2),
-        "elapsed_s": round(dt, 2),
-        "false_suspects": susp,
-        "refutes": refutes,
-        "permanent_faulty": int(
-            (np.asarray(state.truth_status) == es.FAULTY).sum()
-        ),
-    }
+    for gate in (True, False):
+        params = es.ScalableParams(
+            n=n, u=512, packet_loss=0.05, gate_phases=gate
+        )
+        state = es.init_state(params, seed=0)
+        step = jax.jit(functools.partial(es.tick, params=params))
+        state, m = step(state, es.ChurnInputs.quiet(n))  # compile
+        jax.block_until_ready(state)
+        t0 = time.perf_counter()
+        susp = refutes = 0
+        for _ in range(ticks):
+            state, m = step(state, es.ChurnInputs.quiet(n))
+            susp += int(m.suspects_published)
+            refutes += int(m.refutes_published)
+        jax.block_until_ready(state)
+        dt = time.perf_counter() - t0
+        key = "epidemic_100k_5pct_loss" + ("" if gate else "_nogate")
+        results[key] = {
+            "node_ticks_per_sec": round(n * ticks / dt, 1),
+            "ms_per_tick": round(dt / ticks * 1e3, 2),
+            "elapsed_s": round(dt, 2),
+            "false_suspects": susp,
+            "refutes": refutes,
+            "permanent_faulty": int(
+                (np.asarray(state.truth_status) == es.FAULTY).sum()
+            ),
+        }
+        print(json.dumps({key: results[key]}), flush=True)
 
 
 def phase_storm_1m(results: dict) -> None:
@@ -198,42 +203,66 @@ def phase_storm_1m(results: dict) -> None:
     from ringpop_tpu.models.sim.storm import ScalableCluster, StormSchedule
 
     n, ticks = 1_000_000, 60
+    sched = StormSchedule.churn_storm(
+        ticks, n, fraction=0.10, fail_tick=2, seed=0
+    )
     for in_tick in (True, False):
-        key = "storm_1m" + ("" if in_tick else "_deferred_checksums")
-        try:
-            params = es.ScalableParams(n=n, u=512, checksum_in_tick=in_tick)
-            sched = StormSchedule.churn_storm(
-                ticks, n, fraction=0.10, fail_tick=2, seed=0
+        for gate in (True, False):
+            key = (
+                "storm_1m"
+                + ("" if in_tick else "_deferred_checksums")
+                + ("" if gate else "_nogate")
             )
-            cluster = ScalableCluster(n=n, params=params, seed=0)
-            t0 = time.perf_counter()
-            cluster.run(sched)
-            jax.block_until_ready(cluster.state)
-            cold = time.perf_counter() - t0
+            try:
+                params = es.ScalableParams(
+                    n=n, u=512, checksum_in_tick=in_tick, gate_phases=gate
+                )
+                cluster = ScalableCluster(n=n, params=params, seed=0)
+                t0 = time.perf_counter()
+                cluster.run(sched)
+                jax.block_until_ready(cluster.state)
+                cold = time.perf_counter() - t0
+                if not in_tick:
+                    # precompile the standalone checksum recompute OUTSIDE
+                    # the timed window (in-tick mode reads state.checksum
+                    # and needs no extra program)
+                    jax.block_until_ready(
+                        es.compute_checksums(cluster.state, params)
+                    )
 
-            cluster2 = ScalableCluster(n=n, params=params, seed=0)
-            t0 = time.perf_counter()
-            metrics = cluster2.run(sched)
-            cs = es.compute_checksums(cluster2.state, params)
-            cs = jax.block_until_ready(cs)
-            warm = time.perf_counter() - t0
-            live = np.asarray(cluster2.state.proc_alive)
-            ncs = np.unique(np.asarray(cs)[live]).size
-            results[key] = {
-                "n": n,
-                "ticks": ticks,
-                "cold_s": round(cold, 2),
-                "warm_s": round(warm, 2),
-                "under_60s": bool(warm < 60.0),
-                "converged": bool(ncs == 1),
-                "distinct_checksums": int(ncs),
-                "full_coverage_final": bool(
-                    np.asarray(metrics.full_coverage)[-1]
-                ),
-            }
-        except Exception as e:
-            results[key] = {"error": str(e)[:300]}
-        print(json.dumps({key: results.get(key)}), flush=True)
+                # warm wall-clock: min of 2 full runs (tunnel background
+                # load swings single runs by tens of percent; the round-3
+                # artifact even recorded warm > cold)
+                warms = []
+                for _ in range(2):
+                    cluster2 = ScalableCluster(n=n, params=params, seed=0)
+                    t0 = time.perf_counter()
+                    metrics = cluster2.run(sched)
+                    if in_tick:
+                        cs = cluster2.state.checksum
+                    else:
+                        cs = es.compute_checksums(cluster2.state, params)
+                    cs = jax.block_until_ready(cs)
+                    warms.append(time.perf_counter() - t0)
+                warm = min(warms)
+                live = np.asarray(cluster2.state.proc_alive)
+                ncs = np.unique(np.asarray(cs)[live]).size
+                results[key] = {
+                    "n": n,
+                    "ticks": ticks,
+                    "cold_s": round(cold, 2),
+                    "warm_s": round(warm, 2),
+                    "warm_runs_s": [round(w, 2) for w in warms],
+                    "under_60s": bool(warm < 60.0),
+                    "converged": bool(ncs == 1),
+                    "distinct_checksums": int(ncs),
+                    "full_coverage_final": bool(
+                        np.asarray(metrics.full_coverage)[-1]
+                    ),
+                }
+            except Exception as e:
+                results[key] = {"error": str(e)[:300]}
+            print(json.dumps({key: results.get(key)}), flush=True)
 
 
 def main() -> int:

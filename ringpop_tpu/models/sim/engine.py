@@ -70,6 +70,7 @@ import numpy as np
 
 from ringpop_tpu.ops import checksum_encode as ce
 from ringpop_tpu.ops import jax_farmhash as jfh
+from ringpop_tpu.models.sim.gating import phase as _phase
 from ringpop_tpu.ops.record_mix import record_mix
 
 # status codes (== ce.STATUS_*): rank order IS override priority at equal
@@ -494,15 +495,6 @@ def _apply_updates(
     )
     return new_state, gate, start_t, stop_t
 
-
-def _phase(gate: bool, pred, true_fn, false_fn, *ops):
-    """``lax.cond`` when ``gate`` (the CPU-friendly skip) else the true
-    branch unconditionally (the TPU-friendly straight line).  Safe only
-    because every gated phase is a masked no-op on empty inputs and its
-    random draws are salt-pure — see SimParams.gate_phases."""
-    if gate:
-        return jax.lax.cond(pred, true_fn, false_fn, *ops)
-    return true_fn(*ops)
 
 
 def tick(

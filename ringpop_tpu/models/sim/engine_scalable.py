@@ -83,6 +83,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ringpop_tpu.models.sim.gating import phase as _phase
 from ringpop_tpu.ops.record_mix import record_mix
 
 ALIVE, SUSPECT, FAULTY, LEAVE = 0, 1, 2, 3
@@ -112,6 +113,14 @@ class ScalableParams(NamedTuple):
     # graceful-leave support allocates a 4th rumor slot per tick (raises
     # the minimum table capacity u by a third); off by default
     enable_leave: bool = False
+    # True: rare phases (indirect exchange rounds, checksum diff/retire
+    # reductions, publishes, distinct sort, coverage popcount) run under
+    # lax.cond and cost nothing when there is nothing to do — the win on
+    # quiet/converged ticks.  False: straight-line execution — during a
+    # storm every phase fires anyway and TPU conds carry a scalar-core
+    # sync cost per boundary.  Bitwise-identical trajectories either way
+    # (each gated branch is a masked no-op on empty inputs).
+    gate_phases: bool = True
 
 
 class ScalableState(NamedTuple):
@@ -313,10 +322,18 @@ def _publish_batch(
     )
     any_ev = jnp.any(subj_mask)
     hears = hearer_mask & any_ev
+    # empty batch: leave the (inactive) slot's delta/birth untouched so a
+    # straight-line publish is bit-identical to a cond-skipped one — the
+    # fields are dead while r_active is False, but the gate-equivalence
+    # tests compare raw state
     return state._replace(
         r_active=state.r_active.at[slot].set(any_ev),
-        r_delta=state.r_delta.at[slot].set(delta),
-        r_birth=state.r_birth.at[slot].set(tick),
+        r_delta=state.r_delta.at[slot].set(
+            jnp.where(any_ev, delta, state.r_delta[slot])
+        ),
+        r_birth=state.r_birth.at[slot].set(
+            jnp.where(any_ev, tick, state.r_birth[slot])
+        ),
         truth_status=jnp.where(subj_mask, new_status, state.truth_status),
         truth_inc=jnp.where(subj_mask, new_inc, state.truth_inc),
         heard=jnp.where(
@@ -330,6 +347,7 @@ def _publish_batch(
     ), jnp.where(hears, csum + delta, csum)
 
 
+
 def _publish_batch_gated(
     state: ScalableState,
     csum: jax.Array,
@@ -339,13 +357,15 @@ def _publish_batch_gated(
     new_inc: jax.Array,
     hearer_mask: jax.Array,
     tick: jax.Array,
+    gate: bool = True,
 ) -> tuple[ScalableState, jax.Array]:
     """Skip the whole publish when the subject set is empty (the common
     case for every batch on a healthy converged tick): with no subjects
     the publish writes r_active[slot]=False to an already-False slot,
     delta 0, no truth advance, and no heard bits — a pure no-op, but the
     two [N] record_mix chains it computes are measurably hot at 1M."""
-    return jax.lax.cond(
+    return _phase(
+        gate,
         jnp.any(subj_mask),
         lambda st, c: _publish_batch(
             st, c, slot, subj_mask, new_status, new_inc, hearer_mask, tick
@@ -429,6 +449,7 @@ def tick(
     state: ScalableState, inputs: ChurnInputs, params: ScalableParams
 ) -> tuple[ScalableState, ScalableMetrics]:
     n, u = params.n, params.u
+    gate = params.gate_phases  # static: cond-gated vs straight-line phases
     t = state.tick_index + 1
     now = t + 1  # int32 stamp == epoch + t*200 ms
     rng = state.rng
@@ -515,8 +536,8 @@ def tick(
             u,
         )
 
-    csum = jax.lax.cond(
-        jnp.any(missing != 0), _retire_adjust, lambda c: c, csum
+    csum = _phase(
+        gate, jnp.any(missing != 0), _retire_adjust, lambda c: c, csum
     )
     # recycled slots' stale heard bits must vanish before reuse
     clear_words = _pack_mask(recycled)
@@ -617,7 +638,8 @@ def tick(
             any_reached |= reached
         return nh, any_responder, any_reached
 
-    new_heard, any_responder, any_reached = jax.lax.cond(
+    new_heard, any_responder, any_reached = _phase(
+        gate,
         jnp.any(need_ind),
         _indirect,
         lambda nh: (nh, jnp.zeros(n, bool), jnp.zeros(n, bool)),
@@ -633,9 +655,7 @@ def tick(
     def _diff_add(c):
         return c + _bit_delta_sum(diff, state.r_delta, u)
 
-    csum = jax.lax.cond(
-        jnp.any(diff != 0), _diff_add, lambda c: c, csum
-    )
+    csum = _phase(gate, jnp.any(diff != 0), _diff_add, lambda c: c, csum)
     state = state._replace(heard=new_heard)
 
     # ---- failure detection: suspect batch ------------------------------
@@ -683,6 +703,7 @@ def tick(
         state.truth_inc,  # suspect keeps the member's incarnation
         detector,
         t,
+        gate=gate,
     )
     state = state._replace(
         defame_slot=jnp.where(suspect_subjects, slots[0], state.defame_slot)
@@ -713,6 +734,7 @@ def tick(
         state.truth_inc,  # faulty with current incarnation (suspicion.js:67-70)
         expirer,
         t,
+        gate=gate,
     )
     state = state._replace(
         defame_slot=jnp.where(faulty_subjects, slots[1], state.defame_slot)
@@ -743,6 +765,7 @@ def tick(
         jnp.full(n, now, jnp.int32),  # fresh incarnation (member.js:78-81)
         alive_subjects,
         t,
+        gate=gate,
     )
     state = state._replace(
         defame_slot=jnp.where(alive_subjects, -1, state.defame_slot)
@@ -774,6 +797,7 @@ def tick(
             state.truth_inc,
             leaver,
             t,
+            gate=gate,
         )
         # the reference stops gossip AND suspicion wholesale on leave
         # (on_membership_event.js:32-41 suspicion.stopAll) — a departed
@@ -829,8 +853,11 @@ def tick(
         )
         return jnp.mean(jnp.where(proc_alive, frac, 1.0))
 
-    mean_frac = jax.lax.cond(
-        full_cov, lambda _: jnp.float32(1.0), _mean_frac, operand=None
+    # _phase runs the TRUE branch when ungated, so the general popcount
+    # path is the true branch (under full coverage it returns exactly
+    # 1.0, so both settings agree bitwise)
+    mean_frac = _phase(
+        gate, ~full_cov, _mean_frac, lambda _: jnp.float32(1.0), None
     )
 
     # distinct view count: the O(N log N) sort only runs when live
@@ -850,15 +877,17 @@ def tick(
             + (s[0] != jnp.uint32(0xFFFFFFFF)).astype(jnp.int32)
         ).astype(jnp.int32)
 
-    distinct = jax.lax.cond(
-        (lo == hi) | ~any_live,
-        # all live fingerprints equal: 1 distinct view (0 when none are
-        # live, or when the shared value collides with the dead-node
-        # sentinel — matching the sort path, which never counts it)
+    # general sort path is the true branch (ungated runs it always); the
+    # false branch covers all-live-equal: 1 distinct view (0 when none
+    # are live, or when the shared value collides with the dead-node
+    # sentinel — matching the sort path, which never counts it)
+    distinct = _phase(
+        gate,
+        (lo != hi) & any_live,
+        _distinct_sorted,
         lambda c: (
             any_live & (hi != jnp.uint32(0xFFFFFFFF))
         ).astype(jnp.int32),
-        _distinct_sorted,
         cs,
     )
 

@@ -1,0 +1,24 @@
+"""Shared cond-vs-straight-line phase dispatch for both sim engines.
+
+Both engines gate their rare phases behind ``lax.cond`` so quiet ticks
+skip the work (the CPU win), and both expose a ``gate_phases`` param to
+run the same phases as straight-line code instead (the TPU/vmap win:
+cond boundaries block fusion and carry a scalar-core sync cost, and
+under ``jax.vmap`` a cond lowers to a run-both select anyway).  One
+helper, one contract: the TRUE branch must be the general computation —
+a masked no-op on empty inputs with salt-pure draws — so that running
+it unconditionally is bitwise-identical to the gated program (pinned by
+the gate-equivalence tests in tests/models/).
+"""
+
+from __future__ import annotations
+
+import jax
+
+
+def phase(gate: bool, pred, true_fn, false_fn, *ops):
+    """``lax.cond(pred, true_fn, false_fn, *ops)`` when ``gate`` is True,
+    else ``true_fn(*ops)`` unconditionally."""
+    if gate:
+        return jax.lax.cond(pred, true_fn, false_fn, *ops)
+    return true_fn(*ops)

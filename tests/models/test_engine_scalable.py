@@ -404,3 +404,37 @@ def test_incremental_checksum_matches_recompute_through_churn():
         live = np.asarray(state.proc_alive)
         assert int(m.distinct_checksums) == np.unique(got[live]).size
     assert int(m.distinct_checksums) == 1  # healed and reconverged
+
+
+def test_gate_phases_off_is_bitwise_identical_scalable():
+    """ScalableParams.gate_phases=False (straight-line phases — the
+    storm-on-TPU setting) must reproduce the gated engine's trajectory
+    and metrics bit-for-bit."""
+    n = 96
+    outs = {}
+    for gate in (True, False):
+        params = es.ScalableParams(
+            n=n, u=192, packet_loss=0.05, gate_phases=gate
+        )
+        st = es.init_state(params, seed=1)
+        step = jax.jit(functools.partial(es.tick, params=params))
+        rng = np.random.default_rng(0)
+        mets = []
+        for t in range(40):
+            kill = jnp.asarray(rng.random(n) < (0.05 if t == 4 else 0.0))
+            revive = jnp.asarray(
+                np.zeros(n, bool)
+                if t != 25
+                else ~np.asarray(st.proc_alive)
+            )
+            st, m = step(st, es.ChurnInputs(kill=kill, revive=revive))
+            mets.append(m)
+        outs[gate] = (st, mets)
+    st_t, st_f = outs[True][0], outs[False][0]
+    for f in st_t._fields:
+        a, b = np.asarray(getattr(st_t, f)), np.asarray(getattr(st_f, f))
+        assert (a == b).all(), "state field %s diverges" % f
+    for mt, mf in zip(outs[True][1], outs[False][1]):
+        for f in mt._fields:
+            a, b = np.asarray(getattr(mt, f)), np.asarray(getattr(mf, f))
+            assert (a == b).all(), "metric %s diverges" % f
