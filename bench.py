@@ -114,24 +114,33 @@ def _batched_rate(b: int, n: int, ticks: int) -> tuple:
     )
 
 
-def _mode_rate_retry(
-    n: int, ticks: int, mode: str, gate: bool = True
-) -> tuple:
-    """_mode_rate with in-process backoff for compile-helper 500s (the
+def _retry_helper_500(fn, *args, **kwargs):
+    """Call ``fn`` with in-process backoff for compile-helper 500s (the
     tunnel's remote-compile helper fails intermittently on graphs that
     compile fine seconds later).  Transient backend errors re-raise
-    immediately — main()'s retry loop owns those."""
+    immediately — main()'s retry loop owns those; any other error is a
+    real graph/engine failure and re-raises too.  ONE retry policy for
+    every measured config (fast, straight-line, batched, parity)."""
     exc = None
-    for backoff in (0.0, 10.0, 25.0):
+    for backoff in _HELPER_BACKOFFS:
         if backoff:
             time.sleep(backoff)
         try:
-            return _mode_rate(n, ticks, mode, gate=gate)
+            return fn(*args, **kwargs)
         except Exception as e:
             exc = e
             if _is_transient(exc) or not _is_compile_helper_500(exc):
                 raise
     raise exc
+
+
+_HELPER_BACKOFFS = (0.0, 10.0, 25.0)
+
+
+def _mode_rate_retry(
+    n: int, ticks: int, mode: str, gate: bool = True
+) -> tuple:
+    return _retry_helper_500(_mode_rate, n, ticks, mode, gate=gate)
 
 
 def _measure(n: int, ticks: int) -> dict:
@@ -181,20 +190,9 @@ def _measure(n: int, ticks: int) -> dict:
     if platform == "tpu" and os.environ.get("BENCH_BATCHED", "1") != "0":
         b = int(os.environ.get("BENCH_BATCH_B", "8"))
         try:
-            agg = None
-            exc = None
-            for backoff in (0.0, 10.0, 25.0):  # helper-500 backoff, like
-                if backoff:  # every other measured config
-                    time.sleep(backoff)
-                try:
-                    agg, agg_el, agg_conv = _batched_rate(b, n, ticks)
-                    break
-                except Exception as e:
-                    exc = e
-                    if _is_transient(e) or not _is_compile_helper_500(e):
-                        raise
-            if agg is None:
-                raise exc
+            agg, agg_el, agg_conv = _retry_helper_500(
+                _batched_rate, b, n, ticks
+            )
             result["batched_clusters"] = b
             result["batched_aggregate_node_ticks_per_sec"] = round(agg, 1)
             result["batched_per_cluster_node_ticks_per_sec"] = round(
@@ -213,25 +211,20 @@ def _measure(n: int, ticks: int) -> dict:
     # allowed to sink the whole artifact: the tunneled chip's remote
     # compile helper occasionally 500s on large graphs, and a fast-mode
     # number with a parity_error beats an error-only artifact.
-    tries = 0
-    exc = None
-    for backoff in (0.0, 10.0, 25.0):  # in-process tries with backoff
-        if backoff:
-            time.sleep(backoff)
-        tries += 1
-        try:
-            parity_rate, _, _ = _mode_rate(n, ticks, "farmhash", gate=gate)
-            result["parity_mode_node_ticks_per_sec"] = round(parity_rate, 1)
-            result["parity_mode_vs_baseline"] = round(
-                parity_rate / baseline, 2
-            )
-            return result
-        except Exception as e:
-            exc = e
-            if _is_transient(exc):
-                raise  # retryable backend failures keep the retry semantics
-            if not _is_compile_helper_500(exc):
-                break  # real graph/engine error: no point retrying
+    try:
+        parity_rate, _, _ = _retry_helper_500(
+            _mode_rate, n, ticks, "farmhash", gate=gate
+        )
+        result["parity_mode_node_ticks_per_sec"] = round(parity_rate, 1)
+        result["parity_mode_vs_baseline"] = round(parity_rate / baseline, 2)
+        return result
+    except Exception as e:
+        exc = e
+        if _is_transient(exc):
+            raise  # retryable backend failures keep the retry semantics
+        tries = (
+            len(_HELPER_BACKOFFS) if _is_compile_helper_500(exc) else 1
+        )
     # in-process budget exhausted on a compile-helper 500: a FRESH
     # interpreter re-submits the compile through a clean tunnel session
     # (the fast-mode number is re-measured there — itself protected by

@@ -30,6 +30,18 @@ _TRAJECTORY_NEUTRAL_PARAMS = frozenset(
 # int64 epoch-ms values — a v1 checkpoint's ms incarnations would be
 # silently misread as stamps, so loads reject version mismatches
 _FORMAT_VERSION = 2
+# fields added after checkpoints of the same format version shipped:
+# loadable with a derived default (sibling field supplies shape/dtype).
+# defame_by (scalable engine, round 4): defaulting to the node's own id
+# makes the refute reachability gate (partition[defame_by] == partition)
+# vacuously true, i.e. a pre-round-4 checkpoint's defamed nodes refute
+# on the old, laxer rule — inside the envelope the new field narrows.
+_FIELD_DEFAULTS = {
+    "defame_by": (
+        "defame_slot",
+        lambda arr: np.arange(arr.shape[0], dtype=arr.dtype),
+    ),
+}
 
 
 def save_state(path: str, state: Any, params: Any = None) -> None:
@@ -97,7 +109,11 @@ def load_state(path: str, state_cls: Type[T], params: Any = None) -> T:
                     "checkpoint params differ from the resuming engine's "
                     "(saved, current): %r" % diff
                 )
-        missing = [f for f in state_cls._fields if f not in data.files]
+        missing = [
+            f
+            for f in state_cls._fields
+            if f not in data.files and f not in _FIELD_DEFAULTS
+        ]
         extra = [
             f
             for f in data.files
@@ -110,6 +126,10 @@ def load_state(path: str, state_cls: Type[T], params: Any = None) -> T:
             )
         out = {}
         for f in state_cls._fields:
+            if f not in data.files:
+                sibling, default_of = _FIELD_DEFAULTS[f]
+                out[f] = jnp.asarray(default_of(np.asarray(data[sibling])))
+                continue
             arr = jnp.asarray(data[f])
             if arr.dtype != data[f].dtype:
                 # e.g. int64 incarnations truncated to int32 because JAX

@@ -65,14 +65,20 @@ server/protocol/join.js:131).
 
 Partition groups gate every exchange (gossip, ping-req probes), so a split
 produces cross-side false suspects and checksum divergence between the
-sides, and healing reconverges to a single all-alive view.  Deviation
-envelope: ``truth_*`` is a single global chain, so a suspected node's
-refute cancels the suspecting side's clocks immediately (the reference
-would let the cut-off side escalate to faulty and merge the views after
-heal).  Exact split-brain bookkeeping — per-observer views with faulty
-marks retained across the split — is the full-fidelity ``[N, N]`` engine's
-domain (:mod:`ringpop_tpu.models.sim.engine`, parity-tested against the
-host oracle including partitions in tests/parity/).
+sides, and healing reconverges to a single all-alive view.  Cross-side
+escalation is faithful: a subject refutes a defamation only while it can
+currently reach its representative defamer (``defame_by``), so a
+partitioned-away side's suspicions run their clocks and publish FAULTY
+batches during the split — as the reference does ("Ringpop retains
+members that are 'down'", docs/architecture_design.md; suspicion.js:67-70)
+— and the defamed-but-live subjects clean themselves up with refutes
+after the heal.  Deviation envelope: ``truth_*`` is a single global
+chain, so the two sides' marks land in one merged truth rather than
+per-observer views (both sides' views of the OTHER side go faulty, but a
+third partition would see the union); exact per-observer split-brain
+bookkeeping is the full-fidelity ``[N, N]`` engine's domain
+(:mod:`ringpop_tpu.models.sim.engine`, parity-tested against the host
+oracle including partitions in tests/parity/).
 """
 
 from __future__ import annotations
@@ -147,10 +153,17 @@ class ScalableState(NamedTuple):
     # per-node failure-detection state (single in-flight suspicion per node)
     susp_subject: jax.Array  # [N] int32 — -1 or the suspected node
     susp_since: jax.Array  # [N] int32
-    # slot of the most recent rumor defaming this node (-1 none): the hook
-    # a live node uses to notice it has been called suspect/faulty and
+    # slot of the most recent rumor defaming this node (-1 none, -2 the
+    # defaming rumor's slot was recycled while still defamed): the hook a
+    # live node uses to notice it has been called suspect/faulty and
     # refute (member.js:76-81)
     defame_slot: jax.Array  # [N] int32
+    # representative detector/accuser behind that defamation (-1 none),
+    # same-side preferred: a subject refutes only while it can currently
+    # TALK to this node — split-brain correctness (same-tick defamations
+    # from disconnected sides share one rumor slot, so the heard bit
+    # alone cannot tell which side's accusation a subject learned of)
+    defame_by: jax.Array  # [N] int32
     # commutative checksum base shared by all fully-caught-up nodes
     base_sum: jax.Array  # scalar uint32
     rng: jax.Array  # [2] uint32
@@ -289,6 +302,7 @@ def init_state(params: ScalableParams, seed: int = 0) -> ScalableState:
         susp_subject=jnp.full(n, -1, jnp.int32),
         susp_since=jnp.full(n, -1, jnp.int32),
         defame_slot=jnp.full(n, -1, jnp.int32),
+        defame_by=jnp.full(n, -1, jnp.int32),
         base_sum=jnp.sum(base, dtype=jnp.uint32),
         rng=jnp.asarray(rng.integers(1, 2**32 - 1, size=2, dtype=np.uint32)),
         # seeded to the no-rumors value: the in-tick checksum path
@@ -479,6 +493,7 @@ def tick(
         susp_subject=jnp.where(revived, -1, state.susp_subject),
         susp_since=jnp.where(revived, -1, state.susp_since),
         defame_slot=jnp.where(revived, -1, state.defame_slot),
+        defame_by=jnp.where(revived, -1, state.defame_by),
     )
     # incremental checksum: a revived node's heard set is empty, so its
     # checksum is exactly the current shared base (pre-fold; this tick's
@@ -504,14 +519,15 @@ def tick(
     recycled = jnp.zeros(u, bool).at[slots].set(True)
     retired = aged | (state.r_active & recycled)
     # a defame_slot pointer whose slot is recycled this tick would, after
-    # the slot's reuse, read an unrelated rumor's heard bit — clear it,
-    # treating the retired defamation as "aged into base" explicitly (the
-    # live defamed node already had >= 2 aware ticks to refute between
-    # aging and recycling, per the init_state capacity check)
+    # the slot's reuse, read an unrelated rumor's heard bit — demote it
+    # to the -2 "aged into base while still defamed" sentinel.  The
+    # subject stays refute-eligible (aware) but still gated on currently
+    # reaching its defamer: a cross-partition victim of an ultra-long
+    # split must keep the pointer so it can clean itself up after heal.
     ds0 = state.defame_slot
     state = state._replace(
         defame_slot=jnp.where(
-            (ds0 >= 0) & recycled[jnp.clip(ds0, 0, u - 1)], -1, ds0
+            (ds0 >= 0) & recycled[jnp.clip(ds0, 0, u - 1)], -2, ds0
         )
     )
     # fold retired deltas into the shared base (dissemination has long
@@ -694,6 +710,22 @@ def tick(
     subj_idx = jnp.where(detector, partner0, n)
     suspect_subjects = jnp.zeros(n, bool).at[subj_idx].set(True, mode="drop")
     n_susp = jnp.sum(suspect_subjects.astype(jnp.int32))
+    # representative defamer per subject, same-side detectors preferred:
+    # keys in [0, n) are same-side detector ids, [n, 2n) cross-side, so a
+    # scatter-min picks a same-side id whenever one exists.  The refute
+    # gate below requires the subject to be CONNECTED to this detector —
+    # a partitioned-away subject cannot legitimately learn it was defamed
+    # across the cut, even though same-tick defamations from both sides
+    # share one rumor slot (the slot carries no member list).
+    det_same = detector & (
+        partition == partition[jnp.clip(partner0, 0, n - 1)]
+    )
+    det_key = jnp.where(det_same, ids, ids + n)
+    rep_key = (
+        jnp.full(n, 2 * n, jnp.int32)
+        .at[subj_idx]
+        .min(det_key, mode="drop")
+    )
     state, csum = _publish_batch_gated(
         state,
         csum,
@@ -706,7 +738,8 @@ def tick(
         gate=gate,
     )
     state = state._replace(
-        defame_slot=jnp.where(suspect_subjects, slots[0], state.defame_slot)
+        defame_slot=jnp.where(suspect_subjects, slots[0], state.defame_slot),
+        defame_by=jnp.where(suspect_subjects, rep_key % n, state.defame_by),
     )
 
     # ---- suspicion expiry: faulty batch --------------------------------
@@ -721,6 +754,14 @@ def tick(
     fs_idx = jnp.where(expirer, state.susp_subject, n)
     faulty_subjects = jnp.zeros(n, bool).at[fs_idx].set(True, mode="drop")
     n_faulty = jnp.sum(faulty_subjects.astype(jnp.int32))
+    # representative accuser per faulty subject (same scheme as suspects)
+    exp_same = expirer & (partition == partition[esubj])
+    exp_key = jnp.where(exp_same, ids, ids + n)
+    frep_key = (
+        jnp.full(n, 2 * n, jnp.int32)
+        .at[fs_idx]
+        .min(exp_key, mode="drop")
+    )
     state = state._replace(
         susp_subject=jnp.where(expire, -1, state.susp_subject),
         susp_since=jnp.where(expire, -1, state.susp_since),
@@ -737,23 +778,38 @@ def tick(
         gate=gate,
     )
     state = state._replace(
-        defame_slot=jnp.where(faulty_subjects, slots[1], state.defame_slot)
+        defame_slot=jnp.where(faulty_subjects, slots[1], state.defame_slot),
+        defame_by=jnp.where(faulty_subjects, frep_key % n, state.defame_by),
     )
 
     # ---- refute + rejoin: alive batch ----------------------------------
     # refute (member.js:76-81): a live node that has HEARD the rumor
     # defaming it re-asserts alive with a fresh incarnation.  "Heard" =
-    # its bit for the defaming slot is set, or that rumor already aged
-    # into base_sum (then every live node counts it).
+    # its bit for the defaming slot is set, the rumor already aged into
+    # base_sum (then every live node counts it), or the slot was recycled
+    # while still defamed (the -2 sentinel).  ADDITIONALLY the subject
+    # must currently be able to TALK to its representative defamer
+    # (defame_by): same-tick defamations from disconnected partition
+    # sides share one rumor slot, so without this gate a partitioned-away
+    # subject would refute an accusation it could never have heard —
+    # split-brain faulty marks would cancel before escalating
+    # (the reference retains per-observer faulty marks through a split,
+    # docs/architecture_design.md, suspicion.js:67-70).
     ds = state.defame_slot
     ds_c = jnp.clip(ds, 0, u - 1)
     heard_bit = (
         state.heard[ids, ds_c // WORD]
         >> (ds_c % WORD).astype(jnp.uint32)
     ) & jnp.uint32(1)
-    aware = (ds >= 0) & (heard_bit.astype(bool) | ~state.r_active[ds_c])
+    aware = (ds == -2) | (
+        (ds >= 0) & (heard_bit.astype(bool) | ~state.r_active[ds_c])
+    )
+    db = state.defame_by
+    reachable = (db >= 0) & (
+        partition[jnp.clip(db, 0, n - 1)] == partition
+    )
     defamed = (state.truth_status == SUSPECT) | (state.truth_status == FAULTY)
-    refuter = proc_alive & ~revived & aware & defamed
+    refuter = proc_alive & ~revived & aware & reachable & defamed
     n_refute = jnp.sum(refuter.astype(jnp.int32))
     alive_subjects = revived | rejoined | refuter
     state, csum = _publish_batch_gated(
@@ -768,7 +824,8 @@ def tick(
         gate=gate,
     )
     state = state._replace(
-        defame_slot=jnp.where(alive_subjects, -1, state.defame_slot)
+        defame_slot=jnp.where(alive_subjects, -1, state.defame_slot),
+        defame_by=jnp.where(alive_subjects, -1, state.defame_by),
     )
 
     # ---- graceful leave: leave batch -----------------------------------

@@ -250,6 +250,7 @@ _SCALABLE_NODE_FIELDS = frozenset(
         "susp_subject",
         "susp_since",
         "defame_slot",
+        "defame_by",
         "checksum",
     }
 )

@@ -98,3 +98,32 @@ def test_checkpoint_rejects_wrong_class_and_fields(tmp_path):
         assert np.asarray(getattr(back, f)).dtype == np.asarray(
             getattr(state, f)
         ).dtype
+
+
+def test_pre_round4_checkpoint_missing_defame_by_loads(tmp_path):
+    """A checkpoint written before defame_by existed must still load: the
+    field defaults to the node's own id, which makes the refute
+    reachability gate vacuously true (the old, laxer rule)."""
+    import numpy as np
+
+    from ringpop_tpu.models.sim import engine_scalable as es
+    from ringpop_tpu.models.sim.checkpoint import load_state, save_state
+
+    params = es.ScalableParams(n=32, u=160)
+    state = es.init_state(params, seed=4)
+    path = str(tmp_path / "old.npz")
+    save_state(path, state, params)
+    # strip defame_by, simulating a round-3 artifact
+    data = dict(np.load(path, allow_pickle=True))
+    del data["defame_by"]
+    np.savez(path, **data)
+
+    loaded = load_state(path, es.ScalableState, params)
+    db = np.asarray(loaded.defame_by)
+    assert (db == np.arange(32)).all()
+    for f in es.ScalableState._fields:
+        if f == "defame_by":
+            continue
+        assert (
+            np.asarray(getattr(loaded, f)) == np.asarray(getattr(state, f))
+        ).all(), f

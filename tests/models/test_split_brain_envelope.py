@@ -91,6 +91,9 @@ def run_scalable_split(n=100_000, split_frac=0.1, split_ticks=35, heal_ticks=80)
         refutes += int(m.refutes_published)
         faulties += int(m.faulties_published)
     truth_mid = np.asarray(state.truth_status)
+    faulty_mid = int((truth_mid == es.FAULTY).sum())
+    # cross-side split: minority subjects marked faulty by the majority
+    faulty_mid_minority = int((truth_mid[:cut] == es.FAULTY).sum())
 
     heal_inp = es.ChurnInputs(
         kill=jnp.zeros(n, bool),
@@ -108,6 +111,8 @@ def run_scalable_split(n=100_000, split_frac=0.1, split_ticks=35, heal_ticks=80)
         "suspects_during_split": susp,
         "refutes": refutes,
         "faulties_published": faulties,
+        "faulty_truth_at_heal": faulty_mid,
+        "faulty_truth_at_heal_minority": faulty_mid_minority,
         "bad_truth_at_heal": int((truth_mid >= es.SUSPECT).sum()),
         "reconverge_ticks": reconverge_ticks,
         "residual_bad_marks": int((truth_end >= es.SUSPECT).sum()),
@@ -123,15 +128,23 @@ def test_split_brain_envelope_full_vs_scalable():
     assert full["suspects_during_split"] > 0
     assert scal["suspects_during_split"] > 0
 
-    # ENVELOPE EDGE, asserted: the full engine escalates cross-side
-    # suspicions to FAULTY during a >suspicion_ticks split (reference
-    # behavior)...
+    # BOTH engines escalate cross-side suspicions to FAULTY during a
+    # >suspicion_ticks split (reference behavior: faulty marks are
+    # retained through the partition).  For the scalable engine this is
+    # the round-4 defame_by reachability gate at work: partitioned-away
+    # subjects cannot refute accusations they could never have heard, so
+    # the accusing side's suspicion clocks run out and publish faulty
+    # batches.
     assert full["faulty_marks_at_heal"] > 0, (
         "full engine should have escalated cross-side suspects to faulty "
         "during a 35-tick split (suspicion window 25)"
     )
-    # ...while the scalable engine's single truth chain lets refutes
-    # cancel suspicions before the faulty batch fires for LIVE nodes
+    assert scal["faulties_published"] > 0, scal
+    assert scal["faulty_truth_at_heal_minority"] > 0, (
+        "majority side should have escalated partitioned-away subjects "
+        "to faulty during the split: %r" % (scal,)
+    )
+    # the defamed-but-live subjects clean themselves up after the heal
     assert scal["refutes"] > 0
     assert scal["residual_bad_marks"] == 0
 
