@@ -122,12 +122,13 @@ def _retry_helper_500(fn, *args, **kwargs):
     real graph/engine failure and re-raises too.  ONE retry policy for
     every measured config (fast, straight-line, batched, parity)."""
     exc = None
-    for backoff in _HELPER_BACKOFFS:
+    for i, backoff in enumerate(_HELPER_BACKOFFS):
         if backoff:
             time.sleep(backoff)
         try:
             return fn(*args, **kwargs)
         except Exception as e:
+            e._bench_attempts = i + 1  # actual tries for artifact fields
             exc = e
             if _is_transient(exc) or not _is_compile_helper_500(exc):
                 raise
@@ -222,9 +223,7 @@ def _measure(n: int, ticks: int) -> dict:
         exc = e
         if _is_transient(exc):
             raise  # retryable backend failures keep the retry semantics
-        tries = (
-            len(_HELPER_BACKOFFS) if _is_compile_helper_500(exc) else 1
-        )
+        tries = getattr(exc, "_bench_attempts", 1)
     # in-process budget exhausted on a compile-helper 500: a FRESH
     # interpreter re-submits the compile through a clean tunnel session
     # (the fast-mode number is re-measured there — itself protected by
@@ -247,7 +246,7 @@ def _measure(n: int, ticks: int) -> dict:
     # actual parity attempts across every process of this run: each
     # re-exec'd predecessor exhausted its full in-process budget (only
     # compile-helper 500s re-exec; other errors break out above)
-    result["parity_attempts"] = tries + 3 * int(
+    result["parity_attempts"] = tries + len(_HELPER_BACKOFFS) * int(
         os.environ.get("BENCH_PARITY_ATTEMPT", "0")
     )
     return result

@@ -132,6 +132,12 @@ class RequestProxy:
                     dest, endpoint, head=head, body=req.get("body"),
                     timeout_s=timeout_s,
                 )
+                if attempt > 0:
+                    # a RETRY landed (send.js:160-166)
+                    self.ringpop.stat(
+                        "increment", "requestProxy.retry.succeeded"
+                    )
+                self.ringpop.stat("increment", "requestProxy.send.success")
                 return res
             except (ChannelError, RemoteError) as e:
                 if isinstance(e, RemoteError):
@@ -139,11 +145,15 @@ class RequestProxy:
                     # checksum mismatches are retryable (ring may converge);
                     # other application errors are not
                     if payload.get("type") != errors.InvalidCheckSumError.type:
+                        self.ringpop.stat(
+                            "increment", "requestProxy.send.error"
+                        )
                         raise
                 if attempt >= max_retries:
                     self.ringpop.stat(
                         "increment", "requestProxy.retry.failed"
                     )
+                    self.ringpop.stat("increment", "requestProxy.send.error")
                     raise errors.MaxRetriesExceededError(maxRetries=max_retries)
                 delay = self.retry_schedule_s[
                     min(attempt, len(self.retry_schedule_s) - 1)
@@ -153,11 +163,20 @@ class RequestProxy:
                 attempt += 1
                 dest = self._relookup(keys, dest)
                 if dest == self.ringpop.whoami():
-                    # reroute local (send.js:190-198)
+                    # reroute local (send.js:190-198) — a landed retry and
+                    # a completed request, so the full success accounting
+                    # fires like the remote path's
                     self.ringpop.stat(
                         "increment", "requestProxy.retry.reroute.local"
                     )
-                    return self._handle_locally(head, req.get("body"))
+                    out = self._handle_locally(head, req.get("body"))
+                    self.ringpop.stat(
+                        "increment", "requestProxy.retry.succeeded"
+                    )
+                    self.ringpop.stat(
+                        "increment", "requestProxy.send.success"
+                    )
+                    return out
                 self.ringpop.stat(
                     "increment", "requestProxy.retry.reroute.remote"
                 )
@@ -166,6 +185,8 @@ class RequestProxy:
         dests = {self.ringpop.lookup(k) for k in keys}
         if len(dests) > 1:
             self.ringpop.stat("increment", "requestProxy.retry.aborted")
+            # the request fails permanently here: close the accounting
+            self.ringpop.stat("increment", "requestProxy.send.error")
             raise errors.KeysDivergedError(
                 keys=keys, origDestination=orig_dest,
                 newDestinations=sorted(dests),
@@ -191,7 +212,10 @@ class RequestProxy:
         """The ``/proxy/req`` receive path (request-proxy/index.js:168-229)."""
         self.ringpop.stat("increment", "requestProxy.requests.incoming")
         expected = head.get("ringpopChecksum")
-        if self.enforce_consistency and expected != self.ringpop.membership.checksum:
+        if expected != self.ringpop.membership.checksum:
+            # the differ STAT fires whether or not consistency is
+            # enforced; only the rejection is gated
+            # (lib/request-proxy/index.js:186-193)
             self.ringpop.stat("increment", "requestProxy.checksumsDiffer")
             self.ringpop.logger.warning(
                 "ringpop request proxy checksums differ",
@@ -201,9 +225,11 @@ class RequestProxy:
                     "actual": self.ringpop.membership.checksum,
                 },
             )
-            raise errors.InvalidCheckSumError(
-                expected=expected, actual=self.ringpop.membership.checksum
-            )
+            if self.enforce_consistency:
+                raise errors.InvalidCheckSumError(
+                    expected=expected,
+                    actual=self.ringpop.membership.checksum,
+                )
         return self._handle_locally(head, body)
 
     def destroy(self) -> None:

@@ -496,6 +496,38 @@ def _apply_updates(
     return new_state, gate, start_t, stop_t
 
 
+def _rows(m: jax.Array, idx: jax.Array, n: int) -> jax.Array:
+    """``m[idx]`` — select whole rows of an [N, N] array by an [N] index.
+
+    On TPU this is computed as a ONE-HOT f32 MATMUL on the MXU instead of
+    a gather: the round-4 trace (PROF_1K_OPS.json) measured the [N, N]
+    row-gathers of the receive/response phases at ~5-10 ms each at
+    n=1024 (~0.4 GB/s — XLA's TPU dynamic-gather path), while the
+    equivalent [N,N]x[N,N] selection matmul is tens of microseconds.
+    Exact because every engine value riding this path — bools, status
+    codes, node ids, int32 tick stamps — is an integer with |v| < 2^24,
+    representable exactly in float32, and a one-hot row dot product is a
+    pure selection (one term, no rounding).  XLA CSEs the repeated
+    one-hot of the same index vector, so several matrices selected by
+    one idx share one W build.  CPU (and n > 4096, where the n^3
+    selection would dominate) keeps the gather.
+    """
+    if n > 4096 or jax.default_backend() != "tpu":
+        return m[idx]
+    w = (
+        idx[:, None] == jnp.arange(n, dtype=jnp.int32)[None, :]
+    ).astype(jnp.float32)
+    # Precision.HIGHEST is REQUIRED for exactness: the TPU's default f32
+    # matmul multiplies in bf16, which rounds the selected values to 8
+    # mantissa bits (measured: default loses equality at 2^24-1 values,
+    # HIGHEST restores it — the 3-pass bf16 split reproduces full f32).
+    out = jnp.matmul(
+        w, m.astype(jnp.float32), precision=jax.lax.Precision.HIGHEST
+    )
+    if m.dtype == jnp.bool_:
+        return out > 0.5
+    return out.astype(m.dtype)
+
 
 def tick(
     state: SimState,
@@ -632,9 +664,9 @@ def tick(
             known_j, status_j, inc_j = carry
             tgt = jorder[:, k]
             ok = jvalid[:, k] & joiner
-            t_known = state.known[tgt]
-            t_status = state.status[tgt]
-            t_inc = state.inc[tgt]
+            t_known = _rows(state.known, tgt, n)
+            t_status = _rows(state.status, tgt, n)
+            t_inc = _rows(state.inc, tgt, n)
             take = ok[:, None] & t_known
             better = take & (
                 ~known_j | (_pack_key(t_inc, t_status) > _pack_key(inc_j, status_j))
@@ -730,9 +762,14 @@ def tick(
     )
     # first pingable member in walk order == the pingable member with the
     # smallest walk rank; rank is elementwise from the stored inverse
-    # permutation, so the whole selection is one [N, N] mod/compare plus a
-    # row argmin — no gathers
-    walk_rank = (state.perm_inv - state.iter_pos[:, None]) % n
+    # permutation, so the whole selection is one [N, N] compare plus a
+    # row argmin — no gathers.  The mod-n is an add-if-negative: TPU
+    # vector units have no integer divide, and an [N, N] `%` lowers to a
+    # ~10 ms fusion at n=1024 (round-4 trace, PROF_1K_OPS.json) where
+    # this select costs microseconds — bitwise-identical for the
+    # difference's (-n, n) range.
+    _wr = state.perm_inv - state.iter_pos[:, None]
+    walk_rank = _wr + jnp.where(_wr < 0, n, 0)
     masked_rank = jnp.where(pingable, walk_rank, n)
     first_k = jnp.min(masked_rank, axis=1).astype(jnp.int32)
     has_target = first_k < n
@@ -784,9 +821,25 @@ def tick(
         a_idx = jnp.clip((r[:, 0] * k_cop).astype(jnp.int32), 0, k_cop - 1)
         a_inv = jnp.asarray(coprime_invs)[a_idx]
         b = (r[:, 1] * np.float32(n)).astype(jnp.int32) % n
-        idx = (
-            a_inv[:, None] * ((base_inv[None, :] - b[:, None]) % n)
-        ) % n
+        # the two [N, N] mod-n ops here were the HOTTEST device code in
+        # the whole 1k scan (round-4 trace: ~18 ms per firing tick — TPU
+        # has no integer divide).  (base_inv - b) spans (-n, n): mod is
+        # an add-if-negative.  a_inv * d spans [0, n^2): for n <= 4096
+        # every value is exact in float32, so quotient-by-float-division
+        # with a one-step correction reproduces integer mod bit-for-bit
+        # (the host oracle's plain % arithmetic is matched exactly).
+        d = base_inv[None, :] - b[:, None]
+        d = d + jnp.where(d < 0, n, 0)
+        x = a_inv[:, None] * d
+        if n <= 4096:  # n*n < 2^24: f32-exact path
+            q = jnp.floor(
+                x.astype(jnp.float32) / np.float32(n)
+            ).astype(jnp.int32)
+            idx = x - q * n
+            idx = idx + jnp.where(idx < 0, n, 0)
+            idx = idx - jnp.where(idx >= n, n, 0)
+        else:  # [N, N] engines beyond 4k nodes are memory-bound anyway
+            idx = x % n
         return jnp.where(resh[:, None], idx, state.perm_inv)
 
     perm_inv = _phase(
@@ -861,11 +914,29 @@ def tick(
         winner_sender = jax.ops.segment_min(
             jnp.where(is_winner, sender_ids, n), seg, num_segments=n + 1
         )[:n]
-        ws = jnp.clip(winner_sender, 0, n - 1)
         u_status = (recv_key % 4).astype(jnp.int32)
         u_inc = recv_key // 4
-        u_source = state.ch_source[ws, subject]
-        u_source_inc = state.ch_source_inc[ws, subject]
+        # winner's source fields WITHOUT a general [N, N] gather (the
+        # round-4 trace's hottest ops): mark the unique winning (sender,
+        # subject) cell — the min-id sender among max-key holders, found
+        # by selecting each sender's own segment row of winner_sender —
+        # and segment-reduce the source fields over that singleton mask.
+        # Exact: exactly one final winner per delivered (receiver,
+        # subject); undelivered segments reduce to the sentinel and are
+        # masked by recv_mask downstream, as before.
+        wsrow = _rows(winner_sender, jnp.clip(target, 0, n - 1), n)
+        final_w = is_winner & (sender_ids == wsrow)
+        NEG = jnp.int32(-(2**31))
+        u_source = jax.ops.segment_max(
+            jnp.where(final_w, state.ch_source, NEG),
+            seg,
+            num_segments=n + 1,
+        )[:n]
+        u_source_inc = jax.ops.segment_max(
+            jnp.where(final_w, state.ch_source_inc, NEG),
+            seg,
+            num_segments=n + 1,
+        )[:n]
         state, applied_ping, started, _ = _apply_updates(
             state, now, recv_mask, u_status, u_inc, u_source, u_source_inc
         )
@@ -946,24 +1017,32 @@ def tick(
         # (dissemination.js:91-98) — matched against the ping-body
         # incarnation (sent_self_inc)
         resp_filter = (
-            (state.ch_source[tgt] == node)
-            & (state.ch_source_inc[tgt] == sent_self_inc[:, None])
+            (_rows(state.ch_source, tgt, n) == node)
+            & (_rows(state.ch_source_inc, tgt, n) == sent_self_inc[:, None])
         )
-        resp_mask = delivered[:, None] & respondable[tgt] & ~resp_filter
+        resp_mask = delivered[:, None] & _rows(respondable, tgt, n) & ~resp_filter
         any_resp_change = jnp.any(resp_mask, axis=1)
         # full-sync: no changes to send back AND checksums differ
         # (sender's checksum rides in the ping body, ping-sender.js:70-76)
         full_sync = delivered & ~any_resp_change & (
             mid_checksum[tgt] != advertised_checksum
         )
-        fs_mask = full_sync[:, None] & state.known[tgt]
-        r_status = jnp.where(fs_mask, state.status[tgt], state.ch_status[tgt])
-        r_inc = jnp.where(fs_mask, state.inc[tgt], state.ch_inc[tgt])
+        fs_mask = full_sync[:, None] & _rows(state.known, tgt, n)
+        r_status = jnp.where(
+            fs_mask, _rows(state.status, tgt, n), _rows(state.ch_status, tgt, n)
+        )
+        r_inc = jnp.where(
+            fs_mask, _rows(state.inc, tgt, n), _rows(state.ch_inc, tgt, n)
+        )
         r_source = jnp.where(
-            fs_mask, jnp.broadcast_to(target[:, None], (n, n)), state.ch_source[tgt]
+            fs_mask,
+            jnp.broadcast_to(target[:, None], (n, n)),
+            _rows(state.ch_source, tgt, n),
         )
         r_source_inc = jnp.where(
-            fs_mask, state.inc[tgt, tgt][:, None], state.ch_source_inc[tgt]
+            fs_mask,
+            state.inc[tgt, tgt][:, None],
+            _rows(state.ch_source_inc, tgt, n),
         )
         apply_resp = resp_mask | fs_mask
         state, applied_resp, started_r, _ = _apply_updates(

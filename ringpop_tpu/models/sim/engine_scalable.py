@@ -362,6 +362,33 @@ def _publish_batch(
 
 
 
+def _representative_accuser(
+    accuser: jax.Array,  # [N] bool — nodes defaming someone this tick
+    subj_idx: jax.Array,  # [N] int32 — accuser i's subject (n = none)
+    partition: jax.Array,  # [N] int32
+    n: int,
+) -> jax.Array:
+    """[N] int32: per SUBJECT, one representative accuser id, same-side
+    accusers preferred — keys in [0, n) are same-side accuser ids,
+    [n, 2n) cross-side, so a scatter-min picks a same-side id whenever
+    one exists.  The refute phase requires the subject to currently
+    REACH this node (defame_by gate): a partitioned-away subject cannot
+    legitimately learn it was defamed across the cut, even though
+    same-tick defamations from both sides share one rumor slot (the
+    slot carries no member list).  Entries for non-subjects decode from
+    the untouched 2n sentinel and must be masked by the caller."""
+    ids = jnp.arange(n, dtype=jnp.int32)
+    same = accuser & (
+        partition == partition[jnp.clip(subj_idx, 0, n - 1)]
+    )
+    key = jnp.where(same, ids, ids + n)
+    rep_key = (
+        jnp.full(n, 2 * n, jnp.int32).at[subj_idx].min(key, mode="drop")
+    )
+    rep_id = rep_key - jnp.where(rep_key >= n, n, 0)  # key in [0, 2n]
+    return rep_id - jnp.where(rep_id >= n, n, 0)
+
+
 def _publish_batch_gated(
     state: ScalableState,
     csum: jax.Array,
@@ -581,15 +608,20 @@ def tick(
     inv_base = jnp.argsort(base_perm).astype(jnp.int32)
     offs = [(k * (n // k_total)) % n for k in range(k_total)]  # static
 
+    # mod-n via range-correcting selects, not `%`: TPU vector units have
+    # no integer divide (an [N] `%` at 1M costs milliseconds; a select is
+    # free) — exact because both operands lie in (-n, 2n)
     def _partner(k):
         if offs[k] == 0:
             return base_perm
-        return base_perm[(ids + jnp.int32(offs[k])) % n]
+        v = ids + jnp.int32(offs[k])  # [0, 2n)
+        return base_perm[v - jnp.where(v >= n, n, 0)]
 
     def _inv(k):  # inv_k[v] = (inv_base[v] - c_k) mod n
         if offs[k] == 0:
             return inv_base
-        return (inv_base - jnp.int32(offs[k])) % n
+        v = inv_base - jnp.int32(offs[k])  # (-n, n)
+        return v + jnp.where(v < 0, n, 0)
 
     partner0 = base_perm
     # one loss outcome per (node, partner-round) message — shared by the
@@ -710,22 +742,7 @@ def tick(
     subj_idx = jnp.where(detector, partner0, n)
     suspect_subjects = jnp.zeros(n, bool).at[subj_idx].set(True, mode="drop")
     n_susp = jnp.sum(suspect_subjects.astype(jnp.int32))
-    # representative defamer per subject, same-side detectors preferred:
-    # keys in [0, n) are same-side detector ids, [n, 2n) cross-side, so a
-    # scatter-min picks a same-side id whenever one exists.  The refute
-    # gate below requires the subject to be CONNECTED to this detector —
-    # a partitioned-away subject cannot legitimately learn it was defamed
-    # across the cut, even though same-tick defamations from both sides
-    # share one rumor slot (the slot carries no member list).
-    det_same = detector & (
-        partition == partition[jnp.clip(partner0, 0, n - 1)]
-    )
-    det_key = jnp.where(det_same, ids, ids + n)
-    rep_key = (
-        jnp.full(n, 2 * n, jnp.int32)
-        .at[subj_idx]
-        .min(det_key, mode="drop")
-    )
+    rep_id = _representative_accuser(detector, subj_idx, partition, n)
     state, csum = _publish_batch_gated(
         state,
         csum,
@@ -739,7 +756,7 @@ def tick(
     )
     state = state._replace(
         defame_slot=jnp.where(suspect_subjects, slots[0], state.defame_slot),
-        defame_by=jnp.where(suspect_subjects, rep_key % n, state.defame_by),
+        defame_by=jnp.where(suspect_subjects, rep_id, state.defame_by),
     )
 
     # ---- suspicion expiry: faulty batch --------------------------------
@@ -755,13 +772,7 @@ def tick(
     faulty_subjects = jnp.zeros(n, bool).at[fs_idx].set(True, mode="drop")
     n_faulty = jnp.sum(faulty_subjects.astype(jnp.int32))
     # representative accuser per faulty subject (same scheme as suspects)
-    exp_same = expirer & (partition == partition[esubj])
-    exp_key = jnp.where(exp_same, ids, ids + n)
-    frep_key = (
-        jnp.full(n, 2 * n, jnp.int32)
-        .at[fs_idx]
-        .min(exp_key, mode="drop")
-    )
+    frep_id = _representative_accuser(expirer, fs_idx, partition, n)
     state = state._replace(
         susp_subject=jnp.where(expire, -1, state.susp_subject),
         susp_since=jnp.where(expire, -1, state.susp_since),
@@ -779,7 +790,7 @@ def tick(
     )
     state = state._replace(
         defame_slot=jnp.where(faulty_subjects, slots[1], state.defame_slot),
-        defame_by=jnp.where(faulty_subjects, frep_key % n, state.defame_by),
+        defame_by=jnp.where(faulty_subjects, frep_id, state.defame_by),
     )
 
     # ---- refute + rejoin: alive batch ----------------------------------

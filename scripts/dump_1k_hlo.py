@@ -1,0 +1,81 @@
+#!/usr/bin/env python3
+"""Compile the 1k fast-mode scan for TPU and print the bodies of the hot
+fusions/conditionals from the round-4 trace (PROF_1K_OPS.json) with their
+jax source metadata, so the 10 ms fusions can be attributed to engine
+lines.  Compile-only; writes /tmp/hlo_1k.txt and prints a filtered view.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main() -> int:
+    from ringpop_tpu.utils.util import scrub_repo_pythonpath, wait_for_tpu
+
+    scrub_repo_pythonpath(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    )
+    import ringpop_tpu  # noqa: F401
+
+    wait_for_tpu(__file__, "HLO_1K_ATTEMPT", 90, 20.0)
+    import jax
+
+    from ringpop_tpu.models.sim import engine
+    from ringpop_tpu.models.sim.cluster import EventSchedule, SimCluster
+
+    n = 1024
+    sim = SimCluster(
+        n=n, params=engine.SimParams(n=n, checksum_mode="fast")
+    )
+    sched = EventSchedule(ticks=32, n=n)
+    lowered = sim._scanned.lower(sim.state, sched.as_inputs())
+    txt = lowered.compile().as_text()
+    with open("/tmp/hlo_1k.txt", "w") as f:
+        f.write(txt)
+    print("HLO bytes:", len(txt))
+
+    # print each hot computation's instruction lines w/ metadata op names
+    want = re.compile(
+        r"^(%?(fusion\.[4-8]|conditional\.7[4-9])) ", re.M
+    )
+    lines = txt.splitlines()
+    for i, line in enumerate(lines):
+        s = line.strip()
+        m = re.match(r"%?(fusion\.[4-8]|conditional\.7[4-9]) =", s)
+        if m:
+            print("==== DEF:", s[:400])
+    # fusions are defined as computations named %fused_computation.N —
+    # map fusion.N instruction to its called computation and dump ops
+    for name in ["fusion.4", "fusion.5", "fusion.6", "fusion.7", "fusion.8"]:
+        m = re.search(r"%s = [^\n]*calls=([%%\w.\-_]+)" % re.escape(name), txt)
+        if not m:
+            continue
+        comp = m.group(1).lstrip("%")
+        print("\n######## %s -> %s" % (name, comp))
+        cm = re.search(
+            r"^%%?%s[^\n]*\{(.*?)^\}" % re.escape(comp),
+            txt,
+            re.M | re.S,
+        )
+        if cm:
+            body = cm.group(1)
+            # keep op lines with metadata source info, compressed
+            for ln in body.splitlines():
+                ln = ln.strip()
+                if not ln:
+                    continue
+                meta = re.search(r'op_name="([^"]+)"', ln)
+                op = ln.split(" = ")[0]
+                kind = ln.split(" = ")[-1].split("(")[0][:60]
+                if meta:
+                    print("  ", op[:28], "|", kind, "|", meta.group(1)[-120:])
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,108 @@
+#!/usr/bin/env python3
+"""Per-op XLA profile of the 1k full-fidelity fast-mode scan on TPU.
+
+Captures a jax.profiler trace of the 32-tick bench scan, then parses the
+perfetto trace JSON for the top ops by device self-time — the data the
+1k chip-vs-CPU gap decision needs (RESULTS_TPU_r04: 22.2k node-ticks/s
+TPU vs 50.8k CPU; batched vmap made it WORSE, so the cost lives in
+specific ops, not launch overhead).
+
+Writes PROF_1K_OPS.json: [{"op": ..., "total_ms": ..., "count": ...}].
+"""
+
+from __future__ import annotations
+
+import glob
+import gzip
+import json
+import os
+import sys
+import time
+from collections import defaultdict
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+OUT = os.environ.get("PROF_1K_OUT", "PROF_1K_OPS.json")
+TRACE_DIR = "/tmp/jax_trace_1k"
+
+
+def main() -> int:
+    from ringpop_tpu.utils.util import scrub_repo_pythonpath, wait_for_tpu
+
+    scrub_repo_pythonpath(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    )
+    import ringpop_tpu  # noqa: F401
+
+    wait_for_tpu(__file__, "PROF_1K_ATTEMPT", 90, 20.0)
+    import jax
+
+    from ringpop_tpu.models.sim import engine
+    from ringpop_tpu.models.sim.cluster import EventSchedule, SimCluster
+
+    n, ticks = 1024, 32
+    sim = SimCluster(
+        n=n, params=engine.SimParams(n=n, checksum_mode="fast")
+    )
+    sim.bootstrap()
+    sched = EventSchedule(ticks=ticks, n=n)
+    sim.run(sched)  # compile + warm
+    jax.block_until_ready(sim.state)
+
+    t0 = time.perf_counter()
+    with jax.profiler.trace(TRACE_DIR):
+        sim.run(sched)
+        jax.block_until_ready(sim.state)
+    wall = time.perf_counter() - t0
+
+    # parse the perfetto trace for TPU-lane op events
+    paths = glob.glob(
+        os.path.join(TRACE_DIR, "**", "*.trace.json.gz"), recursive=True
+    )
+    agg = defaultdict(lambda: [0.0, 0])
+    if paths:
+        with gzip.open(sorted(paths)[-1], "rt") as f:
+            trace = json.load(f)
+        events = trace.get("traceEvents", [])
+        # find TPU/device process ids (names contain 'TPU' or 'Device')
+        pid_names = {}
+        for e in events:
+            if e.get("ph") == "M" and e.get("name") == "process_name":
+                pid_names[e.get("pid")] = e.get("args", {}).get("name", "")
+        dev_pids = {
+            p
+            for p, name in pid_names.items()
+            if "TPU" in name or "/device:" in name or "Device" in name
+        }
+        for e in events:
+            if e.get("ph") != "X" or e.get("pid") not in dev_pids:
+                continue
+            dur = e.get("dur", 0) / 1e3  # us -> ms
+            name = e.get("name", "?")
+            agg[name][0] += dur
+            agg[name][1] += 1
+    top = sorted(
+        (
+            {"op": k, "total_ms": round(v[0], 2), "count": v[1]}
+            for k, v in agg.items()
+        ),
+        key=lambda d: -d["total_ms"],
+    )[:60]
+    out = {
+        "wall_s": round(wall, 3),
+        "n": n,
+        "ticks": ticks,
+        "device": str(jax.devices()[0]),
+        "pid_names": sorted(set(pid_names.values())) if paths else [],
+        "top_ops": top,
+    }
+    with open(OUT, "w") as f:
+        json.dump(out, f, indent=1)
+    print(json.dumps({"wall_s": out["wall_s"], "n_ops": len(top)}))
+    for d in top[:25]:
+        print(json.dumps(d))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,89 @@
+#!/usr/bin/env python3
+"""Cross-backend trajectory equality for the 1k full-fidelity engine.
+
+The TPU path differs from CPU in two deliberate ways (one-hot MXU row
+selection with Precision.HIGHEST, f32-exact reshuffle mod) — both proven
+exact op-level; this drives the whole bench config end-to-end on ONE
+backend and dumps the final state's integer digests so a run on the
+OTHER backend can be compared bit-for-bit.
+
+Usage:
+  env -u JAX_PLATFORMS python scripts/verify_1k_chip.py tpu out_tpu.npz
+  python scripts/verify_1k_chip.py cpu out_cpu.npz   (forces CPU)
+  python scripts/verify_1k_chip.py compare out_tpu.npz out_cpu.npz
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def run(backend: str, out: str) -> int:
+    from ringpop_tpu.utils.util import scrub_repo_pythonpath
+
+    scrub_repo_pythonpath(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    )
+    import jax
+
+    if backend == "cpu":
+        jax.config.update("jax_platforms", "cpu")
+    import numpy as np
+
+    import ringpop_tpu  # noqa: F401
+    from ringpop_tpu.utils.util import wait_for_tpu
+
+    if backend == "tpu":
+        wait_for_tpu(__file__, "VERIFY_1K_ATTEMPT", 90, 20.0)
+    from ringpop_tpu.models.sim import engine
+    from ringpop_tpu.models.sim.cluster import EventSchedule, SimCluster
+
+    n = 1024
+    sim = SimCluster(
+        n=n, params=engine.SimParams(n=n, checksum_mode="fast")
+    )
+    sim.bootstrap()
+    sched = EventSchedule(ticks=32, n=n)
+    sched.kill[5, 7] = True
+    sched.revive[20, 7] = True
+    m = sim.run(sched)
+    st = sim.state
+    np.savez(
+        out,
+        platform=np.array(jax.devices()[0].platform),
+        checksum=np.asarray(st.checksum),
+        status=np.asarray(st.status),
+        inc=np.asarray(st.inc),
+        known=np.asarray(st.known),
+        ch_active=np.asarray(st.ch_active),
+        perm_inv=np.asarray(st.perm_inv),
+        converged=np.asarray(m.converged),
+        changes_applied=np.asarray(m.changes_applied),
+    )
+    print("wrote", out, "platform", jax.devices()[0].platform)
+    return 0
+
+
+def compare(a_path: str, b_path: str) -> int:
+    import numpy as np
+
+    a, b = np.load(a_path), np.load(b_path)
+    bad = 0
+    for k in a.files:
+        if k == "platform":
+            continue
+        ok = (a[k] == b[k]).all()
+        print(k, "OK" if ok else "MISMATCH %d" % int((a[k] != b[k]).sum()))
+        bad += not ok
+    print("platforms:", a["platform"], b["platform"])
+    return 1 if bad else 0
+
+
+if __name__ == "__main__":
+    mode = sys.argv[1]
+    if mode == "compare":
+        sys.exit(compare(sys.argv[2], sys.argv[3]))
+    sys.exit(run(mode, sys.argv[2]))

@@ -473,3 +473,153 @@ def test_proxy_endpoint_override(cluster):
     # handler, not the channel)
     assert res == {"via": "custom"}
     assert seen["head"]["ringpopKeys"] == [key]
+
+
+def test_enforce_consistency_false_serves_despite_mismatch(cluster):
+    """enforceConsistency=false: a checksum mismatch still increments the
+    differ stat but the request IS served (proxy-test.js 'no retries,
+    invalid checksum emit request when enforceConsistency is false';
+    lib/request-proxy/index.js:186-193)."""
+    c = cluster(n=2, options={"requestProxy": {"enforceConsistency": False}})
+    wire_echo_handlers(c)
+    from ringpop_tpu.utils.stats import CapturingStatsd
+
+    sender, dest = c.node(0), c.node(1)
+    dest.statsd = CapturingStatsd()
+    key = key_owned_by(c, dest)
+    # destabilize dest's checksum: sender's head now carries a stale one
+    dest.membership.update(
+        {
+            "address": "127.0.0.1:19996",
+            "status": "faulty",
+            "incarnationNumber": 1,
+            "source": dest.whoami(),
+            "sourceIncarnationNumber": 1,
+        }
+    )
+    res = sender.proxy_req(
+        {"keys": [key], "dest": dest.whoami(), "req": {"url": "/ec"}}
+    )
+    assert res["body"]["handledBy"] == dest.whoami()
+    assert any(
+        "checksumsDiffer" in k
+        for _, k, _ in dest.statsd.records
+    ), "the differ stat must fire even when not enforcing"
+
+
+def test_per_retry_stats_full_lifecycle(cluster):
+    """Per-retry stat emission (send.js:92-200): attempted on each retry,
+    reroute.remote on re-lookup to another node, succeeded when a retry
+    lands, and send.success exactly once for the whole request."""
+    c = cluster(n=3)
+    wire_echo_handlers(c)
+    from ringpop_tpu.utils.stats import CapturingStatsd
+
+    sender, owner = c.node(0), c.node(1)
+    sender.statsd = CapturingStatsd()
+    sender.request_proxy.retry_schedule_s = [0.0]
+    key = key_owned_by(c, owner)
+
+    def count(fragment):
+        return sum(
+            1 for _, k, _ in sender.statsd.records if fragment in k
+        )
+
+    res = sender.proxy_req(
+        {"keys": [key], "dest": "127.0.0.1:1", "req": {"url": "/st"}}
+    )
+    assert res["body"]["handledBy"] == owner.whoami()
+    assert count("requestProxy.retry.attempted") == 1
+    assert count("requestProxy.retry.reroute.remote") == 1
+    assert count("requestProxy.retry.succeeded") == 1
+    assert count("requestProxy.send.success") == 1
+    assert count("requestProxy.retry.failed") == 0
+
+
+def test_reroute_local_serves_in_process(cluster):
+    """A retry whose re-lookup lands on the SENDER handles the request
+    in-process and emits reroute.local (send.js:190-198, proxy-test.js
+    'reroutes retry to local')."""
+    c = cluster(n=2)
+    wire_echo_handlers(c)
+    from ringpop_tpu.utils.stats import CapturingStatsd
+
+    sender = c.node(0)
+    sender.statsd = CapturingStatsd()
+    sender.request_proxy.retry_schedule_s = [0.0]
+    key = key_owned_by(c, sender)
+    res = sender.proxy_req(
+        {"keys": [key], "dest": "127.0.0.1:1", "req": {"url": "/lo"}}
+    )
+    assert res["body"]["handledBy"] == sender.whoami()
+    assert any(
+        "retry.reroute.local" in k for _, k, _ in sender.statsd.records
+    )
+
+
+def test_retries_multiple_keys_same_dest(cluster):
+    """Multiple keys that re-lookup to ONE owner retry fine — divergence
+    aborts only when owners differ (proxy-test.js 'retries multiple keys
+    w/ same dest')."""
+    c = cluster(n=3)
+    wire_echo_handlers(c)
+    sender, owner = c.node(0), c.node(1)
+    sender.request_proxy.retry_schedule_s = [0.0]
+    k1 = key_owned_by(c, owner, tag="mk1")
+    k2 = key_owned_by(c, owner, tag="mk2")
+    res = sender.proxy_req(
+        {"keys": [k1, k2], "dest": "127.0.0.1:1", "req": {"url": "/mk"}}
+    )
+    assert res["body"]["handledBy"] == owner.whoami()
+    assert res["body"]["keys"] == [k1, k2]
+
+
+def test_proxies_big_json(cluster):
+    """A ~1 MB JSON body survives the round trip intact (proxy-test.js
+    'proxies big json')."""
+    c = cluster(n=2)
+    sender, dest = c.node(0), c.node(1)
+    got = {}
+
+    def handler(req, res, head):
+        got["body"] = req["body"]
+        res.end({"n": len(req["body"]["blob"])})
+
+    dest.on("request", handler)
+    key = key_owned_by(c, dest)
+    blob = "x" * (1 << 20)
+    res = sender.proxy_req(
+        {
+            "keys": [key],
+            "dest": dest.whoami(),
+            "req": {"url": "/big", "body": {"blob": blob}},
+        }
+    )
+    assert res["body"]["n"] == len(blob)
+    assert got["body"]["blob"] == blob
+
+
+def test_custom_timeout_expires_against_stuck_handler(cluster):
+    """A per-request timeout bounds a handler that never responds
+    (proxy-test.js 'will timeout after default timeout' / 'custom
+    timeouts'), surfacing as retry exhaustion."""
+    c = cluster(n=2)
+    sender, dest = c.node(0), c.node(1)
+
+    def never_responds(req, res, head):
+        pass  # res.end never called
+
+    dest.on("request", never_responds)
+    key = key_owned_by(c, dest)
+    t0 = __import__("time").perf_counter()
+    with pytest.raises(errors.MaxRetriesExceededError):
+        sender.proxy_req(
+            {
+                "keys": [key],
+                "dest": dest.whoami(),
+                "req": {"url": "/slow"},
+                "timeout": 300,  # ms
+                "maxRetries": 0,
+            }
+        )
+    assert __import__("time").perf_counter() - t0 < 10.0
