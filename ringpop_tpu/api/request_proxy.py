@@ -169,7 +169,15 @@ class RequestProxy:
                     self.ringpop.stat(
                         "increment", "requestProxy.retry.reroute.local"
                     )
-                    out = self._handle_locally(head, req.get("body"))
+                    try:
+                        out = self._handle_locally(head, req.get("body"))
+                    except Exception:
+                        # keep the accounting closed like the remote
+                        # handler-error path does
+                        self.ringpop.stat(
+                            "increment", "requestProxy.send.error"
+                        )
+                        raise
                     self.ringpop.stat(
                         "increment", "requestProxy.retry.succeeded"
                     )

@@ -909,7 +909,9 @@ def tick(
         )[:n]
         recv_mask = recv_key >= 0
         # winning sender (lowest index among ties) recovers source fields
-        is_winner = (keys == recv_key[jnp.clip(target, 0, n - 1)]) & msg_content
+        is_winner = (
+            keys == _rows(recv_key, jnp.clip(target, 0, n - 1), n)
+        ) & msg_content
         sender_ids = jnp.broadcast_to(node, (n, n))
         winner_sender = jax.ops.segment_min(
             jnp.where(is_winner, sender_ids, n), seg, num_segments=n + 1

@@ -40,11 +40,8 @@ def main() -> int:
     print("HLO bytes:", len(txt))
 
     # print each hot computation's instruction lines w/ metadata op names
-    want = re.compile(
-        r"^(%?(fusion\.[4-8]|conditional\.7[4-9])) ", re.M
-    )
     lines = txt.splitlines()
-    for i, line in enumerate(lines):
+    for line in lines:
         s = line.strip()
         m = re.match(r"%?(fusion\.[4-8]|conditional\.7[4-9]) =", s)
         if m:

@@ -1,0 +1,162 @@
+#!/usr/bin/env python3
+"""Export an offline Node-parity replay artifact (PARITY_REPLAY.json).
+
+In this image the bit-exact checksum-parity chain is engine == host
+oracle == (transitively) ringpop-node, because no Node.js runtime is
+available (COVERAGE.md).  This exporter closes the residual gap by
+producing a self-contained artifact a Node-equipped machine can check
+against REAL ringpop-node code with no knowledge of this repo:
+
+- a churny full-engine run (farmhash mode) at small n,
+- at checkpoint ticks, the complete membership view of several observer
+  nodes — (address, status string, incarnationNumber ms) triples exactly
+  as the reference's member records hold them,
+- the engine's per-view FarmHash32 checksum.
+
+The validator (scripts/replay_node.md) rebuilds the reference's
+generateChecksumString for each snapshot (lib/membership/index.js:101-123
+— sort by address, concat address+status+incarnationNumber, join ';')
+and compares farmhash.hash32(str) to expected_checksum.
+
+Usage: python scripts/export_parity_replay.py [-n 64] [-o PARITY_REPLAY.json]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+STATUS_STR = {0: "alive", 1: "suspect", 2: "faulty", 3: "leave"}
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(prog="export-parity-replay")
+    p.add_argument("-n", type=int, default=64)
+    p.add_argument("--ticks", type=int, default=48)
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--output", "-o", default="PARITY_REPLAY.json")
+    args = p.parse_args(argv)
+    if args.ticks < 32:
+        p.error(
+            "--ticks must be >= 32 (kill at 10, revive at 26, checkpoint "
+            "at 30 are fixed; fewer ticks drops the faulty/revive "
+            "coverage the artifact exists to exercise)"
+        )
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from ringpop_tpu.models.sim import engine
+    from ringpop_tpu.models.sim.cluster import default_addresses
+    from ringpop_tpu.ops import checksum_encode as ce
+
+    n = args.n
+    params = engine.SimParams(
+        n=n, checksum_mode="farmhash", suspicion_ticks=6
+    )
+    addresses = default_addresses(n)
+    universe = ce.Universe.from_addresses(addresses)
+    state = engine.init_state(params, seed=args.seed, universe=universe)
+    tick = jax.jit(lambda s, i: engine.tick(s, i, params, universe))
+
+    rng = np.random.default_rng(args.seed)
+    victims = rng.choice(n, size=3, replace=False)
+    # churny schedule: bootstrap, kill wave (-> suspects -> faulties),
+    # revive (-> fresh-incarnation alives), reconvergence
+    snapshots = []
+    checkpoint_ticks = {
+        6,  # post-bootstrap dissemination
+        12,  # suspects in flight (kill at 10, suspicion 6 ticks)
+        20,  # faulties escalated
+        30,  # revived with fresh incarnations
+        args.ticks - 1,  # reconverged
+    }
+    observers = [0, int(n // 3), int(victims[0])]
+
+    for t in range(args.ticks):
+        inputs = engine.TickInputs.quiet(n)
+        if t == 0:
+            inputs = inputs._replace(join=jnp.ones(n, bool))
+        if t == 10:
+            kill = np.zeros(n, bool)
+            kill[victims] = True
+            inputs = inputs._replace(kill=jnp.asarray(kill))
+        if t == 26:
+            rv = np.zeros(n, bool)
+            rv[victims] = True
+            inputs = inputs._replace(revive=jnp.asarray(rv))
+        state, m = tick(state, inputs)
+        if t in checkpoint_ticks:
+            known = np.asarray(state.known)
+            status = np.asarray(state.status)
+            inc_ms = np.asarray(
+                engine.stamp_to_ms(state.inc, params)
+            )
+            checksums = np.asarray(state.checksum)
+            alive = np.asarray(state.proc_alive)
+            for o in observers:
+                if not alive[o]:
+                    continue
+                members = [
+                    {
+                        "address": addresses[j],
+                        "status": STATUS_STR[int(status[o, j])],
+                        "incarnationNumber": int(inc_ms[o, j]),
+                    }
+                    for j in range(n)
+                    if known[o, j]
+                ]
+                snapshots.append(
+                    {
+                        "tick": t,
+                        "observer": addresses[o],
+                        "members": members,
+                        "expected_checksum": int(checksums[o]),
+                    }
+                )
+
+    statuses = {
+        m["status"] for s in snapshots for m in s["members"]
+    }
+    assert {"alive", "suspect", "faulty"} <= statuses, (
+        "snapshots must exercise alive+suspect+faulty strings: %r"
+        % statuses
+    )
+    out = {
+        "description": (
+            "Membership-checksum parity replay against ringpop-node: for "
+            "each snapshot, rebuild the reference checksum string "
+            "(lib/membership/index.js:101-123 — members sorted by "
+            "address, address+status+incarnationNumber joined with ';') "
+            "and compare farmhash.hash32(str) >>> 0 to expected_checksum."
+        ),
+        "generator": "scripts/export_parity_replay.py",
+        "engine": "ringpop_tpu full-fidelity engine, farmhash mode",
+        "n": n,
+        "ticks": args.ticks,
+        "seed": args.seed,
+        "validator": "scripts/replay_node.md",
+        "status_values_present": sorted(statuses),
+        "snapshots": snapshots,
+    }
+    with open(args.output, "w") as f:
+        json.dump(out, f, indent=1)
+    print(
+        json.dumps(
+            {
+                "snapshots": len(snapshots),
+                "statuses": sorted(statuses),
+                "output": args.output,
+            }
+        )
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -102,19 +102,29 @@ def test_checksum_mismatch_rejected_then_retried_to_success(cluster):
     stats_before = _stat_count(sender, "requestProxy.retry.attempted")
 
     # background convergence: the proxy retry sleeps on FakeTimers, so we
-    # drive gossip from a thread while proxy_req blocks
+    # drive gossip from a thread while proxy_req blocks — until the
+    # request completes (a fixed iteration count raced the retry
+    # schedule and flaked under load)
     import threading
+    import time as _time
+
+    done = threading.Event()
 
     def converge():
-        for _ in range(30):
+        deadline = _time.monotonic() + 30.0
+        while not done.is_set() and _time.monotonic() < deadline:
             c.tick_all()
             sender.timers.advance(2.0)
+            _time.sleep(0.001)
 
     t = threading.Thread(target=converge, daemon=True)
     t.start()
-    res = sender.proxy_req(
-        {"keys": [key], "dest": dest.whoami(), "req": {"url": "/y"}}
-    )
+    try:
+        res = sender.proxy_req(
+            {"keys": [key], "dest": dest.whoami(), "req": {"url": "/y"}}
+        )
+    finally:
+        done.set()
     t.join(10.0)
     assert res["body"]["handledBy"] in {rp.whoami() for rp in c.nodes}
     assert (

@@ -1,0 +1,40 @@
+"""PARITY_REPLAY.json self-check: every snapshot's expected checksum is
+re-derivable from its member triples via the documented recipe
+(scripts/replay_node.md) using the INDEPENDENT native farmhash oracle —
+the same computation a Node validator performs with the farmhash addon.
+"""
+
+import json
+import os
+
+import pytest
+
+ARTIFACT = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))),
+    "PARITY_REPLAY.json",
+)
+
+
+@pytest.mark.skipif(
+    not os.path.exists(ARTIFACT), reason="artifact not generated"
+)
+def test_replay_artifact_checksums_rederive():
+    from ringpop_tpu.ops import native
+
+    d = json.load(open(ARTIFACT))
+    assert d["snapshots"], "artifact has no snapshots"
+    statuses = set()
+    for s in d["snapshots"]:
+        ms = sorted(s["members"], key=lambda m: m["address"])
+        statuses |= {m["status"] for m in ms}
+        cs = ";".join(
+            "%s%s%d" % (m["address"], m["status"], m["incarnationNumber"])
+            for m in ms
+        )
+        assert native.hash32(cs) == s["expected_checksum"], (
+            s["tick"],
+            s["observer"],
+        )
+    # the artifact must exercise the three status spellings that appear
+    # in reference checksum strings during churn
+    assert {"alive", "suspect", "faulty"} <= statuses
