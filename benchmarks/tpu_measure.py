@@ -6,8 +6,12 @@ once and capture every chip-gated number in a single session —
   B. hash32_rows Pallas kernel vs lax.scan lowering at the parity
      workload shape (SURVEY §2 native table)
   C. 100k-node epidemic broadcast, k=3 ping-req fanout, 5% packet loss
-     (BASELINE.md north-star row 3: "runs in-jit on TPU")
-  D. 1M-node churn storm, 10% fail/rejoin (north-star row 4: < 60 s)
+     (BASELINE.md north-star row 3: "runs in-jit on TPU"), gated and
+     straight-line phase variants
+  D. convergence-time scenarios at 1k (single-node-failure and
+     half-cluster-failure; scenario-runner.js histogram fields)
+  E. 1M-node churn storm, 10% fail/rejoin (north-star row 4: < 60 s),
+     in-tick/deferred checksums x gated/straight-line variants
 
 Each phase is independently guarded; results stream as JSON lines and the
 combined dict lands in RESULTS_TPU_r04.json (TPU_MEASURE_OUT to override).
@@ -132,7 +136,7 @@ def phase_encode_impls(results: dict) -> None:
     stat = jnp.zeros((n, n), jnp.int32)
     inc = jnp.full((n, n), 1414142122274, jnp.int64)
     want = None
-    for impl in ("scatter", "gather"):
+    for impl in ("scatter", "gather", "gather2"):
         try:
             f = jax.jit(
                 lambda p, s, i, impl=impl: ce.membership_rows(
@@ -193,6 +197,23 @@ def phase_epidemic_100k(results: dict) -> None:
             ),
         }
         print(json.dumps({key: results[key]}), flush=True)
+
+
+def phase_convergence(results: dict) -> None:
+    """The reference's convergence-time scenarios on the chip
+    (benchmarks/convergence-time/scenario-runner.js:37-98 + scenarios/):
+    single-node-failure and half-cluster-failure at 1k, convergence =
+    all live checksums equal and fresh (scenario-runner.js:152-170);
+    reports the reference's histogram fields."""
+    from benchmarks.convergence_time import run_jax_sim
+
+    for scenario in ("single-node-failure", "half-cluster-failure"):
+        key = "convergence_%s" % scenario.replace("-", "_")
+        try:
+            results[key] = run_jax_sim(scenario, n=1024, cycles=10, seed=0)
+        except Exception as e:
+            results[key] = {"error": str(e)[:300]}
+        print(json.dumps({key: results.get(key)}), flush=True)
 
 
 def phase_storm_1m(results: dict) -> None:
@@ -297,6 +318,7 @@ def main() -> int:
         ("pallas_vs_scan", phase_pallas_vs_scan),
         ("encode_impls", phase_encode_impls),
         ("epidemic_100k", phase_epidemic_100k),
+        ("convergence", phase_convergence),
         ("storm_1m", phase_storm_1m),
     ):
         try:

@@ -150,11 +150,21 @@ def membership_rows(
     its cumsum offset — measured 4x faster than 'gather' on this image's
     CPU (713 vs 3048 ms for 1024 full 36 KB rows).  'gather' derives every
     output byte's source via searchsorted over the offset cumsum — no
-    scatter anywhere; kept as the TPU candidate (device scatters serialize
-    there) and A/B'd on hardware by benchmarks/tpu_measure.py."""
-    if impl == "gather":
+    scatter anywhere.  'gather2' replaces the per-byte binary search with
+    a start-indicator scatter + cumsum (O(1) member-of-byte), keeping
+    only [W]-sized table gathers — the TPU candidate (device scatters
+    AND searchsorted serialize there).  All three are A/B'd on hardware
+    by benchmarks/tpu_measure.py."""
+    if impl in ("gather", "gather2"):
         return _membership_rows_gather(
-            universe, present, status, incarnation, max_digits, width, chunk
+            universe,
+            present,
+            status,
+            incarnation,
+            max_digits,
+            width,
+            chunk,
+            member_of=("cumsum" if impl == "gather2" else "searchsorted"),
         )
     width = width or universe.member_row_width(max_digits)
     A = universe.addr_width
@@ -246,14 +256,22 @@ def _membership_rows_gather(
     max_digits: int = MAX_DIGITS,
     width: Optional[int] = None,
     chunk: int = 64,
+    member_of: str = "searchsorted",
 ):
     """Gather-form encoder: output byte b of a row belongs to the member
-    whose [offset, offset+seg_len) interval contains b (binary search over
-    the inclusive-cumsum of segment ends), then resolves to an address
-    byte, a status byte, an ASCII digit of the incarnation, or ';' from
-    its position within the segment.  No scatter anywhere — the scatter
-    formulation serializes on both CPU and TPU, and at 1k nodes the
-    encode (not the hash) dominated the parity-mode recompute."""
+    whose [offset, offset+seg_len) interval contains b, then resolves to
+    an address byte, a status byte, an ASCII digit of the incarnation, or
+    ';' from its position within the segment.  No byte-level scatter —
+    the scatter formulation serializes on both CPU and TPU, and at 1k
+    nodes the encode (not the hash) dominated the parity-mode recompute.
+
+    ``member_of`` picks how byte -> member is computed:
+    - 'searchsorted': binary search over the segment-end cumsum ([W]
+      searches of a [N] table per row);
+    - 'cumsum': scatter 1 at each present member's start offset ([N]
+      tiny scatter), prefix-sum over the row width, and map the rank
+      back through the present-members list — O(1) per byte, no search,
+      the TPU-friendly form."""
     width = width or universe.member_row_width(max_digits)
     A = universe.addr_width
     n = universe.n
@@ -276,10 +294,32 @@ def _membership_rows_gather(
             pres_i.sum() > 0
         ).astype(jnp.int32)
 
-        # member owning each byte: first m with ends[m] > b (empty
-        # segments have ends[m] == offset of the next, so they never win)
-        m = jnp.searchsorted(ends, b_pos, side="right").astype(jnp.int32)
-        mc = jnp.clip(m, 0, n - 1)
+        if member_of == "cumsum":
+            # rank r of byte b = (# present members starting at or
+            # before b) - 1; rank -> member via the compacted present
+            # list.  Identical to the binary search: the winner is the
+            # last present member with offset <= b (empty segments never
+            # place a start indicator).
+            starts = (
+                jnp.zeros(width + 1, jnp.int32)
+                .at[jnp.clip(offset, 0, width)]
+                .add(pres_i, mode="drop")
+            )
+            rank_of_byte = jnp.cumsum(starts[:width]) - 1  # [W]
+            prank = jnp.cumsum(pres_i) - 1  # present-member rank
+            rank_to_m = (
+                jnp.zeros(n, jnp.int32)
+                .at[jnp.where(pres, prank, n)]
+                .set(jnp.arange(n, dtype=jnp.int32), mode="drop")
+            )
+            mc = rank_to_m[jnp.clip(rank_of_byte, 0, n - 1)]
+        else:
+            # member owning each byte: first m with ends[m] > b (empty
+            # segments have ends[m] == offset of the next, never win)
+            m = jnp.searchsorted(ends, b_pos, side="right").astype(
+                jnp.int32
+            )
+            mc = jnp.clip(m, 0, n - 1)
         local = b_pos - offset[mc]
         al = addr_len[mc]
         sl = slen[mc]

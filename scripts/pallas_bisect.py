@@ -1,0 +1,166 @@
+#!/usr/bin/env python3
+"""Bisect the axon remote-compile failure on Pallas TPU kernels.
+
+The tunnel's compile helper has 500'd on ops/pallas_farmhash.py for two
+rounds (RESULTS_TPU_r03/r02).  This ladder compiles+runs progressively
+richer Pallas kernels on the chip to find the first failing feature:
+
+  1. copy        — single-program elementwise copy, no grid
+  2. grid1d      — 1-D grid, blocked row tiles
+  3. scratch     — + VMEM scratch carried across a 1-D grid axis
+  4. grid2d_when — + 2-D grid with pl.when init/flush (the real shape)
+  5. farmhash_tiny / 6. farmhash_bench — the real kernel
+
+Writes PALLAS_BISECT.json with pass/fail + error heads per rung.
+"""
+
+from __future__ import annotations
+
+import functools
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+OUT = os.environ.get("PALLAS_BISECT_OUT", "PALLAS_BISECT.json")
+
+
+def main() -> int:
+    from ringpop_tpu.utils.util import scrub_repo_pythonpath, wait_for_tpu
+
+    scrub_repo_pythonpath(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    )
+    import ringpop_tpu  # noqa: F401
+
+    wait_for_tpu(__file__, "PALLAS_BISECT_ATTEMPT", 90, 20.0)
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.experimental import pallas as pl
+
+    res = {"device": str(jax.devices()[0])}
+
+    def attempt(name, fn):
+        try:
+            out = fn()
+            jax.block_until_ready(out)
+            res[name] = {"ok": True}
+        except Exception as e:
+            res[name] = {"ok": False, "error": str(e)[:400]}
+        print(json.dumps({name: res[name]["ok"]}), flush=True)
+
+    x = jnp.arange(8 * 128, dtype=jnp.uint32).reshape(8, 128)
+
+    # 1. single-program copy
+    def copy_kernel(x_ref, o_ref):
+        o_ref[:] = x_ref[:] * jnp.uint32(3)
+
+    attempt(
+        "copy",
+        lambda: pl.pallas_call(
+            copy_kernel, out_shape=jax.ShapeDtypeStruct((8, 128), jnp.uint32)
+        )(x),
+    )
+
+    # 2. 1-D grid over row tiles
+    big = jnp.arange(64 * 128, dtype=jnp.uint32).reshape(64, 128)
+
+    def grid_kernel(x_ref, o_ref):
+        o_ref[:] = x_ref[:] + jnp.uint32(1)
+
+    attempt(
+        "grid1d",
+        lambda: pl.pallas_call(
+            grid_kernel,
+            grid=(8,),
+            in_specs=[pl.BlockSpec((8, 128), lambda i: (i, 0))],
+            out_specs=pl.BlockSpec((8, 128), lambda i: (i, 0)),
+            out_shape=jax.ShapeDtypeStruct((64, 128), jnp.uint32),
+        )(big),
+    )
+
+    # 3. scratch accumulator across a serial grid axis
+    def scratch_kernel(x_ref, o_ref, acc):
+        i = pl.program_id(0)
+
+        @pl.when(i == 0)
+        def _():
+            acc[:] = jnp.zeros_like(acc)
+
+        acc[:] += x_ref[:]
+
+        @pl.when(i == 7)
+        def _():
+            o_ref[:] = acc[:]
+
+    import jax.experimental.pallas.tpu as pltpu
+
+    attempt(
+        "scratch_when",
+        lambda: pl.pallas_call(
+            scratch_kernel,
+            grid=(8,),
+            in_specs=[pl.BlockSpec((8, 128), lambda i: (i, 0))],
+            out_specs=pl.BlockSpec((8, 128), lambda i: (0, 0)),
+            out_shape=jax.ShapeDtypeStruct((8, 128), jnp.uint32),
+            scratch_shapes=[pltpu.VMEM((8, 128), jnp.uint32)],
+        )(big),
+    )
+
+    # 4. 2-D grid with carries across the SECOND axis + pl.when — the
+    # real kernel's control shape, with a trivial body
+    def grid2d_kernel(x_ref, o_ref, acc):
+        j = pl.program_id(1)
+
+        @pl.when(j == 0)
+        def _():
+            acc[:] = jnp.zeros_like(acc)
+
+        acc[:] += x_ref[0]
+
+        @pl.when(j == 3)
+        def _():
+            o_ref[0] = acc[:]
+
+    big2 = jnp.arange(2 * 4 * 8 * 128, dtype=jnp.uint32).reshape(
+        2, 4, 8, 128
+    )
+    attempt(
+        "grid2d_when",
+        lambda: pl.pallas_call(
+            grid2d_kernel,
+            grid=(2, 4),
+            in_specs=[
+                pl.BlockSpec((1, 1, 8, 128), lambda i, j: (i, j, 0, 0))
+            ],
+            out_specs=pl.BlockSpec((1, 8, 128), lambda i, j: (i, 0, 0)),
+            out_shape=jax.ShapeDtypeStruct((2, 8, 128), jnp.uint32),
+            scratch_shapes=[pltpu.VMEM((8, 128), jnp.uint32)],
+        )(big2),
+    )
+
+    # 5/6. the real farmhash block loop, tiny then bench shape
+    from ringpop_tpu.ops import jax_farmhash as jfh
+
+    def hash_rows(n_rows, row_bytes):
+        rng = np.random.default_rng(0)
+        bufs = jnp.asarray(
+            rng.integers(32, 127, size=(n_rows, row_bytes), dtype=np.uint8)
+        )
+        lens = jnp.full((n_rows,), row_bytes, jnp.int32)
+        fn = jax.jit(functools.partial(jfh.hash32_rows, impl="pallas"))
+        return fn(bufs, lens)
+
+    attempt("farmhash_tiny", lambda: hash_rows(1024, 128))
+    attempt("farmhash_bench", lambda: hash_rows(1024, 36868))
+
+    with open(OUT, "w") as f:
+        json.dump(res, f, indent=1)
+    print(json.dumps(res))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

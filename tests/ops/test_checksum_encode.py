@@ -97,13 +97,18 @@ def test_gather_impl_matches_scatter_impl():
             u, jnp.asarray(pres), jnp.asarray(stat), jnp.asarray(inc),
             impl="scatter",
         )
-        # chunk=8 < B forces the lax.map chunked path in both impls
-        b = ce.membership_rows(
-            u, jnp.asarray(pres), jnp.asarray(stat), jnp.asarray(inc),
-            impl="gather", chunk=8,
-        )
-        la, lb = np.asarray(a[1]), np.asarray(b[1])
-        assert (la == lb).all()
-        ba, bb = np.asarray(a[0]), np.asarray(b[0])
-        for r in range(B):
-            assert (ba[r, : la[r]] == bb[r, : la[r]]).all(), (n, r)
+        # chunk=8 < B forces the lax.map chunked path in every impl
+        for impl in ("gather", "gather2"):
+            b = ce.membership_rows(
+                u, jnp.asarray(pres), jnp.asarray(stat), jnp.asarray(inc),
+                impl=impl, chunk=8,
+            )
+            la, lb = np.asarray(a[1]), np.asarray(b[1])
+            assert (la == lb).all(), impl
+            ba, bb = np.asarray(a[0]), np.asarray(b[0])
+            for r in range(B):
+                assert (ba[r, : la[r]] == bb[r, : la[r]]).all(), (
+                    impl,
+                    n,
+                    r,
+                )
