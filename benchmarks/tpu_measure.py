@@ -8,9 +8,10 @@ once and capture every chip-gated number in a single session —
   C. 100k-node epidemic broadcast, k=3 ping-req fanout, 5% packet loss
      (BASELINE.md north-star row 3: "runs in-jit on TPU"), gated and
      straight-line phase variants
-  D. convergence-time scenarios at 1k (single-node-failure and
+  D. batched 8x1k vmapped multi-cluster aggregate throughput
+  E. convergence-time scenarios at 1k (single-node-failure and
      half-cluster-failure; scenario-runner.js histogram fields)
-  E. 1M-node churn storm, 10% fail/rejoin (north-star row 4: < 60 s),
+  F. 1M-node churn storm, 10% fail/rejoin (north-star row 4: < 60 s),
      in-tick/deferred checksums x gated/straight-line variants
 
 Each phase is independently guarded; results stream as JSON lines and the
@@ -199,6 +200,37 @@ def phase_epidemic_100k(results: dict) -> None:
         print(json.dumps({key: results[key]}), flush=True)
 
 
+def phase_batched(results: dict) -> None:
+    """B independent 1k clusters as one vmapped program (the
+    TPU-utilization configuration; models/sim/batched.py) — aggregate
+    and per-cluster node-ticks/s."""
+    import time as _time
+
+    import jax
+    import numpy as np
+
+    from ringpop_tpu.models.sim.batched import BatchedSimClusters
+    from ringpop_tpu.models.sim.cluster import EventSchedule
+
+    b, n, ticks = 8, 1024, 32
+    bat = BatchedSimClusters(b=b, n=n, seed=0)
+    bat.bootstrap()
+    sched = EventSchedule(ticks=ticks, n=n)
+    bat.run(sched)  # compile + warm
+    jax.block_until_ready(bat.state)
+    t0 = _time.perf_counter()
+    ms = bat.run(sched)
+    jax.block_until_ready(bat.state)
+    dt = _time.perf_counter() - t0
+    results["batched_8x1k"] = {
+        "clusters": b,
+        "aggregate_node_ticks_per_sec": round(b * n * ticks / dt, 1),
+        "per_cluster_node_ticks_per_sec": round(n * ticks / dt, 1),
+        "converged": bool(np.asarray(ms.converged)[-1].all()),
+    }
+    print(json.dumps({"batched_8x1k": results["batched_8x1k"]}), flush=True)
+
+
 def phase_convergence(results: dict) -> None:
     """The reference's convergence-time scenarios on the chip
     (benchmarks/convergence-time/scenario-runner.js:37-98 + scenarios/):
@@ -318,6 +350,7 @@ def main() -> int:
         ("pallas_vs_scan", phase_pallas_vs_scan),
         ("encode_impls", phase_encode_impls),
         ("epidemic_100k", phase_epidemic_100k),
+        ("batched", phase_batched),
         ("convergence", phase_convergence),
         ("storm_1m", phase_storm_1m),
     ):

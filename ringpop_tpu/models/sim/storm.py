@@ -51,12 +51,26 @@ class StormSchedule:
             self.revive = np.zeros((self.ticks, self.n), bool)
 
     def as_inputs(self) -> es.ChurnInputs:
-        # leave stays None when unused: identical pytree to plain inputs
-        return es.ChurnInputs(
+        # leave stays None when unused: identical pytree to plain inputs.
+        # Device arrays memoized — a [60, 1M] bool pair is 120 MB of
+        # host->device transfer that must not repeat per run (the storm
+        # bench's warm-then-measure pattern).  The schedule is FROZEN at
+        # first use: mutate kill/revive/leave before running, or call
+        # invalidate() after mutating.
+        cached = getattr(self, "_device_inputs", None)
+        if cached is not None:
+            return cached
+        inputs = es.ChurnInputs(
             kill=jnp.asarray(self.kill),
             revive=jnp.asarray(self.revive),
             leave=None if self.leave is None else jnp.asarray(self.leave),
         )
+        self._device_inputs = inputs
+        return inputs
+
+    def invalidate(self) -> None:
+        """Drop the memoized device inputs after mutating the schedule."""
+        self._device_inputs = None
 
     @staticmethod
     def churn_storm(

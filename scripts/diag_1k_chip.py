@@ -1,0 +1,113 @@
+#!/usr/bin/env python3
+"""Round-4 chip diagnosis: (A) where the 1k fast scan's wall time goes
+now that device compute collapsed (~15 ms device vs ~1.4 s wall per
+32-tick run — per-run transport/launch overhead suspected), and (B)
+which ingredient of the parity-mode graph trips the tunnel's
+remote-compile helper 500 (deterministic across 12+ attempts at 1k
+while n=64 parity compiled fine in round 3).
+
+Writes DIAG_1K.json.
+"""
+
+from __future__ import annotations
+
+import functools
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+OUT = os.environ.get("DIAG_1K_OUT", "DIAG_1K.json")
+
+
+def main() -> int:
+    from ringpop_tpu.utils.util import scrub_repo_pythonpath, wait_for_tpu
+
+    scrub_repo_pythonpath(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    )
+    import ringpop_tpu  # noqa: F401
+
+    wait_for_tpu(__file__, "DIAG_1K_ATTEMPT", 90, 20.0)
+    import jax
+    import numpy as np
+
+    from ringpop_tpu.models.sim import engine
+    from ringpop_tpu.models.sim.cluster import EventSchedule, SimCluster
+
+    res = {"device": str(jax.devices()[0])}
+
+    # ---- A: wall-time decomposition of the fast scan -------------------
+    n = 1024
+    for ticks in (32, 256):
+        sim = SimCluster(
+            n=n, params=engine.SimParams(n=n, checksum_mode="fast")
+        )
+        sim.bootstrap()
+        sched = EventSchedule(ticks=ticks, n=n)
+        sim.run(sched)  # compile + warm (uploads + memoizes inputs)
+        jax.block_until_ready(sim.state)
+        walls = []
+        for _ in range(5):
+            t0 = time.perf_counter()
+            sim.run(sched)
+            jax.block_until_ready(sim.state)
+            walls.append(time.perf_counter() - t0)
+        res["fast_scan_%dticks" % ticks] = {
+            "wall_s_runs": [round(w, 3) for w in walls],
+            "best_node_ticks_per_sec": round(n * ticks / min(walls), 1),
+        }
+        print(
+            json.dumps({("fast_%d" % ticks): res["fast_scan_%dticks" % ticks]}),
+            flush=True,
+        )
+
+    # ---- B: parity-graph compile bisect --------------------------------
+    from ringpop_tpu.ops import checksum_encode as ce
+
+    def attempt(name, fn):
+        try:
+            t0 = time.perf_counter()
+            out = fn()
+            jax.block_until_ready(out)
+            res[name] = {"ok": True, "s": round(time.perf_counter() - t0, 2)}
+        except Exception as e:
+            res[name] = {"ok": False, "error": str(e)[:300]}
+        print(json.dumps({name: res[name]}), flush=True)
+
+    def parity_sim(ticks, **pkw):
+        params = engine.SimParams(n=n, checksum_mode="farmhash", **pkw)
+        sim = SimCluster(n=n, params=params)
+        sim.bootstrap()
+        sched = EventSchedule(ticks=ticks, n=n)
+        m = sim.run(sched)
+        return sim.state.checksum
+
+    # one non-scanned parity tick
+    def parity_single_tick():
+        params = engine.SimParams(n=n, checksum_mode="farmhash")
+        sim = SimCluster(n=n, params=params)
+        sim.bootstrap()  # bootstrap itself runs one jitted parity tick
+        return sim.state.checksum
+
+    attempt("parity_single_tick", parity_single_tick)
+    attempt("parity_scan4", lambda: parity_sim(4))
+    attempt("parity_scan32", lambda: parity_sim(32))
+    attempt(
+        "parity_scan32_dirty64", lambda: parity_sim(32, dirty_batch=64)
+    )
+    attempt(
+        "parity_scan32_nogate",
+        lambda: parity_sim(32, gate_phases=False),
+    )
+
+    with open(OUT, "w") as f:
+        json.dump(res, f, indent=1)
+    print(json.dumps(res))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
