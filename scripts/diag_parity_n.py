@@ -1,0 +1,118 @@
+#!/usr/bin/env python3
+"""Parity-compile bisect #2: which axis breaks the tunnel's compile
+helper — cluster size, or a specific parity component at 1k?
+
+Rungs: parity single tick at n=128/256/512/768/1024, then at the first
+failing n, the isolated pieces (full farmhash compute_checksums,
+membership_rows encode, hash32_rows) to finger the component.
+Writes DIAG_PARITY_N.json.
+"""
+
+from __future__ import annotations
+
+import functools
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+OUT = os.environ.get("DIAG_PARITY_N_OUT", "DIAG_PARITY_N.json")
+
+
+def main() -> int:
+    from ringpop_tpu.utils.util import scrub_repo_pythonpath, wait_for_tpu
+
+    scrub_repo_pythonpath(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    )
+    import ringpop_tpu  # noqa: F401
+
+    wait_for_tpu(__file__, "DIAG_PARITY_N_ATTEMPT", 90, 20.0)
+    import jax
+    import numpy as np
+
+    from ringpop_tpu.models.sim import engine
+    from ringpop_tpu.models.sim.cluster import SimCluster
+
+    res = {"device": str(jax.devices()[0])}
+
+    def attempt(name, fn):
+        try:
+            t0 = time.perf_counter()
+            out = fn()
+            jax.block_until_ready(out)
+            res[name] = {"ok": True, "s": round(time.perf_counter() - t0, 2)}
+        except Exception as e:
+            res[name] = {"ok": False, "error": str(e)[:200]}
+        print(json.dumps({name: res[name]}), flush=True)
+
+    def one_parity_tick(n):
+        sim = SimCluster(
+            n=n, params=engine.SimParams(n=n, checksum_mode="farmhash")
+        )
+        sim.bootstrap()
+        return sim.state.checksum
+
+    first_fail = None
+    for n in (128, 256, 512, 768, 1024):
+        attempt("parity_tick_n%d" % n, functools.partial(one_parity_tick, n))
+        if first_fail is None and not res["parity_tick_n%d" % n]["ok"]:
+            first_fail = n
+
+    # isolate components at 1k (or the first failing n)
+    n = first_fail or 1024
+    from ringpop_tpu.models.sim.cluster import default_addresses
+    from ringpop_tpu.ops import checksum_encode as ce
+    from ringpop_tpu.ops import jax_farmhash as jfh
+
+    params = engine.SimParams(n=n, checksum_mode="farmhash")
+    universe = ce.Universe.from_addresses(default_addresses(n))
+    state = engine.init_state(params, seed=0, universe=universe)
+
+    attempt(
+        "compute_checksums_full_n%d" % n,
+        lambda: jax.jit(
+            lambda s: engine.compute_checksums(s, universe, params)
+        )(state),
+    )
+
+    # the dirty-batch bounded recompute path in isolation
+    import jax.numpy as jnp
+
+    dirty = jnp.zeros(n, bool).at[3].set(True)
+
+    attempt(
+        "checksums_where_n%d" % n,
+        lambda: jax.jit(
+            lambda s, d: engine._checksums_where(
+                s, universe, params, d, s.checksum
+            )
+        )(state, dirty),
+    )
+
+    # fast tick at same n (control: should compile)
+    attempt(
+        "fast_tick_n%d" % n,
+        functools.partial(
+            lambda n: (
+                lambda sim: (sim.bootstrap(), sim.state.checksum)[1]
+            )(
+                SimCluster(
+                    n=n,
+                    params=engine.SimParams(n=n, checksum_mode="fast"),
+                )
+            ),
+            n,
+        ),
+    )
+
+    with open(OUT, "w") as f:
+        json.dump(res, f, indent=1)
+    print(json.dumps(res))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
