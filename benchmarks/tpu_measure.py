@@ -47,9 +47,14 @@ def phase_headline(results: dict) -> None:
 
     from ringpop_tpu.models.sim import engine
     from ringpop_tpu.models.sim.cluster import EventSchedule, SimCluster
+    from ringpop_tpu.utils.util import retry_compile_helper
 
-    n, ticks = 1024, 32
-    for mode in ("fast", "farmhash"):
+    # 256-tick window, same as bench.py: the tunnel charges ~0.9 s per
+    # execution regardless of scan length (DIAG_1K.json), so a 32-tick
+    # window measures the tunnel, not the engine
+    n, ticks = 1024, 256
+
+    def one_mode(mode):
         sim = SimCluster(n=n, params=engine.SimParams(n=n, checksum_mode=mode))
         sim.bootstrap()
         sched = EventSchedule(ticks=ticks, n=n)
@@ -59,12 +64,22 @@ def phase_headline(results: dict) -> None:
         metrics = sim.run(sched)
         jax.block_until_ready(sim.state)
         dt = time.perf_counter() - t0
-        results["headline_%s" % mode] = {
+        return {
             "node_ticks_per_sec": round(n * ticks / dt, 1),
             "ms_per_tick": round(dt / ticks * 1e3, 2),
             "vs_realtime_baseline": round((n * ticks / dt) / (n * 5.0), 2),
             "converged": bool(np.asarray(metrics.converged)[-1]),
         }
+
+    # per-mode capture with compile-helper-500 retries: a parity 500 must
+    # not erase the fast number (nor vice versa) — the round-3 regression
+    for mode in ("fast", "farmhash"):
+        key = "headline_%s" % mode
+        try:
+            results[key] = retry_compile_helper(one_mode, mode)
+        except Exception as e:
+            results[key] = {"error": str(e)[:300]}
+        print(json.dumps({key: results[key]}), flush=True)
 
 
 def phase_pallas_vs_scan(results: dict) -> None:
@@ -93,15 +108,28 @@ def phase_pallas_vs_scan(results: dict) -> None:
     )
     bufs = jax.block_until_ready(bufs)
     row_bytes = int(bufs.shape[1])
+    # the tunnel memoizes identical (executable, inputs) executions
+    # (RESULTS.md round 4: a repeat-N loop on unchanged buffers reports
+    # 0.03 ms / 1.5 TB/s apparent) — salt one byte per rep so every
+    # execution does real work
+    import jax.numpy as jnp
+
+    salts = [jnp.asarray(np.array([i], np.uint8)) for i in range(16)]
     want = None
-    for impl in ("scan", "pallas"):
+    for impl in ("scan", "pallas", "pallas_nogrid"):
         try:
-            fn = jax.jit(functools.partial(jfh.hash32_rows, impl=impl))
-            out = jax.block_until_ready(fn(bufs, lens))
+
+            def run(b, salt, impl=impl):
+                return jfh.hash32_rows(
+                    b.at[0, 0].set(salt[0]), lens, impl=impl
+                )
+
+            fn = jax.jit(run)  # bufs passed as an arg, not a baked const
+            out = jax.block_until_ready(fn(bufs, salts[-1]))
             t0 = time.perf_counter()
             reps = 10
-            for _ in range(reps):
-                out = fn(bufs, lens)
+            for r in range(reps):
+                out = fn(bufs, salts[r])
             out = jax.block_until_ready(out)
             dt = (time.perf_counter() - t0) / reps
             if want is None:
@@ -136,6 +164,9 @@ def phase_encode_impls(results: dict) -> None:
     pres = jnp.ones((n, n), bool)
     stat = jnp.zeros((n, n), jnp.int32)
     inc = jnp.full((n, n), 1414142122274, jnp.int64)
+    # salt one incarnation per rep — see phase_pallas_vs_scan on the
+    # tunnel's identical-execution cache
+    base = 1414142122274
     want = None
     for impl in ("scatter", "gather", "gather2"):
         try:
@@ -146,8 +177,9 @@ def phase_encode_impls(results: dict) -> None:
             )
             out = jax.block_until_ready(f(pres, stat, inc))
             t0 = time.perf_counter()
-            for _ in range(5):
-                out = f(pres, stat, inc)
+            for r in range(5):
+                # r+1: salt 0 would reproduce the warm-up input exactly
+                out = f(pres, stat, inc.at[0, 0].set(base + 200 * (r + 1)))
             out = jax.block_until_ready(out)
             dt = (time.perf_counter() - t0) / 5
             if want is None:
@@ -212,7 +244,9 @@ def phase_batched(results: dict) -> None:
     from ringpop_tpu.models.sim.batched import BatchedSimClusters
     from ringpop_tpu.models.sim.cluster import EventSchedule
 
-    b, n, ticks = 8, 1024, 32
+    # 256-tick window like phase_headline/bench.py: a 32-tick single
+    # execution is dominated by the tunnel's flat ~0.9 s per-execution tax
+    b, n, ticks = 8, 1024, 256
     bat = BatchedSimClusters(b=b, n=n, seed=0)
     bat.bootstrap()
     sched = EventSchedule(ticks=ticks, n=n)
@@ -285,10 +319,14 @@ def phase_storm_1m(results: dict) -> None:
 
                 # warm wall-clock: min of 2 full runs (tunnel background
                 # load swings single runs by tens of percent; the round-3
-                # artifact even recorded warm > cold)
+                # artifact even recorded warm > cold).  Distinct seeds per
+                # run: with the shared executable cache, seed=0 would make
+                # every warm run the identical (executable, inputs) pair
+                # the tunnel is known to memoize (RESULTS.md round 4) —
+                # the work per seed is statistically identical
                 warms = []
-                for _ in range(2):
-                    cluster2 = ScalableCluster(n=n, params=params, seed=0)
+                for r in range(2):
+                    cluster2 = ScalableCluster(n=n, params=params, seed=r + 1)
                     t0 = time.perf_counter()
                     metrics = cluster2.run(sched)
                     if in_tick:
@@ -316,6 +354,20 @@ def phase_storm_1m(results: dict) -> None:
             except Exception as e:
                 results[key] = {"error": str(e)[:300]}
             print(json.dumps({key: results.get(key)}), flush=True)
+
+
+def _drop_executables() -> None:
+    """Release each phase's compiled programs (the shared lru_caches pin
+    them for process life otherwise — four distinct 1M-node storm
+    programs by the final phase)."""
+    for mod in ("cluster", "batched", "storm"):
+        try:
+            m = __import__(
+                "ringpop_tpu.models.sim.%s" % mod, fromlist=[mod]
+            )
+            m.clear_executable_cache()
+        except Exception:
+            pass  # a phase that never imported the module
 
 
 def main() -> int:
@@ -358,6 +410,7 @@ def main() -> int:
             fn(results)
         except Exception as e:
             results["%s_error" % name] = str(e)[:400]
+        _drop_executables()
         print(json.dumps({name: "done"}), flush=True)
 
     with open(OUT_PATH, "w") as f:

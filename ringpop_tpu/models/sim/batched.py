@@ -34,6 +34,29 @@ from ringpop_tpu.models.sim.cluster import EventSchedule, default_addresses
 from ringpop_tpu.ops import checksum_encode as ce
 
 
+@functools.lru_cache(maxsize=None)
+def _vtick_fn(params: engine.SimParams, universe: ce.Universe):
+    step = functools.partial(engine.tick, params=params, universe=universe)
+    return jax.jit(jax.vmap(step, in_axes=(0, None)))
+
+
+@functools.lru_cache(maxsize=None)
+def _vscanned_fn(params: engine.SimParams, universe: ce.Universe):
+    step = functools.partial(engine.tick, params=params, universe=universe)
+    vstep = jax.vmap(step, in_axes=(0, None))
+
+    @jax.jit
+    def _scanned(state, inputs):
+        return jax.lax.scan(vstep, state, inputs)
+
+    return _scanned
+
+
+def clear_executable_cache() -> None:
+    _vtick_fn.cache_clear()
+    _vscanned_fn.cache_clear()
+
+
 class BatchedSimClusters:
     def __init__(
         self,
@@ -45,8 +68,12 @@ class BatchedSimClusters:
         self.b, self.n = b, n
         addresses = default_addresses(n)
         self.universe = ce.Universe.from_addresses(addresses)
+        from ringpop_tpu.models.sim.cluster import _resolve_hash_impl
+
         base = params or engine.SimParams(n=n, checksum_mode="fast")
-        self.params = base._replace(n=n, gate_phases=False)
+        self.params = _resolve_hash_impl(
+            base._replace(n=n, gate_phases=False)
+        )
         states: List[engine.SimState] = [
             engine.init_state(self.params, seed=seed + i, universe=self.universe)
             for i in range(b)
@@ -54,17 +81,9 @@ class BatchedSimClusters:
         # [B, ...] leading axis on every state field
         self.state = jax.tree.map(lambda *xs: jnp.stack(xs), *states)
 
-        step = functools.partial(
-            engine.tick, params=self.params, universe=self.universe
-        )
-        vstep = jax.vmap(step, in_axes=(0, None))
-
-        @jax.jit
-        def _scanned(state, inputs):
-            return jax.lax.scan(vstep, state, inputs)
-
-        self._scanned = _scanned
-        self._vtick = jax.jit(vstep)
+        # shared per-(params, universe) executables, as in SimCluster
+        self._scanned = _vscanned_fn(self.params, self.universe)
+        self._vtick = _vtick_fn(self.params, self.universe)
 
     def bootstrap(self) -> engine.TickMetrics:
         inputs = engine.TickInputs.quiet(self.n)._replace(

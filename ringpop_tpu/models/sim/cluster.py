@@ -84,6 +84,48 @@ class EventSchedule:
         object.__setattr__(self, "_device_inputs", None)
 
 
+def _resolve_hash_impl(params: engine.SimParams) -> engine.SimParams:
+    """Pin ``hash_impl="env"`` to the CONCRETE lowering at construction.
+
+    The RINGPOP_TPU_PALLAS toggle is otherwise read at trace time inside
+    engine.tick's checksum path; with shared executable caches that read
+    would race with toggles between construction and first call, silently
+    serving a pre-toggle executable (or poisoning the cache with a
+    post-toggle trace under the pre-toggle key)."""
+    if params.hash_impl != "env":
+        return params
+    from ringpop_tpu.ops.jax_farmhash import _impl_from_env
+
+    return params._replace(hash_impl=_impl_from_env())
+
+
+@functools.lru_cache(maxsize=None)
+def _tick_fn(params: engine.SimParams, universe: ce.Universe):
+    return jax.jit(
+        functools.partial(engine.tick, params=params, universe=universe)
+    )
+
+
+@functools.lru_cache(maxsize=None)
+def _scanned_fn(params: engine.SimParams, universe: ce.Universe):
+    @jax.jit
+    def _scanned(state, inputs):
+        def body(st, inp):
+            st, m = engine.tick(st, inp, params, universe)
+            return st, m
+
+        return jax.lax.scan(body, state, inputs)
+
+    return _scanned
+
+
+def clear_executable_cache() -> None:
+    """Drop the shared compiled executables (e.g. between sweep phases —
+    a 1M-node program pins ~55 s of compile output until cleared)."""
+    _tick_fn.cache_clear()
+    _scanned_fn.cache_clear()
+
+
 class SimCluster:
     def __init__(
         self,
@@ -101,22 +143,13 @@ class SimCluster:
         self.params = params or engine.SimParams(n=n)
         if self.params.n != n:
             self.params = self.params._replace(n=n)
+        self.params = _resolve_hash_impl(self.params)
         self.state = engine.init_state(self.params, seed=seed, universe=self.universe)
-        self._tick = jax.jit(
-            functools.partial(
-                engine.tick, params=self.params, universe=self.universe
-            )
-        )
-
-        @jax.jit
-        def _scanned(state, inputs):
-            def body(st, inp):
-                st, m = engine.tick(st, inp, self.params, self.universe)
-                return st, m
-
-            return jax.lax.scan(body, state, inputs)
-
-        self._scanned = _scanned  # compiled once; reused by every run()
+        # shared per-(params, universe) executables — a fresh SimCluster
+        # over the same config reuses the compiled tick/scan instead of
+        # re-tracing (Universe hashes by its address tuple)
+        self._tick = _tick_fn(self.params, self.universe)
+        self._scanned = _scanned_fn(self.params, self.universe)
 
     # -- lifecycle --------------------------------------------------------
 

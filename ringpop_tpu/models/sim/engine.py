@@ -109,6 +109,13 @@ class SimParams(NamedTuple):
     # the throughput mode; the serial 20-byte FarmHash block walk over a
     # ~40KB string per node per tick is the single hottest op otherwise.
     checksum_mode: str = "farmhash"
+    # FarmHash block-loop lowering for the in-tick checksum hash:
+    # "env" = read RINGPOP_TPU_PALLAS when the tick TRACES (the direct-
+    # engine default), or an explicit jax_farmhash impl name.  SimCluster
+    # resolves "env" to the concrete impl at construction so the shared
+    # executable caches key on it — a trace-time env read would race with
+    # toggles between construction and first call.
+    hash_impl: str = "env"
     # True: rare phases (revive, rejoin, join, reshuffle, piggyback,
     # apply, responses, ping-req, expiry) run under lax.cond and cost
     # nothing on ticks with nothing to do — the right call on CPU, where
@@ -365,7 +372,12 @@ def compute_checksums(state: SimState, universe: ce.Universe, params: SimParams)
         stamp_to_ms(state.inc, params),  # int64 only inside this branch
         max_digits=params.max_digits,
     )
-    return jfh.hash32_rows(bufs, lens)
+    return jfh.hash32_rows(bufs, lens, impl=_hash_impl(params))
+
+
+def _hash_impl(params: SimParams):
+    """None = let hash32_rows read RINGPOP_TPU_PALLAS at trace time."""
+    return None if params.hash_impl == "env" else params.hash_impl
 
 
 def _checksums_where(
@@ -414,7 +426,7 @@ def _checksums_where(
             stamp_to_ms(state.inc[idx], params),
             max_digits=params.max_digits,
         )
-        fresh = jfh.hash32_rows(bufs, lens)
+        fresh = jfh.hash32_rows(bufs, lens, impl=_hash_impl(params))
         tgt = jnp.where(lane_ok, idx, params.n)  # n drops
         return cached.at[tgt].set(fresh, mode="drop")
 

@@ -120,6 +120,46 @@ def ring_checksum(ring: jax.Array) -> jax.Array:
     return jnp.sum(mixed, dtype=jnp.uint32)
 
 
+@functools.lru_cache(maxsize=None)
+def _tick_fn(params: es.ScalableParams):
+    return jax.jit(functools.partial(es.tick, params=params))
+
+
+@functools.lru_cache(maxsize=None)
+def _scanned_fn(params: es.ScalableParams):
+    @jax.jit
+    def _scanned(state, inputs):
+        def body(st, inp):
+            return es.tick(st, inp, params)
+
+        return jax.lax.scan(body, state, inputs)
+
+    return _scanned
+
+
+def clear_executable_cache() -> None:
+    """Drop the shared compiled executables (a 1M-node storm program pins
+    ~55 s of compile output until cleared).  The scalable engine has no
+    env-read trace inputs, so params alone keys these caches."""
+    _tick_fn.cache_clear()
+    _scanned_fn.cache_clear()
+    _ring_checksum_fn.cache_clear()
+
+
+@functools.lru_cache(maxsize=None)
+def _ring_checksum_fn(n: int, replica_points: int):
+    @jax.jit
+    def _ring_and_checksum(truth_status, proc_alive):
+        # alive + suspect members stay in the ring
+        # (on_membership_event.js:106-134 keeps alive/suspect servers)
+        in_ring = proc_alive & (truth_status <= es.SUSPECT)
+        reps = device_replica_hashes(n, replica_points)
+        ring = build_ring(reps, in_ring)
+        return ring_checksum(ring)
+
+    return _ring_and_checksum
+
+
 class ScalableCluster:
     def __init__(
         self,
@@ -133,27 +173,16 @@ class ScalableCluster:
             self.params = self.params._replace(n=n)
         self.replica_points = replica_points
         self.state = es.init_state(self.params, seed=seed)
-        self._tick = jax.jit(functools.partial(es.tick, params=self.params))
-
-        @jax.jit
-        def _scanned(state, inputs):
-            def body(st, inp):
-                return es.tick(st, inp, self.params)
-
-            return jax.lax.scan(body, state, inputs)
-
-        self._scanned = _scanned
-
-        @jax.jit
-        def _ring_and_checksum(truth_status, proc_alive):
-            # alive + suspect members stay in the ring
-            # (on_membership_event.js:106-134 keeps alive/suspect servers)
-            in_ring = proc_alive & (truth_status <= es.SUSPECT)
-            reps = device_replica_hashes(self.params.n, self.replica_points)
-            ring = build_ring(reps, in_ring)
-            return ring_checksum(ring)
-
-        self._ring_checksum = _ring_and_checksum
+        # module-level lru_cache keyed by the (hashable) params: every
+        # instance with the same params shares ONE traced+compiled
+        # executable.  A 1M-node storm compile costs ~55 s through the
+        # tunnel; per-instance @jax.jit made the bench's warm run (a fresh
+        # cluster) recompile the identical program.
+        self._tick = _tick_fn(self.params)
+        self._scanned = _scanned_fn(self.params)
+        self._ring_checksum = _ring_checksum_fn(
+            self.params.n, self.replica_points
+        )
 
     def step(self, inputs: Optional[es.ChurnInputs] = None):
         if inputs is None:

@@ -52,18 +52,30 @@ MAX_DIGITS = 19  # int64 decimal digits
 _POW10 = np.array([10**k for k in range(MAX_DIGITS)], dtype=np.int64)
 
 
-@dataclasses.dataclass(frozen=True)
+@dataclasses.dataclass(frozen=True, eq=False)
 class Universe:
     """Static, lexicographically sorted address universe of a simulation.
 
     ``addresses[i]`` is node i's identity; all device arrays indexed by node
     use this order, which equals checksum-string member order (the JS sort at
     membership/index.js:101-110 over ASCII host:port strings is bytewise).
+
+    Equality/hash key on ``addresses`` alone (the byte matrix and lengths
+    are derived from it), so universes can key jit-executable caches: two
+    clusters over the same address list share one compiled program.
     """
 
     addresses: tuple
     addr_bytes: np.ndarray  # [N, A] uint8, zero-padded
     addr_len: np.ndarray  # [N] int32
+
+    def __eq__(self, other):
+        return (
+            isinstance(other, Universe) and self.addresses == other.addresses
+        )
+
+    def __hash__(self):
+        return hash(self.addresses)
 
     @staticmethod
     def from_addresses(addresses: Sequence[str]) -> "Universe":

@@ -38,12 +38,18 @@ MAGIC = np.uint32(0xE6546B64)
 
 
 def _impl_from_env() -> str:
-    """Block-loop implementation: 'pallas' (opt-in via RINGPOP_TPU_PALLAS=1;
-    interpret mode off-TPU so tests validate the kernel everywhere) or the
-    default 'scan' lowering."""
+    """Block-loop implementation: 'pallas' (opt-in via RINGPOP_TPU_PALLAS=1),
+    'pallas_nogrid' (RINGPOP_TPU_PALLAS=nogrid — the gridless variant the
+    axon tunnel's compile helper accepts; interpret mode off-TPU so tests
+    validate the kernels everywhere) or the default 'scan' lowering."""
     import os
 
-    return "pallas" if os.environ.get("RINGPOP_TPU_PALLAS", "") == "1" else "scan"
+    val = os.environ.get("RINGPOP_TPU_PALLAS", "")
+    if val == "1":
+        return "pallas"
+    if val == "nogrid":
+        return "pallas_nogrid"
+    return "scan"
 
 
 def _rot(x: jax.Array, r: int) -> jax.Array:
@@ -169,11 +175,16 @@ def _hash_long(
     if w.shape[1] < need:
         w = jnp.pad(w, ((0, 0), (0, need - w.shape[1])))
 
-    if impl == "pallas":
+    if impl in ("pallas", "pallas_nogrid"):
         from ringpop_tpu.ops import pallas_farmhash
 
         blocks_bi5 = w[:, :need].reshape(w.shape[0], max_iters, 5)
-        h, g, f = pallas_farmhash.block_loop(
+        loop = (
+            pallas_farmhash.block_loop_nogrid
+            if impl == "pallas_nogrid"
+            else pallas_farmhash.block_loop
+        )
+        h, g, f = loop(
             h,
             g,
             f,

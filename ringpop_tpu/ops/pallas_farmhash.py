@@ -141,3 +141,118 @@ def block_loop(h0, g0, f0, blocks, iters, *, interpret: bool = False):
     )
     h, g, f = (x.reshape(bp)[:B] for x in out)
     return h, g, f
+
+
+def _nogrid_kernel(blk_ref, act_ref, h0_ref, g0_ref, f0_ref,
+                   oh_ref, og_ref, of_ref):
+    """One gridless call = ``chunk`` mixing rounds over a [S, LANE] row
+    tile, carries entering/leaving as plain operands."""
+
+    def body(k, carry):
+        h, g, f = carry
+        a = blk_ref[k, 0]
+        b = blk_ref[k, 1]
+        c = blk_ref[k, 2]
+        d = blk_ref[k, 3]
+        e = blk_ref[k, 4]
+        nh = h + a
+        ng = g + b
+        nf = f + c
+        nh = _mur(d, nh) + e
+        ng = _mur(c, ng) + a
+        nf = _mur(b + e * C1, nf) + d
+        nf = nf + ng
+        ng = ng + nf
+        act = act_ref[k] != 0
+        return (
+            jnp.where(act, nh, h),
+            jnp.where(act, ng, g),
+            jnp.where(act, nf, f),
+        )
+
+    h, g, f = jax.lax.fori_loop(
+        0, blk_ref.shape[0], body, (h0_ref[:], g0_ref[:], f0_ref[:])
+    )
+    oh_ref[:] = h
+    og_ref[:] = g
+    of_ref[:] = f
+
+
+def block_loop_nogrid(
+    h0, g0, f0, blocks, iters, *, chunk: int = 64, interpret: bool = False
+):
+    """Gridless variant of :func:`block_loop` for the axon tunnel, whose
+    remote-compile helper deterministically 500s on ANY grid'd Pallas
+    kernel while compiling gridless ones fine (PALLAS_BISECT.json: `copy`/
+    `nogrid_*` ok, every `grid*` rung and the grid'd farmhash fail).
+
+    The iteration axis moves out of the Pallas grid into an outer XLA
+    ``lax.scan``; each scan step is ONE gridless pallas_call running
+    ``chunk`` mixing rounds via an in-kernel ``fori_loop`` with the whole
+    [chunk, 5, S, LANE] block slab resident in VMEM.  Same signature and
+    bit-exact results as :func:`block_loop`.
+    """
+    from jax.experimental import pallas as pl
+
+    B, max_iters, five = blocks.shape
+    assert five == 5
+    pad = (-B) % TILE
+    if pad:
+        h0 = jnp.pad(h0, (0, pad))
+        g0 = jnp.pad(g0, (0, pad))
+        f0 = jnp.pad(f0, (0, pad))
+        blocks = jnp.pad(blocks, ((0, pad), (0, 0), (0, 0)))
+        iters = jnp.pad(iters, (0, pad))
+    bp = B + pad
+    s = bp // LANE  # sublane count; TILE-padding keeps it a multiple of 8
+
+    # keep the per-call VMEM slab (chunk * 5 * S * LANE u32 words + the
+    # uint8 mask) within a few MiB as the row count grows, and never pad
+    # the iteration axis past the actual trip count
+    chunk = max(1, min(chunk, max_iters))
+    while chunk > 1 and chunk * 5 * s * LANE * 4 > 8 * 1024 * 1024:
+        chunk //= 2
+    ipad = (-max_iters) % chunk
+    if ipad:
+        blocks = jnp.pad(blocks, ((0, 0), (0, ipad), (0, 0)))
+    n_iter = max_iters + ipad
+    steps = n_iter // chunk
+
+    # [B, I, 5] -> [steps, chunk, 5, S, LANE]
+    slabs = (
+        blocks.reshape(s, LANE, n_iter, 5)
+        .transpose(2, 3, 0, 1)
+        .reshape(steps, chunk, 5, s, LANE)
+    )
+    # active mask per iteration: i < iters  (uint8: TPU Pallas vector
+    # loads want a byte-addressable dtype, not i1)
+    it2d = iters.astype(jnp.int32).reshape(s, LANE)
+    idx = jnp.arange(n_iter, dtype=jnp.int32)
+    acts = (
+        (idx[:, None, None] < it2d[None])
+        .astype(jnp.uint8)
+        .reshape(steps, chunk, s, LANE)
+    )
+
+    call = pl.pallas_call(
+        _nogrid_kernel,
+        out_shape=[
+            jax.ShapeDtypeStruct((s, LANE), jnp.uint32) for _ in range(3)
+        ],
+        interpret=interpret,
+    )
+
+    def rows(x):
+        return x.reshape(s, LANE)
+
+    def step(carry, x):
+        slab, act = x
+        h, g, f = carry
+        h, g, f = call(slab, act, h, g, f)
+        return (h, g, f), None
+
+    (h, g, f), _ = jax.lax.scan(
+        step, (rows(h0), rows(g0), rows(f0)), (slabs, acts)
+    )
+    h, g, f = (x.reshape(bp)[:B] for x in (h, g, f))
+    return h, g, f

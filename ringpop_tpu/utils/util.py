@@ -172,6 +172,30 @@ def reexec_retry(env_var: str, retries: int, sleep_s: float, script: str):
     )
 
 
+def retry_compile_helper(fn, *args, backoffs=(0.0, 10.0, 25.0), **kwargs):
+    """Call ``fn`` with backoff retries for axon remote-compile-helper
+    500s ONLY (the tunnel's compile helper fails intermittently on graphs
+    that compile fine seconds later — the round-3 artifact lost its
+    parity headline to a single such 500).  Any other error re-raises
+    immediately: those are real graph/engine failures."""
+    import time
+
+    exc = None
+    for backoff in backoffs:
+        if backoff:
+            time.sleep(backoff)
+        try:
+            return fn(*args, **kwargs)
+        except Exception as e:
+            exc = e
+            msg = str(e)
+            if not (
+                "remote_compile" in msg or "tpu_compile_helper" in msg
+            ):
+                raise
+    raise exc
+
+
 def pin_cpu_platform(n_devices=None) -> None:
     """Clear any live JAX backends and force the CPU platform (optionally
     with ``n_devices`` virtual devices).

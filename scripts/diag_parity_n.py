@@ -31,7 +31,6 @@ def main() -> int:
 
     wait_for_tpu(__file__, "DIAG_PARITY_N_ATTEMPT", 90, 20.0)
     import jax
-    import numpy as np
 
     from ringpop_tpu.models.sim import engine
     from ringpop_tpu.models.sim.cluster import SimCluster

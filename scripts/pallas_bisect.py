@@ -118,7 +118,7 @@ def main() -> int:
         def _():
             acc[:] = jnp.zeros_like(acc)
 
-        acc[:] += x_ref[0]
+        acc[:] += x_ref[0, 0]
 
         @pl.when(j == 3)
         def _():
@@ -140,6 +140,61 @@ def main() -> int:
             scratch_shapes=[pltpu.VMEM((8, 128), jnp.uint32)],
         )(big2),
     )
+
+    # 4b-4d. GRIDLESS workaround rungs: the round-4 run showed `copy`
+    # (no grid) compiles on the tunnel while every grid'd kernel 500s —
+    # so probe the features a gridless farmhash block loop needs.
+    def round_kernel(h_ref, g_ref, f_ref, a_ref, b_ref, o_ref):
+        h = h_ref[:] + a_ref[:]
+        g = g_ref[:] + b_ref[:]
+        f = f_ref[:] + h * jnp.uint32(0xCC9E2D51)
+        o_ref[:] = h ^ (g + f)
+
+    def nogrid_round():
+        t = jnp.arange(8 * 128, dtype=jnp.uint32).reshape(8, 128)
+        return pl.pallas_call(
+            round_kernel,
+            out_shape=jax.ShapeDtypeStruct((8, 128), jnp.uint32),
+        )(t, t + 1, t + 2, t + 3, t + 4)
+
+    attempt("nogrid_round", nogrid_round)
+
+    def fori_kernel(x_ref, o_ref):
+        def body(k, acc):
+            return acc + x_ref[k]
+
+        o_ref[:] = jax.lax.fori_loop(
+            0, x_ref.shape[0], body, jnp.zeros((8, 128), jnp.uint32)
+        )
+
+    def nogrid_fori():
+        t = jnp.arange(16 * 8 * 128, dtype=jnp.uint32).reshape(16, 8, 128)
+        return pl.pallas_call(
+            fori_kernel,
+            out_shape=jax.ShapeDtypeStruct((8, 128), jnp.uint32),
+        )(t)
+
+    attempt("nogrid_fori", nogrid_fori)
+
+    def scan_of_pallas():
+        t = jnp.arange(8 * 128, dtype=jnp.uint32).reshape(8, 128)
+        xs = jnp.arange(32 * 8 * 128, dtype=jnp.uint32).reshape(32, 8, 128)
+        call = pl.pallas_call(
+            round_kernel,
+            out_shape=jax.ShapeDtypeStruct((8, 128), jnp.uint32),
+        )
+
+        @jax.jit
+        def run(t, xs):
+            def body(carry, x):
+                return call(carry, x, x, x, x), None
+
+            out, _ = jax.lax.scan(body, t, xs)
+            return out
+
+        return run(t, xs)
+
+    attempt("scan_of_pallas", scan_of_pallas)
 
     # 5/6. the real farmhash block loop, tiny then bench shape
     from ringpop_tpu.ops import jax_farmhash as jfh

@@ -127,3 +127,37 @@ def test_pre_round4_checkpoint_missing_defame_by_loads(tmp_path):
         assert (
             np.asarray(getattr(loaded, f)) == np.asarray(getattr(state, f))
         ).all(), f
+
+
+def test_hash_impl_is_trajectory_neutral(tmp_path):
+    """A checkpoint saved under one FarmHash lowering resumes under
+    another (the lowerings are bit-exact; hash_impl only picks the
+    kernel), and a pre-hash_impl checkpoint with no such key loads."""
+    import json as _json
+
+    import numpy as np
+
+    from ringpop_tpu.models.sim import engine
+    from ringpop_tpu.models.sim.checkpoint import (
+        _PARAMS_KEY,
+        load_state,
+        save_state,
+    )
+
+    params = engine.SimParams(n=8, checksum_mode="fast", hash_impl="scan")
+    state = engine.init_state(params, seed=0)
+    path = str(tmp_path / "st.npz")
+    save_state(path, state, params)
+
+    # cross-lowering resume
+    load_state(
+        path, engine.SimState, params._replace(hash_impl="pallas_nogrid")
+    )
+
+    # pre-hash_impl artifact: strip the key from the stored params JSON
+    data = dict(np.load(path, allow_pickle=True))
+    saved = _json.loads(str(data[_PARAMS_KEY][0]))
+    del saved["hash_impl"]
+    data[_PARAMS_KEY] = np.array([_json.dumps(saved)])
+    np.savez(path, **data)
+    load_state(path, engine.SimState, params)

@@ -89,3 +89,21 @@ def test_pallas_block_loop_matches_scan(monkeypatch):
     np.testing.assert_array_equal(got, want)
     # golden pin (farmhashmk of 'q'*255 from the compiled Google library)
     assert int(fh.hash32(b"q" * 255)) == 0x2AB28F77
+
+
+def test_pallas_nogrid_matches_scan():
+    """The GRIDLESS Pallas block loop (the axon-tunnel workaround: its
+    compile helper 500s on any grid'd kernel, PALLAS_BISECT.json) is
+    bit-exact against the scan lowering, including partially-active rows
+    and iteration counts that don't divide the chunk."""
+    import numpy as np
+
+    from ringpop_tpu.ops import jax_farmhash as jfh
+
+    rng = np.random.default_rng(3)
+    for rows, width in ((5, 25), (33, 444), (130, 2048)):
+        mat = rng.integers(0, 256, size=(rows, width), dtype=np.uint8)
+        lens = rng.integers(0, width + 1, size=(rows,)).astype(np.int32)
+        a = np.asarray(jfh.hash32_rows(mat, lens, impl="scan"))
+        b = np.asarray(jfh.hash32_rows(mat, lens, impl="pallas_nogrid"))
+        np.testing.assert_array_equal(a, b)
