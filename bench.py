@@ -45,28 +45,19 @@ RETRY_SLEEP_S = float(os.environ.get("BENCH_RETRY_SLEEP_S", "30"))
 # no second attempt, where n=64 parity had compiled fine minutes before)
 PARITY_RETRIES = int(os.environ.get("BENCH_PARITY_RETRIES", "4"))
 
-# Transient TPU-tunnel / backend failures worth retrying; anything else
-# (shape errors, engine bugs) fails fast.
-_TRANSIENT_MARKERS = (
-    "Unable to initialize backend",
-    "UNAVAILABLE",
-    "DEADLINE_EXCEEDED",
-    "RESOURCE_EXHAUSTED",
-    "ABORTED",
-)
-
-# The axon tunnel's remote-compile helper intermittently 500s on large
-# graphs (transient tunnel state, not a verdict on the graph — the same
-# parity graph has compiled on-chip before and after such failures).
-_COMPILE_HELPER_MARKERS = ("remote_compile", "tpu_compile_helper")
-
-
+# Transient TPU-tunnel / backend failures worth retrying vs compile-
+# helper 500s — shared classification lives in utils.util so this file
+# and the measurement sweep can't drift.
 def _is_transient(exc: BaseException) -> bool:
-    return any(m in str(exc) for m in _TRANSIENT_MARKERS)
+    from ringpop_tpu.utils.util import is_transient_backend_error
+
+    return is_transient_backend_error(exc)
 
 
 def _is_compile_helper_500(exc: BaseException) -> bool:
-    return any(m in str(exc) for m in _COMPILE_HELPER_MARKERS)
+    from ringpop_tpu.utils.util import is_compile_helper_500
+
+    return is_compile_helper_500(exc)
 
 
 def _mode_rate(n: int, ticks: int, mode: str, gate: bool = True) -> tuple:
@@ -115,24 +106,14 @@ def _batched_rate(b: int, n: int, ticks: int) -> tuple:
 
 
 def _retry_helper_500(fn, *args, **kwargs):
-    """Call ``fn`` with in-process backoff for compile-helper 500s (the
-    tunnel's remote-compile helper fails intermittently on graphs that
-    compile fine seconds later).  Transient backend errors re-raise
-    immediately — main()'s retry loop owns those; any other error is a
-    real graph/engine failure and re-raises too.  ONE retry policy for
-    every measured config (fast, straight-line, batched, parity)."""
-    exc = None
-    for i, backoff in enumerate(_HELPER_BACKOFFS):
-        if backoff:
-            time.sleep(backoff)
-        try:
-            return fn(*args, **kwargs)
-        except Exception as e:
-            e._bench_attempts = i + 1  # actual tries for artifact fields
-            exc = e
-            if _is_transient(exc) or not _is_compile_helper_500(exc):
-                raise
-    raise exc
+    """Shared in-process backoff for compile-helper 500s (utils.util.
+    retry_compile_helper): transient backend errors re-raise immediately
+    — main()'s retry loop owns those — as do real graph/engine failures.
+    ONE retry policy for every measured config (fast, straight-line,
+    batched, parity)."""
+    from ringpop_tpu.utils.util import retry_compile_helper
+
+    return retry_compile_helper(fn, *args, backoffs=_HELPER_BACKOFFS, **kwargs)
 
 
 _HELPER_BACKOFFS = (0.0, 10.0, 25.0)
@@ -212,22 +193,32 @@ def _measure(n: int, ticks: int) -> dict:
                 str(exc)[:300],
             )
     # parity mode: bit-exact reference FarmHash32 string checksums in the
-    # same compiled tick (dirty-row cached) — the north-star config.  Not
-    # allowed to sink the whole artifact: the tunneled chip's remote
-    # compile helper occasionally 500s on large graphs, and a fast-mode
-    # number with a parity_error beats an error-only artifact.
+    # same compiled tick — the north-star config.  Not allowed to sink
+    # the whole artifact: the tunneled chip's remote compile helper
+    # occasionally 500s on large graphs, and a fast-mode number with a
+    # parity_error beats an error-only artifact.  On TPU the parity tick
+    # runs the straight-line full recompute (the tunnel rejects the
+    # dirty-gated loop — see engine.SimParams.parity_recompute) at
+    # ~1.4 s/tick, and scans past ~32 such ticks have kernel-faulted
+    # the TPU worker, so the parity window is capped separately.
+    parity_ticks = ticks
+    if platform == "tpu":
+        parity_ticks = min(
+            ticks, int(os.environ.get("BENCH_PARITY_TICKS", "32"))
+        )
     try:
         parity_rate, _, _ = _retry_helper_500(
-            _mode_rate, n, ticks, "farmhash", gate=gate
+            _mode_rate, n, parity_ticks, "farmhash", gate=gate
         )
         result["parity_mode_node_ticks_per_sec"] = round(parity_rate, 1)
         result["parity_mode_vs_baseline"] = round(parity_rate / baseline, 2)
+        result["parity_ticks"] = parity_ticks  # its own window, not `ticks`
         return result
     except Exception as e:
         exc = e
         if _is_transient(exc):
             raise  # retryable backend failures keep the retry semantics
-        tries = getattr(exc, "_bench_attempts", 1)
+        tries = getattr(exc, "_retry_attempts", 1)
     # in-process budget exhausted on a compile-helper 500: a FRESH
     # interpreter re-submits the compile through a clean tunnel session
     # (the fast-mode number is re-measured there — itself protected by

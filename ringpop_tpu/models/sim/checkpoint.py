@@ -24,7 +24,13 @@ _PARAMS_KEY = "__ringpop_tpu_params__"
 # may change these freely, and a checkpoint from a build predating one of
 # them must still load (its absence on either side is ignored)
 _TRAJECTORY_NEUTRAL_PARAMS = frozenset(
-    {"dirty_batch", "checksum_in_tick", "gate_phases", "hash_impl"}
+    {
+        "dirty_batch",
+        "checksum_in_tick",
+        "gate_phases",
+        "hash_impl",
+        "parity_recompute",
+    }
 )
 # v2: incarnation fields are int32 tick stamps (engine.stamp_to_ms), not
 # int64 epoch-ms values — a v1 checkpoint's ms incarnations would be

@@ -85,18 +85,33 @@ class EventSchedule:
 
 
 def _resolve_hash_impl(params: engine.SimParams) -> engine.SimParams:
-    """Pin ``hash_impl="env"`` to the CONCRETE lowering at construction.
+    """Pin trace-environment-dependent params to CONCRETE values at
+    construction.
 
-    The RINGPOP_TPU_PALLAS toggle is otherwise read at trace time inside
-    engine.tick's checksum path; with shared executable caches that read
-    would race with toggles between construction and first call, silently
-    serving a pre-toggle executable (or poisoning the cache with a
-    post-toggle trace under the pre-toggle key)."""
-    if params.hash_impl != "env":
-        return params
-    from ringpop_tpu.ops.jax_farmhash import _impl_from_env
+    ``hash_impl="env"``: the RINGPOP_TPU_PALLAS toggle is otherwise read
+    at trace time inside engine.tick's checksum path; with shared
+    executable caches that read would race with toggles between
+    construction and first call, silently serving a pre-toggle executable
+    (or poisoning the cache with a post-toggle trace under the pre-toggle
+    key).
 
-    return params._replace(hash_impl=_impl_from_env())
+    ``parity_recompute="auto"``: "gated" (dirty-chunk while_loop — skips
+    clean ticks) on CPU, "full" (straight-line, control-flow-free) on
+    TPU, whose tunnel compile helper 500s on large loop bodies.  Both are
+    bit-identical in trajectory."""
+    if params.hash_impl == "env":
+        from ringpop_tpu.ops.jax_farmhash import _impl_from_env
+
+        params = params._replace(hash_impl=_impl_from_env())
+    if params.parity_recompute == "auto":
+        import jax
+
+        params = params._replace(
+            parity_recompute=(
+                "full" if jax.default_backend() == "tpu" else "gated"
+            )
+        )
+    return params
 
 
 @functools.lru_cache(maxsize=None)

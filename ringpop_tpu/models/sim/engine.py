@@ -116,6 +116,16 @@ class SimParams(NamedTuple):
     # executable caches key on it — a trace-time env read would race with
     # toggles between construction and first call.
     hash_impl: str = "env"
+    # parity-mode checksum recompute shape: "gated" = dirty-chunk
+    # while_loop, skipping clean ticks entirely (the CPU win); "full" =
+    # straight-line full-membership recompute every tick, NO control
+    # flow — bit-identical (a clean row's recompute reproduces its
+    # cached value), required on the axon tunnel whose compile helper
+    # 500s on large bodies nested under while/cond (DIAG_PARITY_N.json +
+    # the round-4 fine bisect: encode+hash compiles straight-line at any
+    # size, fails inside while_loop).  "auto" = resolved to the backend's
+    # right answer at SimCluster construction.
+    parity_recompute: str = "auto"
     # True: rare phases (revive, rejoin, join, reshuffle, piggyback,
     # apply, responses, ping-req, expiry) run under lax.cond and cost
     # nothing on ticks with nothing to do — the right call on CPU, where
@@ -410,33 +420,66 @@ def _checksums_where(
             n_dirty > 0, recompute_all, lambda _: cached, operand=None
         )
 
+    recompute_shape = params.parity_recompute
+    if recompute_shape == "auto":
+        # direct engine users (not routed through SimCluster's
+        # construction-time resolution) still must not trace the gated
+        # loop on the tunnel backend that can't compile it
+        import jax as _jax
+
+        recompute_shape = (
+            "full" if _jax.default_backend() == "tpu" else "gated"
+        )
+    if recompute_shape == "full":
+        # straight-line: no cond, no while.  Recomputing a clean row is
+        # bit-neutral, so dirty tracking is simply unused here.
+        return compute_checksums(state, universe, params)
+
     k = min(params.dirty_batch, params.n)
 
-    def recompute_batch(_):
-        # bounded dirty set: gather K rows, encode+hash only those, and
-        # scatter the results back over the cache.  nonzero(size=K) pads
-        # with index 0; padded lanes are routed to a dropped scatter slot
-        (idx,) = jnp.nonzero(dirty, size=k, fill_value=0)
-        idx = idx.astype(jnp.int32)
-        lane_ok = jnp.arange(k, dtype=jnp.int32) < n_dirty
-        bufs, lens = ce.membership_rows(
-            universe,
-            state.known[idx],
-            state.status[idx],
-            stamp_to_ms(state.inc[idx], params),
-            max_digits=params.max_digits,
-        )
-        fresh = jfh.hash32_rows(bufs, lens, impl=_hash_impl(params))
-        tgt = jnp.where(lane_ok, idx, params.n)  # n drops
-        return cached.at[tgt].set(fresh, mode="drop")
+    def recompute_chunked(_):
+        # ONE bounded K-row encode+hash instantiation, driven by a
+        # while_loop over K-sized chunks of the dirty set.  The previous
+        # shape — a batch path PLUS a full-recompute fallback as separate
+        # cond branches — embedded the encode graph twice (once at K
+        # rows, once at N), and the combined program is what blew the
+        # axon compile helper's resource limit from n=256 up
+        # (DIAG_PARITY_N.json: full recompute alone compiles in 21 s,
+        # _checksums_where 500s).  Chunking also makes program size
+        # independent of N.  Chunk c covers dirty rows with rank in
+        # [cK, cK+K); nonzero(size=K) pads with index 0 and padded lanes
+        # are routed to a dropped scatter slot.
+        rank = jnp.cumsum(dirty.astype(jnp.int32)) - 1
 
-    def recompute(_):
-        return jax.lax.cond(
-            n_dirty <= k, recompute_batch, recompute_all, operand=None
-        )
+        def cond(carry):
+            c, _ = carry
+            return c * k < n_dirty
+
+        def body(carry):
+            c, acc = carry
+            lo = c * k
+            sel = dirty & (rank >= lo) & (rank < lo + k)
+            (idx,) = jnp.nonzero(sel, size=k, fill_value=0)
+            idx = idx.astype(jnp.int32)
+            lane_ok = jnp.arange(k, dtype=jnp.int32) < jnp.minimum(
+                n_dirty - lo, k
+            )
+            bufs, lens = ce.membership_rows(
+                universe,
+                state.known[idx],
+                state.status[idx],
+                stamp_to_ms(state.inc[idx], params),
+                max_digits=params.max_digits,
+            )
+            fresh = jfh.hash32_rows(bufs, lens, impl=_hash_impl(params))
+            tgt = jnp.where(lane_ok, idx, params.n)  # n drops
+            return c + 1, acc.at[tgt].set(fresh, mode="drop")
+
+        _, out = jax.lax.while_loop(cond, body, (jnp.int32(0), cached))
+        return out
 
     return jax.lax.cond(
-        n_dirty > 0, recompute, lambda _: cached, operand=None
+        n_dirty > 0, recompute_chunked, lambda _: cached, operand=None
     )
 
 

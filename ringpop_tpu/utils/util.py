@@ -172,25 +172,50 @@ def reexec_retry(env_var: str, retries: int, sleep_s: float, script: str):
     )
 
 
+# transient backend failures (retryable by a caller's OUTER loop / fresh
+# process, never by in-process compile-helper backoff) vs compile-helper
+# 500s (retryable in-process).  One source of truth — bench.py and the
+# measurement sweep share these.
+TRANSIENT_BACKEND_MARKERS = (
+    "Unable to initialize backend",
+    "UNAVAILABLE",
+    "DEADLINE_EXCEEDED",
+    "RESOURCE_EXHAUSTED",
+    "ABORTED",
+)
+COMPILE_HELPER_MARKERS = ("remote_compile", "tpu_compile_helper")
+
+
+def is_transient_backend_error(exc: BaseException) -> bool:
+    return any(m in str(exc) for m in TRANSIENT_BACKEND_MARKERS)
+
+
+def is_compile_helper_500(exc: BaseException) -> bool:
+    return any(m in str(exc) for m in COMPILE_HELPER_MARKERS)
+
+
 def retry_compile_helper(fn, *args, backoffs=(0.0, 10.0, 25.0), **kwargs):
     """Call ``fn`` with backoff retries for axon remote-compile-helper
     500s ONLY (the tunnel's compile helper fails intermittently on graphs
     that compile fine seconds later — the round-3 artifact lost its
-    parity headline to a single such 500).  Any other error re-raises
-    immediately: those are real graph/engine failures."""
+    parity headline to a single such 500).  Transient backend errors
+    re-raise immediately even when their text also mentions the helper —
+    an outer retry loop / fresh process owns those — as does any other
+    error (real graph/engine failures).  Each raised exception carries
+    ``_retry_attempts`` with the number of tries made."""
     import time
 
     exc = None
-    for backoff in backoffs:
+    for i, backoff in enumerate(backoffs):
         if backoff:
             time.sleep(backoff)
         try:
             return fn(*args, **kwargs)
         except Exception as e:
+            e._retry_attempts = i + 1
             exc = e
-            msg = str(e)
-            if not (
-                "remote_compile" in msg or "tpu_compile_helper" in msg
+            if is_transient_backend_error(exc) or not is_compile_helper_500(
+                exc
             ):
                 raise
     raise exc

@@ -98,7 +98,7 @@ def test_gather_impl_matches_scatter_impl():
             impl="scatter",
         )
         # chunk=8 < B forces the lax.map chunked path in every impl
-        for impl in ("gather", "gather2"):
+        for impl in ("gather", "gather2", "scatter_unique"):
             b = ce.membership_rows(
                 u, jnp.asarray(pres), jnp.asarray(stat), jnp.asarray(inc),
                 impl=impl, chunk=8,

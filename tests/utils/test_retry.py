@@ -1,0 +1,49 @@
+"""retry_compile_helper: backoff retries ONLY for axon remote-compile
+helper 500s (the transient failure that cost round 3 its parity-mode
+headline); every other error propagates immediately."""
+
+import pytest
+
+from ringpop_tpu.utils.util import retry_compile_helper
+
+
+def test_matching_error_retries_then_succeeds():
+    calls = []
+
+    def fn():
+        calls.append(1)
+        if len(calls) < 3:
+            raise RuntimeError(
+                "INTERNAL: remote_compile: HTTP 500: tpu_compile_helper"
+            )
+        return "ok"
+
+    assert retry_compile_helper(fn, backoffs=(0, 0, 0)) == "ok"
+    assert len(calls) == 3
+
+
+def test_non_matching_error_raises_immediately():
+    calls = []
+
+    def fn():
+        calls.append(1)
+        raise ValueError("shape mismatch")
+
+    with pytest.raises(ValueError):
+        retry_compile_helper(fn, backoffs=(0, 0, 0))
+    assert len(calls) == 1
+
+
+def test_exhaustion_reraises_last_matching_error():
+    def fn():
+        raise RuntimeError("tpu_compile_helper subprocess exit code 1")
+
+    with pytest.raises(RuntimeError, match="tpu_compile_helper"):
+        retry_compile_helper(fn, backoffs=(0, 0))
+
+
+def test_args_forwarded():
+    def fn(a, b=0):
+        return a + b
+
+    assert retry_compile_helper(fn, 2, b=3, backoffs=(0,)) == 5
