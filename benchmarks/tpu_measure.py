@@ -164,7 +164,10 @@ def phase_pallas_vs_scan(results: dict) -> None:
             # crash-resumed process still validates against the first
             # impl's output instead of re-anchoring on its own
             digest = int(
-                (last.astype(np.uint64) * (np.arange(n) + 1)).sum()
+                (
+                    last.astype(np.uint64)
+                    * (np.arange(n, dtype=np.uint64) + np.uint64(1))
+                ).sum()
                 & np.uint64(0x7FFFFFFFFFFFFFFF)
             )
             ref = results.get("hash32_rows_digest")
@@ -206,16 +209,18 @@ def phase_encode_impls(results: dict) -> None:
     # byte placement
     if _todo(results, "encode_unique_bitexact_on_device"):
         try:
+            # operands as jit ARGUMENTS, not baked constants — the
+            # compile helper resource-limits large programs
             a_buf, a_len = jax.jit(
-                lambda: ce.membership_rows(
-                    u, pres, stat, inc, max_digits=14, impl="scatter"
+                lambda p, s, i: ce.membership_rows(
+                    u, p, s, i, max_digits=14, impl="scatter"
                 )
-            )()
+            )(pres, stat, inc)
             b_buf, b_len = jax.jit(
-                lambda: ce.membership_rows(
-                    u, pres, stat, inc, max_digits=14, impl="scatter_unique"
+                lambda p, s, i: ce.membership_rows(
+                    u, p, s, i, max_digits=14, impl="scatter_unique"
                 )
-            )()
+            )(pres, stat, inc)
             a_buf, a_len = np.asarray(a_buf), np.asarray(a_len)
             b_buf, b_len = np.asarray(b_buf), np.asarray(b_len)
             ok = bool((a_len == b_len).all()) and all(

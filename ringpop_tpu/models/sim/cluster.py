@@ -107,8 +107,8 @@ def _resolve_hash_impl(params: engine.SimParams) -> engine.SimParams:
         import jax
 
         params = params._replace(
-            parity_recompute=(
-                "full" if jax.default_backend() == "tpu" else "gated"
+            parity_recompute=engine.resolve_parity_recompute(
+                jax.default_backend()
             )
         )
     return params

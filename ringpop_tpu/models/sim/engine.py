@@ -390,6 +390,17 @@ def _hash_impl(params: SimParams):
     return None if params.hash_impl == "env" else params.hash_impl
 
 
+def resolve_parity_recompute(backend: str) -> str:
+    """ONE policy for resolving ``parity_recompute="auto"`` per backend
+    (used both by SimCluster's construction-time resolution and by
+    _checksums_where's trace-time fallback for direct engine users):
+    "gated" skips clean ticks via a dirty-chunk while_loop — the CPU
+    win; "full" is the straight-line shape the TPU tunnel's compile
+    helper can actually compile.  Bit-identical trajectories either
+    way."""
+    return "full" if backend == "tpu" else "gated"
+
+
 def _checksums_where(
     state: SimState,
     universe: ce.Universe,
@@ -427,9 +438,7 @@ def _checksums_where(
         # loop on the tunnel backend that can't compile it
         import jax as _jax
 
-        recompute_shape = (
-            "full" if _jax.default_backend() == "tpu" else "gated"
-        )
+        recompute_shape = resolve_parity_recompute(_jax.default_backend())
     if recompute_shape == "full":
         # straight-line: no cond, no while.  Recomputing a clean row is
         # bit-neutral, so dirty tracking is simply unused here.

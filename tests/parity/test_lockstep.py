@@ -206,3 +206,27 @@ def test_dirty_batch_boundary_n16():
         + quiet(n, 12)
     )
     run_lockstep(n, sched, params=params)
+
+
+def test_parity_recompute_full_n16():
+    """The straight-line full-recompute shape (the TPU production path —
+    the tunnel's compile helper rejects the gated loop) must be
+    bit-identical to the gated path: lockstep vs the oracle through the
+    same kill/revive lifecycle as the dirty-batch boundary test."""
+    n = 16
+    params = engine.SimParams(
+        n=n, checksum_mode="farmhash", parity_recompute="full"
+    )
+    kill = np.zeros(n, bool)
+    kill[7] = True
+    revive = np.zeros(n, bool)
+    revive[7] = True
+    sched = (
+        join_all(n)
+        + quiet(n, 12)
+        + [{"kill": kill}]
+        + quiet(n, 34)
+        + [{"revive": revive}]
+        + quiet(n, 12)
+    )
+    run_lockstep(n, sched, params=params)
