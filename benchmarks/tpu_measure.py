@@ -356,11 +356,14 @@ def phase_batched(results: dict) -> None:
     from ringpop_tpu.models.sim.batched import BatchedSimClusters
     from ringpop_tpu.models.sim.cluster import EventSchedule
 
-    # 256-tick window like phase_headline/bench.py: a 32-tick single
-    # execution is dominated by the tunnel's flat ~0.9 s per-execution tax
     if not _todo(results, "batched_8x1k"):
         return
-    b, n, ticks = 8, 1024, 256
+    # 64 ticks, NOT the 256 the single-cluster headline uses: the 8x1k
+    # vmapped 256-tick scan kernel-faults the tunnel's TPU worker
+    # (round-4 artifacts), while 32/64-tick scans run.  Treat the
+    # number as an existence proof, not a throughput claim: same-config
+    # batched runs measured 6x apart within minutes on this tunnel.
+    b, n, ticks = 8, 1024, 64
     bat = BatchedSimClusters(b=b, n=n, seed=0)
     bat.bootstrap()
     sched = EventSchedule(ticks=ticks, n=n)
@@ -372,9 +375,11 @@ def phase_batched(results: dict) -> None:
     dt = _time.perf_counter() - t0
     results["batched_8x1k"] = {
         "clusters": b,
+        "ticks": ticks,  # 64, NOT the headline's 256 — see cap above
         "aggregate_node_ticks_per_sec": round(b * n * ticks / dt, 1),
         "per_cluster_node_ticks_per_sec": round(n * ticks / dt, 1),
         "converged": bool(np.asarray(ms.converged)[-1].all()),
+        "caveat": "existence proof; 6x run-to-run variance observed",
     }
     print(json.dumps({"batched_8x1k": results["batched_8x1k"]}), flush=True)
 
