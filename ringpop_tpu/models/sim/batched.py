@@ -15,9 +15,22 @@ B clusters means B*N processes for the reference — the batched simulator
 runs them all in one compiled scan).
 
 The engine's rare-phase conds are disabled (``gate_phases=False``): under
-vmap a ``lax.cond`` lowers to a run-both ``select`` anyway, and the
-straight-line program fuses better.  Trajectories are unaffected (the
-two settings are bitwise-identical; tests/models/test_sim.py).
+vmap a ``lax.cond`` with a BATCHED predicate lowers to a run-both
+``select``, and the heavy phases' predicates are state-derived (ping
+failures, suspicion expiry, checksum mismatch) and therefore batched —
+gating could survive vmap only for predicates drawn purely from the
+unmapped shared schedule.  Trajectories are unaffected (the two settings
+are bitwise-identical; tests/models/test_sim.py).
+
+Measured consequence (round 4, CPU-pinned so tunnel noise is excluded):
+straight-line costs ~5x a gated tick at 1k (60 vs 12 ms — the rare
+phases dominate when they run every tick), so batched aggregate
+throughput currently LOSES to one gated cluster (9.1k vs 86k CPU
+node-ticks/s; same ordering on the chip).  The utilization configuration
+only pays off if the rare phases get cheap enough to run always-on;
+until then the single-cluster gated engine is the throughput
+configuration and this runner is for trajectory-exact ensemble runs
+(B seeds, one program), not speed.
 """
 
 from __future__ import annotations
