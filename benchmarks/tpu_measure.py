@@ -483,11 +483,14 @@ def _drop_executables() -> None:
     """Release each phase's compiled programs (the shared lru_caches pin
     them for process life otherwise — four distinct 1M-node storm
     programs by the final phase)."""
-    for mod in ("cluster", "batched", "storm"):
+    for modpath in (
+        "ringpop_tpu.models.sim.cluster",
+        "ringpop_tpu.models.sim.batched",
+        "ringpop_tpu.models.sim.storm",
+        "ringpop_tpu.parallel.mesh",
+    ):
         try:
-            m = __import__(
-                "ringpop_tpu.models.sim.%s" % mod, fromlist=[mod]
-            )
+            m = __import__(modpath, fromlist=[modpath.rsplit(".", 1)[1]])
             m.clear_executable_cache()
         except Exception:
             pass  # a phase that never imported the module

@@ -7,6 +7,7 @@ from ringpop_tpu.parallel.mesh import (
     shard_state,
     make_sharded_tick,
     ShardedSim,
+    clear_executable_cache,
 )
 
 __all__ = [
@@ -16,4 +17,5 @@ __all__ = [
     "shard_state",
     "make_sharded_tick",
     "ShardedSim",
+    "clear_executable_cache",
 ]

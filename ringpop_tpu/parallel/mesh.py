@@ -130,13 +130,17 @@ def _replicated_metrics(mesh: Mesh):
     )
 
 
+@functools.lru_cache(maxsize=None)
 def make_sharded_tick(
     params: engine.SimParams, universe: ce.Universe, mesh: Mesh
 ):
     """Compile ``engine.tick`` as one SPMD program over the mesh.
 
     Returns ``f(state, inputs) -> (state, metrics)`` with state kept
-    device-resident and node-sharded across ticks.
+    device-resident and node-sharded across ticks.  lru_cached on the
+    (hashable) params/universe/mesh triple, like the single-device
+    drivers: fresh ShardedSim instances with the same config reuse the
+    compiled executable instead of re-tracing.
     """
     st_sh = state_shardings(mesh, _abstract_state(params))
     in_sh = inputs_shardings(mesh, engine.TickInputs.quiet(params.n))
@@ -147,10 +151,12 @@ def make_sharded_tick(
     )
 
 
+@functools.lru_cache(maxsize=None)
 def make_sharded_scan(
     params: engine.SimParams, universe: ce.Universe, mesh: Mesh
 ):
-    """Compile a ``lax.scan`` of the tick over a [T, N] event schedule."""
+    """Compile a ``lax.scan`` of the tick over a [T, N] event schedule.
+    lru_cached like :func:`make_sharded_tick`."""
     st_sh = state_shardings(mesh, _abstract_state(params))
     axis = _node_axis(mesh)
     sched_sh = jax.tree.map(
@@ -170,6 +176,15 @@ def make_sharded_scan(
         in_shardings=(st_sh, sched_sh),
         out_shardings=(st_sh, metrics_sh),
     )
+
+
+def clear_executable_cache() -> None:
+    """Drop the shared compiled SPMD executables (sweep hygiene, like the
+    single-device drivers' clear hooks)."""
+    make_sharded_tick.cache_clear()
+    make_sharded_scan.cache_clear()
+    _storm_tick_fn.cache_clear()
+    _storm_scan_fn.cache_clear()
 
 
 class ShardedSim:
@@ -195,6 +210,14 @@ class ShardedSim:
             addresses = default_addresses(n)
         self.universe = ce.Universe.from_addresses(addresses)
         self.params = params or engine.SimParams(n=self.universe.n)
+        # pin trace-env-dependent params (hash_impl="env",
+        # parity_recompute="auto") to concrete values, exactly like
+        # SimCluster: the shared executable caches below key on params,
+        # and a trace-time env read would serve stale lowerings across
+        # RINGPOP_TPU_PALLAS toggles
+        from ringpop_tpu.models.sim.cluster import _resolve_hash_impl
+
+        self.params = _resolve_hash_impl(self.params)
         if self.params.n % self.mesh.devices.size:
             raise ValueError(
                 "n=%d not divisible by mesh size %d"
@@ -274,6 +297,75 @@ def scalable_state_shardings(mesh: Mesh, params):
     )
 
 
+def _storm_input_shardings(mesh, inputs, leading_time_axis: bool):
+    axis = _node_axis(mesh)
+    spec = P(None, axis) if leading_time_axis else P(axis)
+    return jax.tree.map(lambda _: NamedSharding(mesh, spec), inputs)
+
+
+def _storm_metrics_shardings(mesh):
+    from ringpop_tpu.models.sim import engine_scalable as es
+
+    m_fields = len(es.ScalableMetrics._fields)
+    return jax.tree.map(
+        lambda _: NamedSharding(mesh, P()),
+        es.ScalableMetrics(*[0] * m_fields),
+    )
+
+
+def _storm_sample_inputs(n: int, structure_key):
+    """A ChurnInputs pytree with the same STRUCTURE as the caller's (the
+    optional partition/leave fields change the arg tree)."""
+    import jax.numpy as _jnp
+
+    from ringpop_tpu.models.sim import engine_scalable as es
+
+    no_partition, no_leave = structure_key
+    inputs = es.ChurnInputs.quiet(n)
+    if not no_partition:
+        inputs = inputs._replace(partition=_jnp.zeros(n, _jnp.int32))
+    if not no_leave:
+        inputs = inputs._replace(leave=_jnp.zeros(n, bool))
+    return inputs
+
+
+@functools.lru_cache(maxsize=None)
+def _storm_tick_fn(params, mesh: Mesh, structure_key):
+    from ringpop_tpu.models.sim import engine_scalable as es
+
+    st_sh = scalable_state_shardings(mesh, params)
+    in_sh = _storm_input_shardings(
+        mesh, _storm_sample_inputs(params.n, structure_key), False
+    )
+    return jax.jit(
+        functools.partial(es.tick, params=params),
+        in_shardings=(st_sh, in_sh),
+        out_shardings=(st_sh, _storm_metrics_shardings(mesh)),
+    )
+
+
+@functools.lru_cache(maxsize=None)
+def _storm_scan_fn(params, mesh: Mesh, structure_key):
+    from ringpop_tpu.models.sim import engine_scalable as es
+
+    st_sh = scalable_state_shardings(mesh, params)
+    in_sh = _storm_input_shardings(
+        mesh, _storm_sample_inputs(params.n, structure_key), True
+    )
+
+    def scanned(state, inp):
+        def body(st, i):
+            return es.tick(st, i, params)
+
+        return jax.lax.scan(body, state, inp)
+
+    return jax.jit(
+        scanned,
+        in_shardings=(st_sh, in_sh),
+        out_shardings=(st_sh, _storm_metrics_shardings(mesh)),
+    )
+
+
 class ShardedStorm:
     """ScalableCluster over a device mesh: one SPMD program per tick/scan.
 
@@ -299,23 +391,9 @@ class ShardedStorm:
         self.state = jax.device_put(
             es.init_state(self.params, seed=seed), self._st_sh
         )
-        m_fields = len(es.ScalableMetrics._fields)
-        self._m_sh = jax.tree.map(
-            lambda _: NamedSharding(self.mesh, P()),
-            es.ScalableMetrics(*[0] * m_fields),
-        )
-        # jitted fns are built per input-pytree structure: ChurnInputs'
-        # optional partition/leave change the arg tree, and in_shardings
-        # frozen to the quiet() shape would reject them
-        self._ticks: dict = {}
-        self._scans: dict = {}
-
-    def _input_shardings(self, inputs, leading_time_axis: bool):
-        axis = _node_axis(self.mesh)
-        spec = P(None, axis) if leading_time_axis else P(axis)
-        return jax.tree.map(
-            lambda _: NamedSharding(self.mesh, spec), inputs
-        )
+        # jitted fns are resolved per input-pytree structure (ChurnInputs'
+        # optional partition/leave change the arg tree) from MODULE-LEVEL
+        # caches shared across instances, like the single-device drivers
 
     def _structure_key(self, inputs):
         return (inputs.partition is None, inputs.leave is None)
@@ -325,43 +403,17 @@ class ShardedStorm:
 
         if inputs is None:
             inputs = es.ChurnInputs.quiet(self.params.n)
-        key = self._structure_key(inputs)
-        tick = self._ticks.get(key)
-        if tick is None:
-            fn = functools.partial(es.tick, params=self.params)
-            tick = self._ticks[key] = jax.jit(
-                fn,
-                in_shardings=(
-                    self._st_sh,
-                    self._input_shardings(inputs, False),
-                ),
-                out_shardings=(self._st_sh, self._m_sh),
-            )
+        tick = _storm_tick_fn(
+            self.params, self.mesh, self._structure_key(inputs)
+        )
         self.state, m = tick(self.state, inputs)
         return jax.tree.map(np.asarray, m)
 
     def run(self, schedule):
-        from ringpop_tpu.models.sim import engine_scalable as es
-
         inputs = schedule.as_inputs()
-        key = self._structure_key(inputs)
-        scan = self._scans.get(key)
-        if scan is None:
-
-            def scanned(state, inp):
-                def body(st, i):
-                    return es.tick(st, i, self.params)
-
-                return jax.lax.scan(body, state, inp)
-
-            scan = self._scans[key] = jax.jit(
-                scanned,
-                in_shardings=(
-                    self._st_sh,
-                    self._input_shardings(inputs, True),
-                ),
-                out_shardings=(self._st_sh, self._m_sh),
-            )
+        scan = _storm_scan_fn(
+            self.params, self.mesh, self._structure_key(inputs)
+        )
         self.state, ms = scan(self.state, inputs)
         return jax.tree.map(np.asarray, ms)
 
