@@ -75,6 +75,9 @@ class Ringpop(EventEmitter):
         self.logger = logger or NullLogger()
         self.statsd = statsd or NullStatsd()
         self.timers = timers or Timers()
+        # Date.now() analog riding the timer plane, so fake-timer tests see
+        # one coherent clock (Member reads it for damp-score decay deltas)
+        self.now = self.timers.now_ms
         self.rng = random.Random(seed)
         self.destroyed = False
         self.is_ready = False
@@ -130,6 +133,11 @@ class Ringpop(EventEmitter):
             self.setup_channel()
 
         self._wire_events()
+        # "It would be more correct to start Membership's background
+        # decayer once we know that a member has been penalized for a
+        # flap. But it's OK to start prematurely."
+        # (lib/membership/index.js:399-407)
+        self.membership.start_damp_score_decayer()
 
     # -- event plumbing (lib/on_membership_event.js etc.) ----------------
 
@@ -137,10 +145,27 @@ class Ringpop(EventEmitter):
         self.membership.on("updated", self._on_membership_updated)
         self.membership.on("set", self._on_membership_set)
         self.membership.on("event", self._on_membership_event)
+        # flap-damping signals (membership/index.js:406,415-417 — the
+        # reference's onExceeded is a TODO'd subprotocol hook; stats +
+        # facade events carry the signal here, recovery included)
+        self.membership.on(
+            "memberSuppressLimitExceeded", self._on_member_suppressed
+        )
+        self.membership.on(
+            "memberSuppressRecovered", self._on_member_suppress_recovered
+        )
         self.ring.on("added", self._on_ring_server_added)
         self.ring.on("removed", self._on_ring_server_removed)
         self.ring.on("checksumComputed", lambda: self.stat("increment", "ring.checksum-computed"))
         self.on("ready", self._on_ready)
+
+    def _on_member_suppressed(self, member) -> None:
+        self.stat("increment", "damp-score.suppress-limit-exceeded")
+        self.emit("memberSuppressLimitExceeded", member)
+
+    def _on_member_suppress_recovered(self, member, score) -> None:
+        self.stat("increment", "damp-score.suppress-recovered")
+        self.emit("memberSuppressRecovered", member, score)
 
     def _on_ready(self) -> None:
         self.start_time = time.time()
@@ -453,6 +478,7 @@ class Ringpop(EventEmitter):
         self.emit("destroying")
         self.gossip.stop()
         self.suspicion.stop_all()
+        self.membership.stop_damp_score_decayer()
         self.membership_update_rollup.destroy()
         self.tracers.destroy()
         self.request_proxy.destroy()

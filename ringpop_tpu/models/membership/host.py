@@ -25,6 +25,7 @@ from __future__ import annotations
 
 import math
 import random
+import threading
 import time
 import uuid
 from typing import Any, Callable, Dict, List, Optional, Sequence, Union
@@ -119,32 +120,65 @@ class Member(EventEmitter):
         self.damped_timestamp = getattr(update, "damped_timestamp", None)
         self.last_update_timestamp: Optional[int] = None
         self.last_update_damp_score = self.damp_score
+        # True once the score crossed dampScoringSuppressLimit; cleared (with
+        # a 'suppressRecovered' event) when decay brings it back below
+        # dampScoringReuseLimit — the recovery side of the reference's
+        # planned flap-damping subprotocol (membership/index.js:415-417 is a
+        # TODO there; the reuse limit is config.js:69's knob for it)
+        self.suppressed = False
+        # the decay loop runs on a timer thread under real Timers while
+        # updates arrive on gossip/server threads; damp state is a
+        # read-modify-write either way (the reference is single-threaded)
+        self._damp_lock = threading.Lock()
         self.now: Callable[[], int] = getattr(ringpop, "now", _now_ms)
 
     # -- damping ----------------------------------------------------------
 
     def decay_damp_score(self) -> None:
+        with self._damp_lock:
+            events = self._decay_damp_score_locked()
+        for name, *args in events:
+            self.emit(name, *args)
+
+    def _decay_damp_score_locked(self) -> list:
+        """Returns the events to emit (emission happens outside the lock:
+        listeners may re-enter membership)."""
         config = self.ringpop.config
         if self.damp_score is None:
             self.damp_score = config.get("dampScoringInitial")
-            return
+            return []
         time_since = (self.now() - (self.last_update_timestamp or 0)) / 1000.0
         decay = math.e ** (-time_since * math.log(2) / config.get("dampScoringHalfLife"))
         old = self.damp_score
         self.damp_score = max(
             round(self.last_update_damp_score * decay), config.get("dampScoringMin")
         )
-        self.emit("dampScoreDecayed", self.damp_score, old)
+        events = [("dampScoreDecayed", self.damp_score, old)]
+        if self.suppressed and self.damp_score < config.get(
+            "dampScoringReuseLimit"
+        ):
+            self.suppressed = False
+            events.append(("suppressRecovered", self.damp_score))
+        return events
 
     def _apply_update_penalty(self) -> None:
         config = self.ringpop.config
-        self.decay_damp_score()
-        self.damp_score = min(
-            self.damp_score + config.get("dampScoringPenalty"),
-            config.get("dampScoringMax"),
-        )
+        with self._damp_lock:
+            events = self._decay_damp_score_locked()
+            self.damp_score = min(
+                self.damp_score + config.get("dampScoringPenalty"),
+                config.get("dampScoringMax"),
+            )
+            # lastUpdateDampScore is recorded here, atomically with the
+            # penalty (the reference assigns it in evaluateUpdate right
+            # after, member.js:111 — same value, same order)
+            self.last_update_damp_score = self.damp_score
+            if self.damp_score > config.get("dampScoringSuppressLimit"):
+                self.suppressed = True
+                events.append(("suppressLimitExceeded",))
+        for name, *args in events:
+            self.emit(name, *args)
         if self.damp_score > config.get("dampScoringSuppressLimit"):
-            self.emit("suppressLimitExceeded")
             self.ringpop.logger.info(
                 "ringpop member damp score exceeded suppress limit"
             )
@@ -219,8 +253,7 @@ class Member(EventEmitter):
             self.ringpop.config.get("dampScoringEnabled")
             and update.address != self.ringpop.whoami()
         ):
-            self._apply_update_penalty()
-            self.last_update_damp_score = self.damp_score
+            self._apply_update_penalty()  # records last_update_damp_score
 
         self.emit("updated", update)
         self.last_update_timestamp = self.now()
@@ -262,6 +295,11 @@ class Membership(EventEmitter):
         self.local_member: Optional[Member] = None
         self.rng = rng or random.Random()
         self.decay_timer = None
+        # bumping this invalidates any in-flight decay callback: an
+        # on_timeout that captured an older generation must neither decay
+        # nor re-arm (stop() during a firing callback would otherwise be
+        # lost, leaving the loop running — or doubled after a restart)
+        self._decay_gen = 0
 
     # -- checksum ---------------------------------------------------------
 
@@ -440,6 +478,10 @@ class Membership(EventEmitter):
             "suppressLimitExceeded",
             lambda: self.emit("memberSuppressLimitExceeded", member),
         )
+        member.on(
+            "suppressRecovered",
+            lambda score: self.emit("memberSuppressRecovered", member, score),
+        )
         return member
 
     def _update_member(self, update: Update, is_local: bool = False) -> List[Update]:
@@ -450,10 +492,55 @@ class Membership(EventEmitter):
             )
         return updates
 
-    # -- damping decay loop (driven externally / by the facade) ----------
+    # -- damping decay loop (membership/index.js:330-383) ----------------
+
+    def start_damp_score_decayer(self) -> None:
+        """Start the periodic damp-score decay loop (membership/
+        index.js:330-350, interval config.js:62 dampScoringDecayInterval):
+        every interval, every member's flap-penalty score decays
+        exponentially toward dampScoringMin, so suppressed members recover
+        *between* updates rather than only lazily at the next penalty.
+        Idempotent; a no-op when dampScoringDecayEnabled is off or the
+        context has no timer plane (bare fixtures)."""
+        if self.decay_timer is not None:
+            return
+        self._schedule_decay()
+
+    def stop_damp_score_decayer(self) -> None:
+        """membership/index.js:352-357."""
+        self._decay_gen += 1
+        if self.decay_timer is not None:
+            timers = getattr(self.ringpop, "timers", None)
+            if timers is not None:
+                timers.clear_timeout(self.decay_timer)
+            self.decay_timer = None
+
+    def _schedule_decay(self) -> None:
+        config = self.ringpop.config
+        if not config.get("dampScoringDecayEnabled"):
+            return
+        timers = getattr(self.ringpop, "timers", None)
+        if timers is None:
+            return
+        gen = self._decay_gen
+
+        def on_timeout() -> None:
+            if gen != self._decay_gen:
+                return  # stopped (or restarted) while in flight
+            self.decay_timer = None
+            self.decay_members_damp_score()
+            if gen != self._decay_gen:
+                return  # stopped by a decay listener
+            self._schedule_decay()  # loop until stopped or disabled
+
+        self.decay_timer = timers.set_timeout(
+            on_timeout, config.get("dampScoringDecayInterval") / 1000.0
+        )
 
     def decay_members_damp_score(self) -> None:
-        for m in self.members:
+        # snapshot: the sweep runs on the timer thread while joins insert
+        # into self.members at random positions (index.js:285)
+        for m in list(self.members):
             m.decay_damp_score()
 
 
