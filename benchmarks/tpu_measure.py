@@ -228,6 +228,21 @@ def phase_encode_impls(results: dict) -> None:
                 for r in range(n)
             )
             results["encode_unique_bitexact_on_device"] = ok
+            if not ok:
+                # a broken unique_indices promise is silent UB in the TPU
+                # lowering — the production default depends on this holding
+                results["encode_unique_bitexact_FAILURE"] = (
+                    "scatter_unique diverged from scatter on-device: "
+                    "revert checksum_encode.membership_rows' default "
+                    "impl to 'scatter'"
+                )
+                print(
+                    "WARNING: scatter_unique byte-exactness FAILED on "
+                    "this backend — revert membership_rows default to "
+                    "'scatter'",
+                    file=sys.stderr,
+                    flush=True,
+                )
         except Exception as e:
             results["encode_unique_bitexact_on_device"] = {
                 "error": str(e)[:300]
@@ -612,7 +627,14 @@ def main() -> int:
                 )
                 is False
             ):
-                break  # budget gone: keep what we have
+                # budget gone: keep what we have — but the purge above
+                # removed this phase's error keys, so record the crash
+                # explicitly or the artifact would silently omit the phase
+                results["%s_error" % name] = (
+                    "backend crashed; re-exec budget exhausted"
+                )
+                flush()
+                break
             raise AssertionError("unreachable")  # pragma: no cover
         done.add(name)
         _drop_executables()

@@ -179,7 +179,15 @@ def _nogrid_kernel(blk_ref, act_ref, h0_ref, g0_ref, f0_ref,
 
 
 def block_loop_nogrid(
-    h0, g0, f0, blocks, iters, *, chunk: int = 64, interpret: bool = False
+    h0,
+    g0,
+    f0,
+    blocks,
+    iters,
+    *,
+    chunk: int = 64,
+    interpret: bool = False,
+    vmem_budget: int = 8 * 1024 * 1024,
 ):
     """Gridless variant of :func:`block_loop` for the axon tunnel, whose
     remote-compile helper deterministically 500s on ANY grid'd Pallas
@@ -206,12 +214,29 @@ def block_loop_nogrid(
     bp = B + pad
     s = bp // LANE  # sublane count; TILE-padding keeps it a multiple of 8
 
-    # keep the per-call VMEM slab (chunk * 5 * S * LANE u32 words + the
+    # keep the per-call VMEM slab (chunk * 5 * S_t * LANE u32 words + the
     # uint8 mask) within a few MiB as the row count grows, and never pad
-    # the iteration axis past the actual trip count
+    # the iteration axis past the actual trip count.  Two levers, applied
+    # in order: shrink the iteration chunk, then (for very large row
+    # counts, where even a chunk=1 slab of 5*s*LANE words overflows —
+    # B beyond ~420k rows) tile the row/sublane axis too, mapping
+    # independent row tiles through the same gridless kernel.
+    BUDGET = vmem_budget
     chunk = max(1, min(chunk, max_iters))
-    while chunk > 1 and chunk * 5 * s * LANE * 4 > 8 * 1024 * 1024:
+    while chunk > 1 and chunk * 5 * s * LANE * 4 > BUDGET:
         chunk //= 2
+    s_t = s
+    while s_t > 8 and chunk * 5 * s_t * LANE * 4 > BUDGET:
+        s_t = ((s_t + 1) // 2 + 7) // 8 * 8  # halve, sublane-aligned
+    rt = -(-s // s_t)  # row tiles
+    if rt > 1 and rt * s_t > s:  # pad rows up to a whole tile grid
+        extra = (rt * s_t - s) * LANE
+        h0 = jnp.pad(h0, (0, extra))
+        g0 = jnp.pad(g0, (0, extra))
+        f0 = jnp.pad(f0, (0, extra))
+        blocks = jnp.pad(blocks, ((0, extra), (0, 0), (0, 0)))
+        iters = jnp.pad(iters, (0, extra))
+        s = rt * s_t
     ipad = (-max_iters) % chunk
     if ipad:
         blocks = jnp.pad(blocks, ((0, 0), (0, ipad), (0, 0)))
@@ -237,7 +262,7 @@ def block_loop_nogrid(
     call = pl.pallas_call(
         _nogrid_kernel,
         out_shape=[
-            jax.ShapeDtypeStruct((s, LANE), jnp.uint32) for _ in range(3)
+            jax.ShapeDtypeStruct((s_t, LANE), jnp.uint32) for _ in range(3)
         ],
         interpret=interpret,
     )
@@ -251,8 +276,34 @@ def block_loop_nogrid(
         h, g, f = call(slab, act, h, g, f)
         return (h, g, f), None
 
-    (h, g, f), _ = jax.lax.scan(
-        step, (rows(h0), rows(g0), rows(f0)), (slabs, acts)
-    )
-    h, g, f = (x.reshape(bp)[:B] for x in (h, g, f))
+    if rt == 1:
+        (h, g, f), _ = jax.lax.scan(
+            step, (rows(h0), rows(g0), rows(f0)), (slabs, acts)
+        )
+    else:
+        # row-tiled: scan over [rt] tiles (initial rows ride as xs, final
+        # rows come back as ys — tiles are independent), inner scan over
+        # iteration steps, each step one gridless pallas_call on an
+        # [chunk, 5, s_t, LANE] slab that fits the budget
+        slabs_rt = slabs.reshape(steps, chunk, 5, rt, s_t, LANE).transpose(
+            3, 0, 1, 2, 4, 5
+        )
+        acts_rt = acts.reshape(steps, chunk, rt, s_t, LANE).transpose(
+            2, 0, 1, 3, 4
+        )
+
+        def tiles(x):
+            return x.reshape(rt, s_t, LANE)
+
+        def outer(_, tile):
+            slab_t, act_t, ht, gt, ft = tile
+            out, __ = jax.lax.scan(step, (ht, gt, ft), (slab_t, act_t))
+            return None, out
+
+        _, (h, g, f) = jax.lax.scan(
+            outer,
+            None,
+            (slabs_rt, acts_rt, tiles(h0), tiles(g0), tiles(f0)),
+        )
+    h, g, f = (x.reshape(s * LANE)[:B] for x in (h, g, f))
     return h, g, f

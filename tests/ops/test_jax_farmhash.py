@@ -107,3 +107,31 @@ def test_pallas_nogrid_matches_scan():
         a = np.asarray(jfh.hash32_rows(mat, lens, impl="scan"))
         b = np.asarray(jfh.hash32_rows(mat, lens, impl="pallas_nogrid"))
         np.testing.assert_array_equal(a, b)
+
+
+def test_pallas_nogrid_row_tiling_bitexact():
+    """Beyond ~420k rows even a chunk=1 slab exceeds the VMEM budget, so
+    block_loop_nogrid tiles the row/sublane axis too (ADVICE r4, medium).
+    The tiled program must be bit-identical to the untiled one — exercised
+    at a small shape by shrinking the budget."""
+    import numpy as np
+
+    from ringpop_tpu.ops import pallas_farmhash as pf
+
+    rng = np.random.default_rng(11)
+    B, I = 4000, 3  # pads to s=32 sublanes; tiny budget forces s_t=8, rt=4
+    h0, g0, f0 = (
+        rng.integers(0, 2**32, size=B, dtype=np.uint32) for _ in range(3)
+    )
+    blocks = rng.integers(0, 2**32, size=(B, I, 5), dtype=np.uint32)
+    iters = rng.integers(0, I + 1, size=B).astype(np.int32)
+
+    plain = pf.block_loop_nogrid(
+        h0, g0, f0, blocks, iters, interpret=True
+    )
+    tiled = pf.block_loop_nogrid(
+        h0, g0, f0, blocks, iters, interpret=True,
+        vmem_budget=5 * 8 * 128 * 4,  # one chunk=1, s_t=8 slab exactly
+    )
+    for a, b in zip(plain, tiled):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
