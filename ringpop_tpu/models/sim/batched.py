@@ -87,6 +87,15 @@ class BatchedSimClusters:
         self.params = _resolve_hash_impl(
             base._replace(n=n, gate_phases=False)
         )
+        if (
+            self.params.checksum_mode == "farmhash"
+            and self.params.parity_recompute == "bounded"
+        ):
+            # this runner has no overflow-replay plumbing (a per-cluster
+            # overflow would need per-cluster replays under vmap); pin the
+            # straight-line exact shape instead — same philosophy as
+            # gate_phases=False above
+            self.params = self.params._replace(parity_recompute="full")
         states: List[engine.SimState] = [
             engine.init_state(self.params, seed=seed + i, universe=self.universe)
             for i in range(b)

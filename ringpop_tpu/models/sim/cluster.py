@@ -96,22 +96,19 @@ def _resolve_hash_impl(params: engine.SimParams) -> engine.SimParams:
     key).
 
     ``parity_recompute="auto"``: "gated" (dirty-chunk while_loop — skips
-    clean ticks) on CPU, "full" (straight-line, control-flow-free) on
-    TPU, whose tunnel compile helper 500s on large loop bodies.  Both are
-    bit-identical in trajectory."""
+    clean ticks) on CPU, "bounded" (one straight-line K<=64-row chunk,
+    overflow-replayed by the driver) on TPU, whose tunnel compile helper
+    500s on loop- or cond-wrapped encodes AND on chunks past ~K=64.
+    All shapes are bit-identical in trajectory (overflowed bounded
+    windows are replayed under an exact shape before anyone observes
+    them)."""
     if params.hash_impl == "env":
         from ringpop_tpu.ops.jax_farmhash import _impl_from_env
 
         params = params._replace(hash_impl=_impl_from_env())
-    if params.parity_recompute == "auto":
-        import jax
+    import jax
 
-        params = params._replace(
-            parity_recompute=engine.resolve_parity_recompute(
-                jax.default_backend()
-            )
-        )
-    return params
+    return engine.resolve_auto_parity(params, jax.default_backend())
 
 
 @functools.lru_cache(maxsize=None)
@@ -165,6 +162,40 @@ class SimCluster:
         # re-tracing (Universe hashes by its address tuple)
         self._tick = _tick_fn(self.params, self.universe)
         self._scanned = _scanned_fn(self.params, self.universe)
+        # count of bounded-parity overflow replays (measurement honesty:
+        # a bench window that replayed paid the exact-shape cost too)
+        self.parity_replays = 0
+
+    # -- bounded-parity overflow fallback --------------------------------
+
+    @property
+    def _bounded_parity(self) -> bool:
+        return (
+            self.params.checksum_mode == "farmhash"
+            and self.params.parity_recompute == "bounded"
+        )
+
+    def _exact_params(self) -> engine.SimParams:
+        """The exact-recompute twin config for overflow replays: "full"
+        on TPU (the tunnel can't compile the gated loop), "gated"
+        elsewhere.  Bit-identical trajectories either way."""
+        import jax
+
+        return self.params._replace(
+            parity_recompute=engine.resolve_parity_recompute(
+                jax.default_backend()
+            )
+        )
+
+    def _replay_exact(self, pre_state, run, *args):
+        """A bounded-parity tick/scan overflowed: rows past the K-chunk
+        kept stale checksums, and checksums feed full-sync decisions, so
+        the computed trajectory is NOT parity-exact.  Discard it and
+        replay from the pre-run state under an exact recompute shape
+        (state is immutable, so the pre-run snapshot is just a
+        reference)."""
+        self.parity_replays += 1
+        return run(pre_state, *args)
 
     # -- lifecycle --------------------------------------------------------
 
@@ -178,14 +209,26 @@ class SimCluster:
     def step(self, inputs: Optional[engine.TickInputs] = None) -> engine.TickMetrics:
         if inputs is None:
             inputs = engine.TickInputs.quiet(self.params.n)
-        self.state, metrics = self._tick(self.state, inputs)
+        pre = self.state
+        self.state, metrics = self._tick(pre, inputs)
+        if self._bounded_parity and int(metrics.parity_overflow) > 0:
+            self.state, metrics = self._replay_exact(
+                pre, _tick_fn(self._exact_params(), self.universe), inputs
+            )
         return jax.tree.map(np.asarray, metrics)
 
     def run(self, schedule: EventSchedule):
         """Scan the tick over a dense event schedule; returns stacked
         per-tick metrics (a TickMetrics of [T]-arrays)."""
         inputs = schedule.as_inputs()
-        self.state, metrics = self._scanned(self.state, inputs)
+        pre = self.state
+        self.state, metrics = self._scanned(pre, inputs)
+        if self._bounded_parity and int(
+            np.asarray(metrics.parity_overflow).sum()
+        ):
+            self.state, metrics = self._replay_exact(
+                pre, _scanned_fn(self._exact_params(), self.universe), inputs
+            )
         return jax.tree.map(np.asarray, metrics)
 
     def run_until_converged(self, max_ticks: int = 200, quiet_after: int = 0) -> int:

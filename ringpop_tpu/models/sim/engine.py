@@ -31,8 +31,11 @@ Discrete-time model and its documented deviation envelope:
 - A failed direct ping triggers ping-req *within the same tick* (the
   reference's 1.5s/5s timeouts span protocol periods; the sender's gossip
   loop blocks on the exchange either way, gossip/index.js:61-87).
-- Ping-req probes carry no piggybacked changes (the reference piggybacks on
-  ping-req too); dissemination via ping + response + full-sync dominates.
+- Ping-req carries dissemination both ways like the reference (sender
+  piggyback out, issueAsReceiver + full-sync back — see phase 7); the
+  one remaining envelope: the intermediary's relay ping to the TARGET is
+  modeled as reachability only (no piggyback on the M->T leg), and one
+  loss draw covers each sender<->intermediary round trip.
 - Within a tick, phases apply in a fixed order: join -> ping send ->
   receiver apply -> responses (incl. full-sync) -> sender apply -> ping-req
   -> suspicion expiry -> checksums.  The reference's per-message ordering is
@@ -123,8 +126,16 @@ class SimParams(NamedTuple):
     # cached value), required on the axon tunnel whose compile helper
     # 500s on large bodies nested under while/cond (DIAG_PARITY_N.json +
     # the round-4 fine bisect: encode+hash compiles straight-line at any
-    # size, fails inside while_loop).  "auto" = resolved to the backend's
-    # right answer at SimCluster construction.
+    # size, fails inside while_loop).  "bounded" = ONE K-row
+    # (dirty_batch) encode+hash chunk with no loop: the chunk body is
+    # straight-line, optionally cond-gated off clean ticks like any
+    # other phase — the TPU-compilable shape of the dirty-row win.  Ticks
+    # with more than K dirty rows OVERFLOW (counted in
+    # TickMetrics.parity_overflow); the driver must then discard the
+    # run and replay it under an exact shape (SimCluster does this
+    # automatically), because rows past the chunk would have kept stale
+    # checksums and checksums feed full-sync decisions.  "auto" =
+    # resolved to the backend's right answer at SimCluster construction.
     parity_recompute: str = "auto"
     # True: rare phases (revive, rejoin, join, reshuffle, piggyback,
     # apply, responses, ping-req, expiry) run under lax.cond and cost
@@ -215,6 +226,12 @@ class TickMetrics(NamedTuple):
     faulties_marked: jax.Array
     distinct_checksums: jax.Array  # among participating (alive+ready) nodes
     converged: jax.Array  # bool
+    # rows the "bounded" parity recompute could NOT cover this tick
+    # (n_dirty - dirty_batch, clamped at 0; always 0 in other modes).
+    # Nonzero means THIS TICK'S checksums are stale for the uncovered
+    # rows and the trajectory from here is not parity-exact: the driver
+    # must replay from the pre-run state with an exact recompute shape.
+    parity_overflow: jax.Array
 
 
 def _overrides(u_status, u_inc, c_status, c_inc):
@@ -391,14 +408,38 @@ def _hash_impl(params: SimParams):
 
 
 def resolve_parity_recompute(backend: str) -> str:
-    """ONE policy for resolving ``parity_recompute="auto"`` per backend
-    (used both by SimCluster's construction-time resolution and by
-    _checksums_where's trace-time fallback for direct engine users):
-    "gated" skips clean ticks via a dirty-chunk while_loop — the CPU
-    win; "full" is the straight-line shape the TPU tunnel's compile
-    helper can actually compile.  Bit-identical trajectories either
-    way."""
+    """The EXACT recompute shape per backend — every dirty row covered,
+    no overflow possible: "gated" (dirty-chunk while_loop, the CPU win)
+    or "full" (straight-line full recompute, the shape the TPU tunnel's
+    compile helper accepts).  Used for the overflow-replay fallback
+    (SimCluster/ShardedSim ``_exact_params`` — which must NEVER resolve
+    to "bounded", or a replay would overflow again and loop) and as
+    _checksums_where's trace-time "auto" fallback for direct engine
+    users, who have no replay plumbing.  Bit-identical trajectories
+    either way."""
     return "full" if backend == "tpu" else "gated"
+
+
+def resolve_auto_parity(params: "SimParams", backend: str) -> "SimParams":
+    """Driver-level ``parity_recompute="auto"`` resolution (SimCluster /
+    ShardedSim construction — contexts WITH overflow-replay plumbing):
+    "bounded" on TPU — one straight-line K-row encode chunk per
+    recompute — and "gated" elsewhere.  The TPU auto chunk is K=32, the
+    measured round-5 sweep optimum (DIAG_BOUNDED.json: K=32 -> 13.7k
+    node-ticks/s quiet-window median, K=64 -> 8.8k, K=256 -> compile
+    helper 500; replay exactness makes a small K safe — epidemic waves
+    overflow ANY compilable K and fall back identically).  An explicit
+    ``parity_recompute="bounded"`` keeps the caller's dirty_batch
+    untouched (diagnostic sweeps need K above the auto pick)."""
+    if params.parity_recompute == "auto":
+        if backend == "tpu":
+            params = params._replace(
+                parity_recompute="bounded",
+                dirty_batch=min(params.dirty_batch, 32),
+            )
+        else:
+            params = params._replace(parity_recompute="gated")
+    return params
 
 
 def _checksums_where(
@@ -409,6 +450,10 @@ def _checksums_where(
     cached: jax.Array,  # [N] uint32
 ):
     """Per-row checksum with dirty-row caching.
+
+    Returns ``(checksum [N] uint32, overflow scalar int32)`` — overflow
+    is nonzero only in "bounded" parity mode, when more rows were dirty
+    than the one bounded chunk covers (see SimParams.parity_recompute).
 
     The farmhash-parity string build + hash is by far the hottest op in the
     tick; a row's checksum only changes when its VIEW changed, so unchanged
@@ -421,14 +466,18 @@ def _checksums_where(
     """
 
     n_dirty = jnp.sum(dirty, dtype=jnp.int32)
+    no_overflow = jnp.int32(0)
 
     def recompute_all(_):
         fresh = compute_checksums(state, universe, params)
         return jnp.where(dirty, fresh, cached)
 
     if params.checksum_mode == "fast":
-        return jax.lax.cond(
-            n_dirty > 0, recompute_all, lambda _: cached, operand=None
+        return (
+            jax.lax.cond(
+                n_dirty > 0, recompute_all, lambda _: cached, operand=None
+            ),
+            no_overflow,
         )
 
     recompute_shape = params.parity_recompute
@@ -442,7 +491,51 @@ def _checksums_where(
     if recompute_shape == "full":
         # straight-line: no cond, no while.  Recomputing a clean row is
         # bit-neutral, so dirty tracking is simply unused here.
-        return compute_checksums(state, universe, params)
+        return compute_checksums(state, universe, params), no_overflow
+
+    if recompute_shape == "bounded":
+        # ONE bounded K-row chunk, no loop: gather the first K dirty rows
+        # (by index), encode + hash just those, scatter into the cache.
+        # Ticks with n_dirty > K overflow: the uncovered rows keep stale
+        # checksums, so the caller MUST replay from pre-run state under
+        # an exact shape (the returned overflow count, surfaced via
+        # TickMetrics.parity_overflow, is the signal — SimCluster handles
+        # it automatically).  On the axon tunnel the chunk always runs
+        # STRAIGHT-LINE, even when the other phases are cond-gated: the
+        # round-5 bisect (DIAG_BOUNDED.json) showed the compile helper
+        # 500s on a cond whose body holds even the K-row encode — the
+        # restriction is any control flow around an encode graph, not
+        # just while_loops or doubled bodies.  Elsewhere the cond skips
+        # clean ticks like every other phase.
+        k = min(params.dirty_batch, params.n)
+        n = params.n
+
+        def recompute_bounded(_):
+            (idx,) = jnp.nonzero(dirty, size=k, fill_value=0)
+            idx = idx.astype(jnp.int32)
+            lane_ok = jnp.arange(k, dtype=jnp.int32) < n_dirty
+            bufs, lens = ce.membership_rows(
+                universe,
+                _rows(state.known, idx, n),
+                _rows(state.status, idx, n),
+                stamp_to_ms(_rows(state.inc, idx, n), params),
+                max_digits=params.max_digits,
+            )
+            fresh = jfh.hash32_rows(bufs, lens, impl=_hash_impl(params))
+            tgt = jnp.where(lane_ok, idx, n)  # n drops
+            return cached.at[tgt].set(fresh, mode="drop")
+
+        import jax as _jax
+
+        chunk_gate = params.gate_phases and _jax.default_backend() != "tpu"
+        out = _phase(
+            chunk_gate,
+            n_dirty > 0,
+            recompute_bounded,
+            lambda _: cached,
+            None,
+        )
+        return out, jnp.maximum(n_dirty - k, 0)
 
     k = min(params.dirty_batch, params.n)
 
@@ -487,8 +580,11 @@ def _checksums_where(
         _, out = jax.lax.while_loop(cond, body, (jnp.int32(0), cached))
         return out
 
-    return jax.lax.cond(
-        n_dirty > 0, recompute_chunked, lambda _: cached, operand=None
+    return (
+        jax.lax.cond(
+            n_dirty > 0, recompute_chunked, lambda _: cached, operand=None
+        ),
+        no_overflow,
     )
 
 
@@ -1063,7 +1159,7 @@ def tick(
 
     # mid-tick checksums (receivers respond with post-update checksums);
     # only rows whose view changed since last tick's cache are rehashed
-    mid_checksum = _checksums_where(
+    mid_checksum, mid_overflow = _checksums_where(
         state, universe, params, dirty, state.checksum
     )
 
@@ -1132,9 +1228,33 @@ def tick(
     # ---- phase 7: ping-req (indirect probe) ---------------------------
     # only nodes whose DIRECT ping failed probe indirectly; on a healthy
     # steady-state tick nobody does, so the [N, N] top-k and the whole
-    # suspect-apply run under lax.cond (draws are salt-pure, skip-safe)
+    # suspect-apply run under lax.cond (draws are salt-pure, skip-safe).
+    # The exchange carries dissemination both ways, like the reference:
+    # the probing sender piggybacks its changes on each ping-req body
+    # (ping-req-sender.js:74-79 issueAsSender — one bump per selected
+    # intermediary, bump-even-if-unreachable like the ping path's quirk),
+    # the intermediary applies them (server/protocol/ping-req.js:46) and
+    # answers with issueAsReceiver(source, sourceInc, checksum) — origin
+    # filter, budget bump, full-sync on checksum mismatch — which the
+    # sender applies before judging reachability
+    # (ping-req-sender.js:132-139, server/protocol/ping-req.js:62-66).
+    # Deviation envelope (documented): the intermediary's relay ping to
+    # the target is modeled as reachability only — its OWN piggyback
+    # exchange with the target (ping-sender semantics on the M->T leg)
+    # is not carried; dissemination rides the A<->M legs above.  One
+    # loss draw covers each A<->M round trip.
     need_pr = valid_send & ~delivered
+    K_pr = params.ping_req_size
 
+    # Checksum serialization (same envelope the ping path already uses —
+    # advertised_checksum is last tick's value, the response compare is
+    # the mid-tick value): BOTH sides of the ping-req full-sync decision
+    # use mid-tick checksums.  A fresh post-leg-2 recompute would be a
+    # THIRD encode per tick — it cannot live inside this phase's cond
+    # (the tunnel's compile helper rejects any encode under control
+    # flow, DIAG_BOUNDED.json) and hoisting it straight-line made the
+    # full-mode tick heavy enough to kernel-fault the TPU worker at a
+    # 32-tick scan.  The host oracle mirrors this choice bitwise.
     def _ping_req_phase(state):
         pr_rand = _uniform(state.rng, (n, n), salt=29)
         pr_ok = (
@@ -1143,16 +1263,16 @@ def tick(
             & need_pr[:, None]
         )
         pr_score = jnp.where(pr_ok, pr_rand, 2.0)
-        neg_prtop, pr_sel = jax.lax.top_k(-pr_score, params.ping_req_size)
+        neg_prtop, pr_sel = jax.lax.top_k(-pr_score, K_pr)
         pr_valid = -neg_prtop < 1.5
 
         m_alive = state.proc_alive[pr_sel]
         m_conn = partition[pr_sel] == partition[:, None]
-        loss1 = _uniform(state.rng, (n, params.ping_req_size), salt=31) < params.packet_loss
+        loss1 = _uniform(state.rng, (n, K_pr), salt=31) < params.packet_loss
         responder = pr_valid & m_alive & m_conn & ~loss1  # intermediary ok
         t_alive = jnp.where(need_pr, state.proc_alive[tgt], False)
         t_conn = partition[pr_sel] == partition[tgt][:, None]
-        loss2 = _uniform(state.rng, (n, params.ping_req_size), salt=37) < params.packet_loss
+        loss2 = _uniform(state.rng, (n, K_pr), salt=37) < params.packet_loss
         reached = responder & t_alive[:, None] & t_conn & ~loss2
 
         any_responded = jnp.any(responder, axis=1)
@@ -1162,9 +1282,185 @@ def tick(
             jnp.where(need_pr[:, None], pr_valid, False),
             dtype=jnp.int32,
         )
+        # the ping-req body's sourceIncarnationNumber is read at BUILD
+        # time — after this period's ping/response exchanges (phases 5-6)
+        # may have refuted and bumped the sender's self-incarnation
+        pr_self_inc = state.inc[jnp.arange(n), jnp.arange(n)]
 
+        # -- leg 1: sender piggyback (issueAsSender per selected slot) --
+        # slot k's body holds the changes still active at that call with
+        # pb + k + 1 <= max_pb; every valid slot bumps whether or not the
+        # intermediary is reachable (the dissemination.js:142-155 quirk)
+        pb0, active0 = state.ch_pb, state.ch_active
+        n_slots = jnp.sum(pr_valid, axis=1).astype(jnp.int32)  # [N]
+        new_pb = pb0 + jnp.where(active0, n_slots[:, None], 0)
+        over_pr = active0 & (new_pb > max_pb[:, None])
+        state = state._replace(ch_pb=new_pb, ch_active=active0 & ~over_pr)
+
+        karange = jnp.arange(K_pr, dtype=jnp.int32)
+        send_k = (  # [N, K, N]: slot-k message content per sender
+            active0[:, None, :]
+            & (
+                pb0[:, None, :] + karange[None, :, None] + 1
+                <= max_pb[:, None, None]
+            )
+            & pr_valid[:, :, None]
+        )
+        arrive = send_k & responder[:, :, None]
+
+        # -- leg 2: intermediaries apply (winner-combine per subject) --
+        nk = n * K_pr
+        segf = jnp.where(responder, pr_sel, n).reshape(nk)
+        keysf = jnp.where(
+            arrive,
+            _pack_key(state.ch_inc, state.ch_status)[:, None, :],
+            jnp.int32(-1),
+        ).reshape(nk, n)
+        recv_key_pr = jax.ops.segment_max(
+            keysf, segf, num_segments=n + 1
+        )[:n]
+        recv_mask_pr = recv_key_pr >= 0
+        wrow = _rows(recv_key_pr, jnp.clip(segf, 0, n - 1), n)
+        is_w = (keysf == wrow) & (keysf >= 0)
+        flat_ids = jnp.broadcast_to(
+            jnp.arange(nk, dtype=jnp.int32)[:, None], (nk, n)
+        )
+        winner_flat = jax.ops.segment_min(
+            jnp.where(is_w, flat_ids, nk), segf, num_segments=n + 1
+        )[:n]
+        final_w = is_w & (flat_ids == _rows(winner_flat, jnp.clip(segf, 0, n - 1), n))
+        NEG = jnp.int32(-(2**31))
+        src3 = jnp.broadcast_to(
+            state.ch_source[:, None, :], (n, K_pr, n)
+        ).reshape(nk, n)
+        srcinc3 = jnp.broadcast_to(
+            state.ch_source_inc[:, None, :], (n, K_pr, n)
+        ).reshape(nk, n)
+        u_source_pr = jax.ops.segment_max(
+            jnp.where(final_w, src3, NEG), segf, num_segments=n + 1
+        )[:n]
+        u_srcinc_pr = jax.ops.segment_max(
+            jnp.where(final_w, srcinc3, NEG), segf, num_segments=n + 1
+        )[:n]
+        state, applied_prm, started_m, _ = _apply_updates(
+            state,
+            now,
+            recv_mask_pr,
+            (recv_key_pr % 4).astype(jnp.int32),
+            recv_key_pr // 4,
+            u_source_pr,
+            u_srcinc_pr,
+        )
+        state = state._replace(
+            susp_deadline=jnp.where(
+                started_m,
+                tick_next + params.suspicion_ticks,
+                state.susp_deadline,
+            )
+        )
+        # -- leg 3: responses (issueAsReceiver per arriving ping-req) --
+        # budget bump: one per arriving message, origin-filtered BEFORE
+        # the bump (dissemination.js:147-160); aggregated like the ping
+        # path's phase 5.5 (one respondable set per intermediary)
+        cnt_sm = jnp.zeros((n, n), jnp.int32)  # [M, sender] arrivals
+        for k in range(K_pr):
+            cnt_sm = cnt_sm.at[
+                pr_sel[:, k], jnp.arange(n, dtype=jnp.int32)
+            ].add(jnp.where(responder[:, k], 1, 0), mode="drop")
+        prrecv = jnp.sum(cnt_sm, axis=1, dtype=jnp.int32)
+        src_c = jnp.clip(state.ch_source, 0, n - 1)
+        hits = jnp.where(
+            state.ch_active
+            & (state.ch_source >= 0)
+            & (state.ch_source_inc == pr_self_inc[src_c]),
+            jnp.take_along_axis(cnt_sm, src_c, axis=1),
+            0,
+        )
+        bump_pr = (prrecv[:, None] > 0) & state.ch_active
+        nb = jnp.where(bump_pr, prrecv[:, None] - hits, 0)
+        ch_pb2 = state.ch_pb + nb
+        over2 = state.ch_active & (ch_pb2 > max_pb[:, None])
+        respondable_pr = bump_pr & ~over2
+        state = state._replace(
+            ch_pb=ch_pb2, ch_active=state.ch_active & ~over2
+        )
+
+        # response content per slot, winner-combined at the sender (max
+        # key; ties keep the lowest slot): filtered changes, or the
+        # intermediary's full membership when it has nothing to send and
+        # the checksums disagree (dissemination.js:101-114)
+        best_key = jnp.full((n, n), -1, jnp.int32)
+        best_src = jnp.full((n, n), -1, jnp.int32)
+        best_srcinc = jnp.zeros((n, n), jnp.int32)
+        pr_fs_count = jnp.int32(0)
+        for k in range(K_pr):
+            mk = pr_sel[:, k]
+            ex_k = responder[:, k]
+            resp_k = (
+                ex_k[:, None]
+                & _rows(respondable_pr, mk, n)
+                & ~(
+                    (_rows(state.ch_source, mk, n) == node)
+                    & (
+                        _rows(state.ch_source_inc, mk, n)
+                        == pr_self_inc[:, None]
+                    )
+                )
+            )
+            fs_k = ex_k & ~jnp.any(resp_k, axis=1) & (
+                mid_checksum[mk] != mid_checksum
+            )
+            pr_fs_count = pr_fs_count + jnp.sum(fs_k, dtype=jnp.int32)
+            fs_mask_k = fs_k[:, None] & _rows(state.known, mk, n)
+            mask_k = resp_k | fs_mask_k
+            st_k = jnp.where(
+                fs_mask_k,
+                _rows(state.status, mk, n),
+                _rows(state.ch_status, mk, n),
+            )
+            inc_k = jnp.where(
+                fs_mask_k,
+                _rows(state.inc, mk, n),
+                _rows(state.ch_inc, mk, n),
+            )
+            src_k = jnp.where(
+                fs_mask_k,
+                jnp.broadcast_to(mk[:, None], (n, n)),
+                _rows(state.ch_source, mk, n),
+            )
+            srcinc_k = jnp.where(
+                fs_mask_k,
+                state.inc[mk, mk][:, None],
+                _rows(state.ch_source_inc, mk, n),
+            )
+            key_k = jnp.where(mask_k, _pack_key(inc_k, st_k), jnp.int32(-1))
+            better = key_k > best_key
+            best_key = jnp.where(better, key_k, best_key)
+            best_src = jnp.where(better, src_k, best_src)
+            best_srcinc = jnp.where(better, srcinc_k, best_srcinc)
+        state, applied_prr, started_r, _ = _apply_updates(
+            state,
+            now,
+            best_key >= 0,
+            (best_key % 4).astype(jnp.int32),
+            best_key // 4,
+            best_src,
+            best_srcinc,
+        )
+        state = state._replace(
+            susp_deadline=jnp.where(
+                started_r,
+                tick_next + params.suspicion_ticks,
+                state.susp_deadline,
+            )
+        )
+
+        # -- suspect verdict, on post-response state (the reference
+        # makes the suspect AFTER every ping-req callback applied its
+        # changes: ping-req-sender.js:249-262) --
         sus_mask = jnp.zeros((n, n), bool).at[jnp.arange(n), tgt].set(mark_suspect)
         sus_inc = state.inc[jnp.arange(n), tgt]  # member's current inc
+        cur_self = state.inc[jnp.arange(n), jnp.arange(n)]
         state, applied_sus, started_s, _ = _apply_updates(
             state,
             now,
@@ -1172,20 +1468,27 @@ def tick(
             jnp.full((n, n), SUSPECT, jnp.int32),
             jnp.broadcast_to(sus_inc[:, None], (n, n)),
             jnp.broadcast_to(node, (n, n)).astype(jnp.int32),
-            jnp.broadcast_to(cur_self_inc[:, None], (n, n)),
+            jnp.broadcast_to(cur_self[:, None], (n, n)),
         )
         state = state._replace(
             susp_deadline=jnp.where(
                 started_s, tick_next + params.suspicion_ticks, state.susp_deadline
             )
         )
-        return state, applied_sus, ping_req_count
+        applied_pr = applied_prm | applied_prr | applied_sus
+        return state, applied_sus, applied_pr, ping_req_count, pr_fs_count
 
-    state, applied_sus, ping_req_count = _phase(
+    state, applied_sus, applied_pr, ping_req_count, pr_fs_count = _phase(
         gate,
         jnp.any(need_pr),
         _ping_req_phase,
-        lambda s: (s, jnp.zeros((n, n), bool), jnp.int32(0)),
+        lambda s: (
+            s,
+            jnp.zeros((n, n), bool),
+            jnp.zeros((n, n), bool),
+            jnp.int32(0),
+            jnp.int32(0),
+        ),
         state,
     )
 
@@ -1231,14 +1534,14 @@ def tick(
     )
 
     # ---- phase 9: checksums + metrics ---------------------------------
-    # rows untouched since the mid-tick values reuse them; only responses,
-    # ping-req suspects, and suspicion expiries dirty views in phases 6-8
+    # rows untouched since the mid-tick values reuse them; phases 6-8
+    # dirty views via responses, the ping-req exchange, and expiry
     dirty_late = (
         jnp.any(applied_resp, axis=1)
-        | jnp.any(applied_sus, axis=1)
+        | jnp.any(applied_pr, axis=1)
         | jnp.any(applied_faulty, axis=1)
     )
-    checksum = _checksums_where(
+    checksum, late_overflow = _checksums_where(
         state, universe, params, dirty_late, mid_checksum
     )
     state = state._replace(checksum=checksum)
@@ -1259,14 +1562,17 @@ def tick(
         pings_sent=jnp.sum(valid_send.astype(jnp.int32)),
         pings_delivered=jnp.sum(delivered.astype(jnp.int32)),
         ping_reqs=ping_req_count,
-        full_syncs=jnp.sum(full_sync.astype(jnp.int32)),
+        full_syncs=jnp.sum(full_sync.astype(jnp.int32)) + pr_fs_count,
         changes_applied=jnp.sum(
-            (applied_ping | applied_resp | ja_applied).astype(jnp.int32)
+            (applied_ping | applied_resp | applied_pr | ja_applied).astype(
+                jnp.int32
+            )
         ),
         suspects_marked=jnp.sum(applied_sus.astype(jnp.int32)),
         faulties_marked=jnp.sum(applied_faulty.astype(jnp.int32)),
         distinct_checksums=distinct,
         converged=distinct <= 1,
+        parity_overflow=mid_overflow + late_overflow,
     )
 
     state = state._replace(rng=_fold(state.rng, 0x5EED))

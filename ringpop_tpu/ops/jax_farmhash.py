@@ -41,7 +41,11 @@ def _impl_from_env() -> str:
     """Block-loop implementation: 'pallas' (opt-in via RINGPOP_TPU_PALLAS=1),
     'pallas_nogrid' (RINGPOP_TPU_PALLAS=nogrid — the gridless variant the
     axon tunnel's compile helper accepts; interpret mode off-TPU so tests
-    validate the kernels everywhere) or the default 'scan' lowering."""
+    validate the kernels everywhere) or 'scan'.  With the env unset the
+    default is backend-dependent: 'pallas_nogrid' on a real TPU (21x the
+    scan lowering at the parity bench shape, RESULTS_TPU_r04.json —
+    measured, digest-validated), 'scan' elsewhere (interpret-mode Pallas
+    on CPU is orders slower than the scan lowering)."""
     import os
 
     val = os.environ.get("RINGPOP_TPU_PALLAS", "")
@@ -49,7 +53,11 @@ def _impl_from_env() -> str:
         return "pallas"
     if val == "nogrid":
         return "pallas_nogrid"
-    return "scan"
+    if val == "scan":
+        return "scan"
+    import jax
+
+    return "pallas_nogrid" if jax.default_backend() == "tpu" else "scan"
 
 
 def _rot(x: jax.Array, r: int) -> jax.Array:

@@ -229,6 +229,9 @@ class ShardedSim:
         )
         self._tick = make_sharded_tick(self.params, self.universe, self.mesh)
         self._scan = make_sharded_scan(self.params, self.universe, self.mesh)
+        # count of bounded-parity overflow replays, like SimCluster's — a
+        # window that replayed paid the exact-shape cost too
+        self.parity_replays = 0
 
     def bootstrap(self):
         inputs = engine.TickInputs.quiet(self.params.n)._replace(
@@ -236,14 +239,51 @@ class ShardedSim:
         )
         return self.step(inputs)
 
+    def _exact_params(self) -> engine.SimParams:
+        """Exact-recompute twin for bounded-parity overflow replays (same
+        contract as SimCluster's — see engine.SimParams.parity_recompute)."""
+        return self.params._replace(
+            parity_recompute=engine.resolve_parity_recompute(
+                jax.default_backend()
+            )
+        )
+
+    def _maybe_replay_exact(self, pre, metrics, make_fn, inputs):
+        """Bounded-parity overflow fallback, shared by step/run: discard
+        the overflowed result and replay from the pre-run state under the
+        exact twin program (same contract as SimCluster's)."""
+        bounded = (
+            self.params.checksum_mode == "farmhash"
+            and self.params.parity_recompute == "bounded"
+        )
+        if not bounded or not int(np.asarray(metrics.parity_overflow).sum()):
+            return None
+        self.parity_replays += 1
+        return make_fn(self._exact_params(), self.universe, self.mesh)(
+            pre, inputs
+        )
+
     def step(self, inputs: Optional[engine.TickInputs] = None):
         if inputs is None:
             inputs = engine.TickInputs.quiet(self.params.n)
-        self.state, metrics = self._tick(self.state, inputs)
+        pre = self.state
+        self.state, metrics = self._tick(pre, inputs)
+        replayed = self._maybe_replay_exact(
+            pre, metrics, make_sharded_tick, inputs
+        )
+        if replayed is not None:
+            self.state, metrics = replayed
         return jax.tree.map(np.asarray, metrics)
 
     def run(self, schedule) -> engine.TickMetrics:
-        self.state, metrics = self._scan(self.state, schedule.as_inputs())
+        inputs = schedule.as_inputs()
+        pre = self.state
+        self.state, metrics = self._scan(pre, inputs)
+        replayed = self._maybe_replay_exact(
+            pre, metrics, make_sharded_scan, inputs
+        )
+        if replayed is not None:
+            self.state, metrics = replayed
         return jax.tree.map(np.asarray, metrics)
 
     def checksums(self) -> np.ndarray:

@@ -522,21 +522,183 @@ class OracleCluster:
                 self._apply(s, ups, tick_next)
 
         # ---- phase 7: ping-req ------------------------------------------
+        # carries dissemination both ways, mirroring engine phase 7: the
+        # probing sender piggybacks issueAsSender on each body
+        # (ping-req-sender.js:74-79), intermediaries apply and answer
+        # issueAsReceiver (origin filter, bump, full-sync —
+        # server/protocol/ping-req.js:46,62-66), the sender applies the
+        # responses, THEN judges reachability (ping-req-sender.js:132-139,
+        # 249-262).  Same envelope as the engine: the relay ping to the
+        # target is reachability-only.
+        K_pr = p.ping_req_size
         need_pr = valid_send & ~delivered
         pr_rand = _np_uniform(self.rng, (n, n), salt=29)
         pr_ok = pingable & (np.arange(n)[None, :] != target[:, None]) & need_pr[:, None]
         pr_score = np.where(pr_ok, pr_rand, np.float32(2.0))
-        pr_sel = np.argsort(pr_score, axis=1, kind="stable")[:, : p.ping_req_size]
+        pr_sel = np.argsort(pr_score, axis=1, kind="stable")[:, :K_pr]
         pr_valid = np.take_along_axis(pr_score, pr_sel, axis=1) < 1.5
         m_alive = self.proc_alive[pr_sel]
         m_conn = self.partition[pr_sel] == self.partition[:, None]
-        loss1 = _np_uniform(self.rng, (n, p.ping_req_size), salt=31) < p.packet_loss
+        loss1 = _np_uniform(self.rng, (n, K_pr), salt=31) < p.packet_loss
         responder = pr_valid & m_alive & m_conn & ~loss1
         t_alive = np.where(need_pr, self.proc_alive[tgt], False)
         t_conn = self.partition[pr_sel] == self.partition[tgt][:, None]
-        loss2 = _np_uniform(self.rng, (n, p.ping_req_size), salt=37) < p.packet_loss
+        loss2 = _np_uniform(self.rng, (n, K_pr), salt=37) < p.packet_loss
         reached = responder & t_alive[:, None] & t_conn & ~loss2
         mark_suspect = need_pr & responder.any(axis=1) & ~reached.any(axis=1)
+
+        # body sourceIncarnationNumber: read at build time, post-phase-6
+        pr_self_inc = np.array(
+            [self._self_inc(i) for i in range(n)], np.int64
+        )
+
+        # leg 1: issueAsSender per valid slot, sequentially (each slot
+        # bumps every still-active change, reachable intermediary or not)
+        pr_bodies: Dict[tuple, Dict[int, _Change]] = {}
+        for i in np.flatnonzero(need_pr):
+            node_i = self.nodes[i]
+            for k in range(K_pr):
+                if not pr_valid[i, k]:
+                    continue
+                body: Dict[int, _Change] = {}
+                for j in list(node_i.changes.keys()):
+                    ch = node_i.changes[j]
+                    ch.pb += 1
+                    if ch.pb > max_pb[i]:
+                        del node_i.changes[j]
+                    else:
+                        body[j] = dataclasses.replace(ch)
+                pr_bodies[(int(i), k)] = body
+
+        # leg 2: intermediaries apply (winner-combine per subject; ties
+        # keep the lowest (sender, slot) pair — engine flat-id order)
+        inbox_pr: Dict[int, Dict[int, tuple]] = {}
+        for i in np.flatnonzero(need_pr):
+            for k in range(K_pr):
+                if not responder[i, k]:
+                    continue
+                m = int(pr_sel[i, k])
+                box = inbox_pr.setdefault(m, {})
+                flat = int(i) * K_pr + k
+                for j, ch in pr_bodies[(int(i), k)].items():
+                    key = ch.inc * 4 + ch.status
+                    cur = box.get(j)
+                    if cur is None or key > cur[0] or (
+                        key == cur[0] and flat < cur[1]
+                    ):
+                        box[j] = (key, flat, ch)
+        for m, box in sorted(inbox_pr.items()):
+            ups = [
+                {
+                    "address": self.addresses[j],
+                    "status": STATUS_STR[ch.status],
+                    "incarnationNumber": ch.inc,
+                    "source": self.addresses[ch.source] if ch.source >= 0 else None,
+                    "sourceIncarnationNumber": ch.source_inc,
+                }
+                for j, (_, _, ch) in sorted(box.items())
+            ]
+            self._apply(m, ups, tick_next)
+
+        # full-sync decisions use MID-TICK checksums on both sides — the
+        # engine's serialization choice (a fresh post-leg-2 recompute
+        # would be a third encode per tick; see engine phase 7's note)
+
+        # leg 3a: receiver bumps on the intermediary (issueAsReceiver per
+        # arriving ping-req, origin filter before the bump; aggregated
+        # like the ping path's phase 5.5)
+        prrecv = np.zeros(n, np.int64)
+        cnt_sm = np.zeros((n, n), np.int64)
+        for i in np.flatnonzero(need_pr):
+            for k in range(K_pr):
+                if responder[i, k]:
+                    m = int(pr_sel[i, k])
+                    prrecv[m] += 1
+                    cnt_sm[m, i] += 1
+        pr_respondable: List[Dict[int, _Change]] = [dict() for _ in range(n)]
+        for m in np.flatnonzero(prrecv > 0):
+            node_m = self.nodes[m]
+            for j in list(node_m.changes.keys()):
+                ch = node_m.changes[j]
+                hits = 0
+                if ch.source >= 0 and ch.source_inc == pr_self_inc[ch.source]:
+                    hits = int(cnt_sm[m, ch.source])
+                ch.pb += int(prrecv[m]) - hits
+                if ch.pb > max_pb[m]:
+                    del node_m.changes[j]
+                else:
+                    pr_respondable[m][j] = dataclasses.replace(ch)
+
+        # leg 3b: responses, winner-combined at the sender (max key; ties
+        # keep the lowest slot).  Payloads come from the post-leg-2
+        # snapshot, exactly like the engine builds every slot's content
+        # before one batched apply.
+        known7, status7, inc7 = self._views()
+        diag_inc_7 = np.array(
+            [self._self_inc(i) for i in range(n)], np.int64
+        )
+        pr_fs = 0
+        for i in np.flatnonzero(need_pr):
+            best: Dict[int, tuple] = {}
+            for k in range(K_pr):
+                if not responder[i, k]:
+                    continue
+                m = int(pr_sel[i, k])
+                resp = {
+                    j: ch
+                    for j, ch in pr_respondable[m].items()
+                    if not (
+                        ch.source == i and ch.source_inc == pr_self_inc[i]
+                    )
+                }
+                if resp:
+                    content = [
+                        (
+                            j,
+                            ch.inc * 4 + ch.status,
+                            {
+                                "address": self.addresses[j],
+                                "status": STATUS_STR[ch.status],
+                                "incarnationNumber": ch.inc,
+                                "source": self.addresses[ch.source]
+                                if ch.source >= 0
+                                else None,
+                                "sourceIncarnationNumber": ch.source_inc,
+                            },
+                        )
+                        for j, ch in resp.items()
+                    ]
+                elif mid_checksum[m] != mid_checksum[i]:
+                    pr_fs += 1
+                    content = [
+                        (
+                            j,
+                            int(inc7[m, j]) * 4 + int(status7[m, j]),
+                            {
+                                "address": self.addresses[j],
+                                "status": STATUS_STR[status7[m, j]],
+                                "incarnationNumber": int(inc7[m, j]),
+                                "source": self.addresses[m],
+                                "sourceIncarnationNumber": int(diag_inc_7[m]),
+                            },
+                        )
+                        for j in np.flatnonzero(known7[m])
+                    ]
+                else:
+                    content = []
+                for j, key, upd in content:
+                    cur = best.get(j)
+                    if cur is None or key > cur[0]:
+                        best[j] = (key, upd)
+            if best:
+                self._apply(
+                    i,
+                    [upd for j, (_, upd) in sorted(best.items())],
+                    tick_next,
+                )
+
+        # suspect verdict on post-response state (reference: makeSuspect
+        # after every ping-req callback applied its changes)
         for i in np.flatnonzero(mark_suspect):
             t = int(tgt[i])
             m = self.nodes[i].membership.find_member_by_address(self.addresses[t])
@@ -549,11 +711,12 @@ class OracleCluster:
                         "status": Status.suspect,
                         "incarnationNumber": cur_inc,
                         "source": self.addresses[i],
-                        "sourceIncarnationNumber": int(diag_inc_post5[i]),
+                        "sourceIncarnationNumber": int(self._self_inc(i)),
                     }
                 ],
                 tick_next,
             )
+        full_syncs += pr_fs
 
         # ---- phase 8: suspicion expiry ----------------------------------
         for i in range(n):

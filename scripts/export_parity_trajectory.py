@@ -1,0 +1,173 @@
+#!/usr/bin/env python3
+"""Export a FULL-TRAJECTORY Node-parity artifact (PARITY_TRAJECTORY.json).
+
+Where PARITY_REPLAY.json validates static membership views at a handful
+of checkpoints, this artifact carries a scripted 1k tick-cluster
+schedule and, for EVERY tick, the checksum-group view the reference's
+tick-cluster harness prints (scripts/tick-cluster.js:87-114 groups live
+nodes by membership checksum) — plus, per group, one representative
+observer's complete membership view.  A single `node
+validate_trajectory.js PARITY_TRAJECTORY.json` run on any Node machine
+(scripts/replay_node.md) then proves, per tick, that every represented
+group's checksum is exactly `farmhash.hash32` of ringpop-node's
+`generateChecksumString` over a real view — the per-tick checksum
+SEQUENCE of the trajectory, not just isolated snapshots.
+
+Groups beyond --max-groups per tick (early-dissemination ticks can have
+hundreds of one-node groups) carry counts but no representative view;
+the artifact records how many member-bytes went unrepresented so the
+coverage is explicit.  Converged ticks (one group) are always fully
+covered.
+
+Usage: python scripts/export_parity_trajectory.py [-n 1024] [--ticks 36]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+STATUS_STR = {0: "alive", 1: "suspect", 2: "faulty", 3: "leave"}
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(prog="export-parity-trajectory")
+    p.add_argument("-n", type=int, default=1024)
+    p.add_argument("--ticks", type=int, default=42)  # reconverges at 39
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--max-groups", type=int, default=2)
+    p.add_argument("--output", "-o", default="PARITY_TRAJECTORY.json")
+    args = p.parse_args(argv)
+
+    from ringpop_tpu.utils.util import pin_cpu_platform
+
+    pin_cpu_platform()
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from ringpop_tpu.models.sim import engine
+    from ringpop_tpu.models.sim.cluster import default_addresses
+    from ringpop_tpu.ops import checksum_encode as ce
+
+    n = args.n
+    params = engine.SimParams(
+        n=n, checksum_mode="farmhash", suspicion_ticks=6
+    )
+    addresses = default_addresses(n)
+    universe = ce.Universe.from_addresses(addresses)
+    state = engine.init_state(params, seed=args.seed, universe=universe)
+    tick = jax.jit(lambda s, i: engine.tick(s, i, params, universe))
+
+    rng = np.random.default_rng(args.seed)
+    victims = [int(v) for v in rng.choice(n, size=4, replace=False)]
+    # the scripted schedule (recorded in the artifact): bootstrap ->
+    # kill wave -> suspects -> faulties -> revive -> reconverge
+    schedule = {0: {"join": "all"}, 8: {"kill": victims[:2]},
+                20: {"revive": victims[:2]}, 24: {"kill": victims[2:]}}
+
+    ticks_out = []
+    total_unrepresented = 0
+    for t in range(args.ticks):
+        inputs = engine.TickInputs.quiet(n)
+        ev = schedule.get(t, {})
+        if ev.get("join") == "all":
+            inputs = inputs._replace(join=jnp.ones(n, bool))
+        if "kill" in ev:
+            kill = np.zeros(n, bool)
+            kill[ev["kill"]] = True
+            inputs = inputs._replace(kill=jnp.asarray(kill))
+        if "revive" in ev:
+            rv = np.zeros(n, bool)
+            rv[ev["revive"]] = True
+            inputs = inputs._replace(revive=jnp.asarray(rv))
+        state, m = tick(state, inputs)
+
+        checksums = np.asarray(state.checksum)
+        part = np.asarray(state.proc_alive) & np.asarray(state.ready)
+        groups: dict = {}
+        for i in np.flatnonzero(part):
+            groups.setdefault(int(checksums[i]), []).append(int(i))
+        ordered = sorted(
+            groups.items(), key=lambda kv: (-len(kv[1]), kv[0])
+        )
+        known = status = inc_ms = None
+        entry_groups = []
+        for gi, (cs, members_idx) in enumerate(ordered):
+            g = {"checksum": cs, "count": len(members_idx)}
+            if gi < args.max_groups:
+                if known is None:
+                    known = np.asarray(state.known)
+                    status = np.asarray(state.status)
+                    inc_ms = np.asarray(engine.stamp_to_ms(state.inc, params))
+                o = members_idx[0]
+                g["representative"] = {
+                    "observer": addresses[o],
+                    # compact member triples: [address, status, incMs]
+                    "members": [
+                        [
+                            addresses[j],
+                            STATUS_STR[int(status[o, j])],
+                            int(inc_ms[o, j]),
+                        ]
+                        for j in range(n)
+                        if known[o, j]
+                    ],
+                }
+            else:
+                total_unrepresented += len(members_idx)
+            entry_groups.append(g)
+        ticks_out.append(
+            {
+                "tick": t,
+                "distinct_checksums": len(ordered),
+                "groups": entry_groups,
+            }
+        )
+
+    converged = ticks_out[-1]["distinct_checksums"] == 1
+    assert converged, "trajectory must reconverge by its last tick"
+    out = {
+        "description": (
+            "Full-trajectory membership-checksum parity vs ringpop-node: "
+            "per tick, live nodes grouped by checksum (the tick-cluster "
+            "convergence view, scripts/tick-cluster.js:87-114); each "
+            "represented group's checksum must equal farmhash.hash32 of "
+            "generateChecksumString (lib/membership/index.js:101-123 — "
+            "sort members by address, concat "
+            "address+status+incarnationNumber, join ';') over the "
+            "representative view.  Member triples are "
+            "[address, status, incarnationNumber]."
+        ),
+        "generator": "scripts/export_parity_trajectory.py",
+        "validator": "scripts/replay_node.md (validate_trajectory.js)",
+        "n": n,
+        "ticks": args.ticks,
+        "seed": args.seed,
+        "schedule": {str(k): v for k, v in schedule.items()},
+        "max_groups_represented_per_tick": args.max_groups,
+        "unrepresented_group_nodes_total": total_unrepresented,
+        "ticks_data": ticks_out,
+    }
+    with open(args.output, "w") as f:
+        json.dump(out, f, separators=(",", ":"))
+    print(
+        json.dumps(
+            {
+                "ticks": len(ticks_out),
+                "final_distinct": ticks_out[-1]["distinct_checksums"],
+                "unrepresented_total": total_unrepresented,
+                "bytes": os.path.getsize(args.output),
+                "output": args.output,
+            }
+        )
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -26,7 +26,13 @@ def main(argv=None) -> int:
     p.add_argument("--output", "-o", default=None)
     args = p.parse_args(argv)
 
-    import jax
+    # the oracle comparison is a host-side workload; pin CPU before any
+    # backend init (the env var alone is captured at sitecustomize import
+    # and ignored afterwards — RESULTS.md round 4)
+    from ringpop_tpu.utils.util import pin_cpu_platform
+
+    pin_cpu_platform()
+    import jax  # noqa: F401
     import numpy as np
 
     from ringpop_tpu.models.sim import engine

@@ -170,3 +170,107 @@ def test_gate_phases_off_is_bitwise_identical():
     for f in m_t._fields:
         a, b = np.asarray(getattr(m_t, f)), np.asarray(getattr(m_f, f))
         assert (a == b).all(), "metric %s diverges" % f
+
+
+def test_bounded_parity_recompute_bitwise_and_overflow_replay():
+    """parity_recompute="bounded" (the TPU shape: one cond-gated K-row
+    encode chunk, no loop) must reproduce the gated trajectory bit-for-bit
+    whenever per-tick dirty counts fit the chunk — and when they DON'T
+    (bootstrap dirties every row), the overflow must surface in
+    TickMetrics.parity_overflow and SimCluster must transparently replay
+    the window under an exact shape so the observable trajectory is
+    IDENTICAL either way."""
+    import numpy as np
+
+    n = 48
+    sched_kill, sched_rev = 7, 24
+
+    def drive(recompute, dirty_batch):
+        p = engine.SimParams(
+            n=n,
+            checksum_mode="farmhash",
+            parity_recompute=recompute,
+            dirty_batch=dirty_batch,
+            packet_loss=0.05,
+            suspicion_ticks=6,
+        )
+        sim = SimCluster(n=n, params=p, seed=2)
+        sim.bootstrap()
+        sched = EventSchedule(ticks=40, n=n)
+        sched.kill[sched_kill, 3] = True
+        sched.revive[sched_rev, 3] = True
+        m = sim.run(sched)
+        return sim, m
+
+    ref_sim, ref_m = drive("gated", 16)
+
+    # chunk covers every per-tick dirty set except bootstrap's: the
+    # bootstrap step overflows (all 48 rows dirty > K=16) and replays
+    bounded_sim, bounded_m = drive("bounded", 16)
+    assert bounded_sim.parity_replays >= 1  # bootstrap overflow replayed
+    for f in ref_sim.state._fields:
+        a = np.asarray(getattr(ref_sim.state, f))
+        b = np.asarray(getattr(bounded_sim.state, f))
+        assert (a == b).all(), "state field %s diverges" % f
+    for f in ref_m._fields:
+        if f == "parity_overflow":
+            continue  # replay-path metric, mode-specific by design
+        a, b = np.asarray(getattr(ref_m, f)), np.asarray(getattr(bounded_m, f))
+        assert (a == b).all(), "metric %s diverges" % f
+
+    # K = n can never overflow (n_dirty <= n): no replays, same trajectory
+    wide_sim, _ = drive("bounded", n)
+    assert wide_sim.parity_replays == 0
+    assert (
+        np.asarray(wide_sim.state.checksum)
+        == np.asarray(ref_sim.state.checksum)
+    ).all()
+
+
+def test_bounded_parity_overflow_metric_from_raw_engine():
+    """Direct engine users see the overflow signal: a bootstrap tick under
+    "bounded" with a small chunk reports n_dirty - K uncovered rows."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    from ringpop_tpu.models.sim.cluster import default_addresses
+    from ringpop_tpu.ops import checksum_encode as ce
+
+    n = 32
+    p = engine.SimParams(
+        n=n, checksum_mode="farmhash", parity_recompute="bounded",
+        dirty_batch=8,
+    )
+    u = ce.Universe.from_addresses(default_addresses(n))
+    st = engine.init_state(p, seed=0, universe=u)
+    inputs = engine.TickInputs.quiet(n)._replace(join=jnp.ones(n, bool))
+    _, m = engine.tick(st, inputs, p, u)
+    assert int(np.asarray(m.parity_overflow)) > 0
+
+
+def test_bounded_parity_straightline_matches_gated():
+    """bounded + gate_phases=False (no cond even around the chunk) is the
+    vmap-safe shape; still bitwise vs the gated reference trajectory."""
+    import numpy as np
+
+    n = 32
+    outs = {}
+    for mode, gate in (("gated", True), ("bounded", False)):
+        p = engine.SimParams(
+            n=n,
+            checksum_mode="farmhash",
+            parity_recompute=mode,
+            gate_phases=gate,
+            dirty_batch=n,  # never overflows
+            suspicion_ticks=4,
+        )
+        sim = SimCluster(n=n, params=p, seed=5)
+        sim.bootstrap()
+        sched = EventSchedule(ticks=24, n=n)
+        sched.kill[6, 2] = True
+        sim.run(sched)
+        outs[mode] = sim.state
+    for f in outs["gated"]._fields:
+        a = np.asarray(getattr(outs["gated"], f))
+        b = np.asarray(getattr(outs["bounded"], f))
+        assert (a == b).all(), "state field %s diverges" % f

@@ -184,3 +184,35 @@ def test_destroyed_channel_refuses_new_connections():
     finally:
         client.destroy()
         server.destroy()
+
+
+def test_malformed_frame_closes_connection(pair):
+    """A peer that sends garbage (a frame that isn't valid JSON) must not
+    crash the server — the connection is dropped/errored and the channel
+    keeps serving well-formed peers (the proxy layer can therefore never
+    see an unparseable head: the transport rejects it first — the analog
+    of proxy-test.js:911-955 'handle body failures' / 'non json head')."""
+    import socket
+    import struct
+
+    a, b = pair
+    b.register("/ok", lambda head, body: ("fine", None))
+
+    host, port = b.host_port.split(":")
+    raw = socket.create_connection((host, int(port)), timeout=2)
+    try:
+        garbage = b"\xff\xfenot json at all"
+        raw.sendall(struct.pack(">I", len(garbage)) + garbage)
+        # server must not hang or crash; it either closes or ignores
+        raw.settimeout(2.0)
+        try:
+            got = raw.recv(65536)
+        except (socket.timeout, ConnectionResetError, OSError):
+            got = b""
+    finally:
+        raw.close()
+    del got  # any response (or close) is fine; the invariant is below
+
+    # the channel still serves well-formed requests afterwards
+    head, _ = a.request(b.host_port, "/ok", None, None, timeout_s=2)
+    assert head == "fine"

@@ -38,3 +38,42 @@ def test_replay_artifact_checksums_rederive():
     # the artifact must exercise the three status spellings that appear
     # in reference checksum strings during churn
     assert {"alive", "suspect", "faulty"} <= statuses
+
+
+def test_trajectory_groups_native_oracle():
+    """Every represented group checksum in PARITY_TRAJECTORY.json
+    re-derives with the independent native farmhash oracle from the
+    representative view's reference checksum string — the in-image twin
+    of scripts/replay_node.md's validate_trajectory.js."""
+    import json
+    import os
+
+    from ringpop_tpu.ops import native
+
+    path = os.path.join(
+        os.path.dirname(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__)
+        ))),
+        "PARITY_TRAJECTORY.json",
+    )
+    if not os.path.exists(path):
+        import pytest
+
+        pytest.skip("PARITY_TRAJECTORY.json not generated")
+    art = json.load(open(path))
+    checked = 0
+    for t in art["ticks_data"]:
+        for g in t["groups"]:
+            rep = g.get("representative")
+            if rep is None:
+                continue
+            s = ";".join(
+                "%s%s%d" % (m[0], m[1], m[2])
+                for m in sorted(rep["members"], key=lambda m: m[0])
+            )
+            assert native.hash32(s) == g["checksum"], (
+                "tick %d observer %s" % (t["tick"], rep["observer"])
+            )
+            checked += 1
+    assert checked >= art["ticks"], checked  # at least one group per tick
+    assert art["ticks_data"][-1]["distinct_checksums"] == 1
