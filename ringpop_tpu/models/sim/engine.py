@@ -60,7 +60,11 @@ Cited reference behavior preserved exactly:
   updates (on_membership_event.js:86-104).
 - ping-req: k=3 random pingable members excluding the target
   (ping-req-sender.js:293-296); all-responders-say-unreachable => suspect
-  (ping-req-sender.js:249-262); no responders => inconclusive, no-op.
+  (ping-req-sender.js:249-262); no responders => inconclusive, no-op; the
+  exchange carries dissemination both ways (issueAsSender per body,
+  ping-req-sender.js:74-79; issueAsReceiver + full-sync in the answer,
+  server/protocol/ping-req.js:62-66) and the suspect verdict lands after
+  the response changes apply (ping-req-sender.js:132-139).
 """
 
 from __future__ import annotations

@@ -53,7 +53,9 @@ def _impl_from_env() -> str:
         return "pallas"
     if val == "nogrid":
         return "pallas_nogrid"
-    if val == "scan":
+    if val:
+        # any other explicit value (incl. "0"/"scan") disables the
+        # kernels — the natural inverse of the documented opt-ins
         return "scan"
     import jax
 
