@@ -60,8 +60,9 @@ def phase_headline(results: dict) -> None:
     # execution regardless of scan length (DIAG_1K.json), so a 32-tick
     # window measures the tunnel, not the engine.  Since round 5 the
     # farmhash window is the SAME 256 ticks: the bounded parity recompute
-    # (K=32 chunk; engine.resolve_auto_parity) scans 256 ticks without
-    # faulting the worker (DIAG_BOUNDED.json).  Measurement hygiene
+    # (auto K=4 chunk; engine.resolve_auto_parity — 256-tick scans
+    # validated fault-free at K=32 and re-validated by the K-ladder
+    # probes at 16/8/4, DIAG_BOUNDED.json + RESULTS.md).  Hygiene
     # (round-5 verdict item 7): every headline rate is the MEDIAN of
     # REPS warm runs with min/max recorded — state mutates between runs,
     # which defeats the tunnel's identical-execution result cache.

@@ -428,18 +428,26 @@ def resolve_auto_parity(params: "SimParams", backend: str) -> "SimParams":
     """Driver-level ``parity_recompute="auto"`` resolution (SimCluster /
     ShardedSim construction — contexts WITH overflow-replay plumbing):
     "bounded" on TPU — one straight-line K-row encode chunk per
-    recompute — and "gated" elsewhere.  The TPU auto chunk is K=32, the
-    measured round-5 sweep optimum (DIAG_BOUNDED.json: K=32 -> 13.7k
-    node-ticks/s quiet-window median, K=64 -> 8.8k, K=256 -> compile
-    helper 500; replay exactness makes a small K safe — epidemic waves
-    overflow ANY compilable K and fall back identically).  An explicit
+    recompute — and "gated" elsewhere.  The TPU auto chunk is K=4: the
+    round-5 chip ladder measured the 256-tick quiet-window median at
+    K=256 -> compile-helper 500, K=64 -> 18.2k node-ticks/s, K=32 ->
+    23.0k, K=16 -> 41.8k, K=8 -> 52.7k, K=4 -> 70.6k — per-chunk cost
+    dominates, so smaller is faster.  The overflow cliff is
+    K-indifferent at WINDOW granularity: every SWIM update disseminates
+    to the whole cluster, so a wave whose per-tick dirty counts pass
+    through [5, 31] keeps doubling past 32 within the same window — any
+    window that overflows K=4 also overflows K=32, and the replay
+    (which discards whole windows) costs the same.  Only per-STEP
+    drivers see a difference (a K=4 step replays on the wave's first
+    few ticks where K=32 wouldn't — each a cheap single-tick exact
+    replay), and replay exactness covers both.  An explicit
     ``parity_recompute="bounded"`` keeps the caller's dirty_batch
     untouched (diagnostic sweeps need K above the auto pick)."""
     if params.parity_recompute == "auto":
         if backend == "tpu":
             params = params._replace(
                 parity_recompute="bounded",
-                dirty_batch=min(params.dirty_batch, 32),
+                dirty_batch=min(params.dirty_batch, 4),
             )
         else:
             params = params._replace(parity_recompute="gated")
