@@ -274,3 +274,21 @@ def test_bounded_parity_straightline_matches_gated():
         a = np.asarray(getattr(outs["gated"], f))
         b = np.asarray(getattr(outs["bounded"], f))
         assert (a == b).all(), "state field %s diverges" % f
+
+
+def test_resolve_auto_parity_policy():
+    """The driver-level auto resolution: bounded K=4 on TPU (the round-5
+    ladder optimum), gated on CPU with dirty_batch untouched; explicit
+    bounded keeps the caller's K; the exact-fallback resolver never
+    returns bounded (a bounded replay would overflow again and loop)."""
+    p = engine.SimParams(n=64, checksum_mode="farmhash")
+    t = engine.resolve_auto_parity(p, "tpu")
+    assert (t.parity_recompute, t.dirty_batch) == ("bounded", 4)
+    c = engine.resolve_auto_parity(p, "cpu")
+    assert (c.parity_recompute, c.dirty_batch) == ("gated", p.dirty_batch)
+    e = engine.resolve_auto_parity(
+        p._replace(parity_recompute="bounded", dirty_batch=64), "tpu"
+    )
+    assert e.dirty_batch == 64  # explicit bounded: caller's K kept
+    for backend in ("tpu", "cpu"):
+        assert engine.resolve_parity_recompute(backend) != "bounded"
