@@ -60,7 +60,40 @@ def _is_compile_helper_500(exc: BaseException) -> bool:
     return is_compile_helper_500(exc)
 
 
-def _mode_rate(n: int, ticks: int, mode: str, gate: bool = True) -> tuple:
+def _runlog_recorder(config: dict):
+    """Optional telemetry trail: BENCH_RUNLOG_DIR=<dir> makes every
+    measured window write a JSONL run log (obs.RunRecorder) so the
+    BENCH_* artifacts can be generated from recorded data instead of
+    hand-curated.  Unset (the default): no recording, no overhead."""
+    d = os.environ.get("BENCH_RUNLOG_DIR")
+    if not d:
+        return None
+    from ringpop_tpu.obs import RunRecorder
+
+    return RunRecorder(d + os.sep, config=dict(config, tool="bench.py"))
+
+
+def _profile_ctx(phase: str):
+    """Flag-gated jax.profiler capture (BENCH_PROFILE=1) around a bench
+    phase; traces land next to the run logs so a tick-cost regression
+    (e.g. the 23% between-session tunnel swing in RESULTS.md) can be
+    diagnosed from the artifact instead of by re-running with prints."""
+    if os.environ.get("BENCH_PROFILE") != "1":
+        import contextlib
+
+        return contextlib.nullcontext()
+    import jax
+
+    d = os.path.join(
+        os.environ.get("BENCH_RUNLOG_DIR") or ".",
+        "profile-%s" % phase,
+    )
+    return jax.profiler.trace(d)
+
+
+def _mode_rate(
+    n: int, ticks: int, mode: str, gate: bool = True, recorder=None
+) -> tuple:
     import jax
 
     from ringpop_tpu.models.sim import engine
@@ -95,9 +128,25 @@ def _mode_rate(n: int, ticks: int, mode: str, gate: bool = True) -> tuple:
 
     warm_replays = sim.parity_replays
     t0 = time.perf_counter()
-    metrics = sim.run(sched)
-    jax.block_until_ready(sim.state)
+    with _profile_ctx(mode):
+        metrics = sim.run(sched)
+        jax.block_until_ready(sim.state)
     elapsed = time.perf_counter() - t0
+    if recorder is not None:
+        # record AFTER the clock stops: the JSONL fold is host-side
+        # Python and must not ride inside the measured window (the rate
+        # with recording on must be comparable to hand-measured rounds).
+        # One run log carries every measured window, delimited by the
+        # "window" events.
+        recorder.describe("sim.engine", sim.params.n, sim.params)
+        recorder.record_event(
+            "window",
+            mode=mode,
+            gate_phases=gate,
+            converged_in=converged_in,
+        )
+        recorder.record_ticks(metrics)
+        recorder.record_phase("measure[%s]" % mode, elapsed)
     # bounded-parity replays INSIDE the measured window (quiet windows
     # have none; any nonzero count means the rate includes exact-shape
     # replay cost and must be read accordingly)
@@ -141,18 +190,38 @@ _HELPER_BACKOFFS = (0.0, 10.0, 25.0)
 
 
 def _mode_rate_retry(
-    n: int, ticks: int, mode: str, gate: bool = True
+    n: int, ticks: int, mode: str, gate: bool = True, recorder=None
 ) -> tuple:
-    return _retry_helper_500(_mode_rate, n, ticks, mode, gate=gate)
+    return _retry_helper_500(
+        _mode_rate, n, ticks, mode, gate=gate, recorder=recorder
+    )
 
 
 def _measure(n: int, ticks: int) -> dict:
     import jax
 
     platform = jax.devices()[0].platform
+    recorder = _runlog_recorder(
+        {"n": n, "ticks": ticks, "platform": platform}
+    )
+    try:
+        return _measure_recorded(n, ticks, platform, recorder)
+    finally:
+        # a failed window must not leave a ZERO-BYTE runlog behind (the
+        # file is created at recorder construction; close() writes the
+        # header, which is the minimum valid log — the schema gate would
+        # otherwise fail on the orphan).  finish() on the success paths
+        # already closed it; close() is then a no-op.
+        if recorder is not None:
+            recorder.close()
+
+
+def _measure_recorded(n: int, ticks: int, platform: str, recorder) -> dict:
     gate = True
     straightline_error = None
-    rate, elapsed, metrics, _ = _mode_rate_retry(n, ticks, "fast")
+    rate, elapsed, metrics, _ = _mode_rate_retry(
+        n, ticks, "fast", recorder=recorder
+    )
     if platform == "tpu" and os.environ.get("BENCH_STRAIGHTLINE") == "1":
         # OPT-IN since round 5: the straight-line program now carries the
         # always-on ping-req dissemination legs (a 22x tick-cost handicap
@@ -162,7 +231,7 @@ def _measure(n: int, ticks: int) -> dict:
         # every later phase of the bench with UNAVAILABLE
         try:
             rate_sl, elapsed_sl, metrics_sl, _ = _mode_rate_retry(
-                n, ticks, "fast", gate=False
+                n, ticks, "fast", gate=False, recorder=recorder
             )
             if rate_sl > rate:
                 gate = False
@@ -221,23 +290,28 @@ def _measure(n: int, ticks: int) -> dict:
     # the whole artifact: the tunneled chip's remote compile helper
     # occasionally 500s on large graphs, and a fast-mode number with a
     # parity_error beats an error-only artifact.  On TPU the parity tick
-    # runs the "bounded" recompute (one straight-line K=32-row dirty
-    # chunk per recompute; overflowed windows replay under an exact
-    # shape — engine.SimParams.parity_recompute), whose 256-tick scans
-    # are stable on the chip (DIAG_BOUNDED.json round 5: 23.2k
-    # node-ticks/s warm, no worker fault) — the round-4 32-tick cap is
-    # gone, though BENCH_PARITY_TICKS still overrides.  Parity is pinned
-    # to gate_phases=True regardless of the fast-mode winner: the gated
+    # runs the "bounded" recompute with the auto-resolved K=4 dirty
+    # chunk (the round-5 ladder optimum — engine.resolve_auto_parity;
+    # one straight-line K-row chunk per recompute, overflowed windows
+    # replayed under an exact shape — engine.SimParams.parity_recompute),
+    # whose 256-tick scans are stable on the chip (DIAG_BOUNDED.json
+    # round 5: no worker fault) — the round-4 32-tick cap is gone,
+    # though BENCH_PARITY_TICKS still overrides.  Parity is pinned to
+    # gate_phases=True regardless of the fast-mode winner: the gated
     # program is the shape the compile ladder validated.
     parity_ticks = int(os.environ.get("BENCH_PARITY_TICKS", str(ticks)))
     try:
         parity_rate, _, _, parity_replays = _retry_helper_500(
-            _mode_rate, n, parity_ticks, "farmhash", gate=True
+            _mode_rate, n, parity_ticks, "farmhash", gate=True,
+            recorder=recorder,
         )
         result["parity_mode_node_ticks_per_sec"] = round(parity_rate, 1)
         result["parity_mode_vs_baseline"] = round(parity_rate / baseline, 2)
         result["parity_ticks"] = parity_ticks  # its own window, not `ticks`
         result["parity_replays_in_window"] = parity_replays
+        if recorder is not None:
+            result["runlog"] = recorder.path
+            recorder.finish(result=result)
         return result
     except Exception as e:
         exc = e
@@ -252,6 +326,12 @@ def _measure(n: int, ticks: int) -> dict:
     if _is_compile_helper_500(exc):
         from ringpop_tpu.utils.util import reexec_retry
 
+        if recorder is not None:
+            # execve replaces the process: the finally-close in
+            # _measure never runs, so seal the log (header + whatever
+            # windows landed) here to keep it schema-valid
+            recorder.record_event("reexec", reason="parity compile 500")
+            recorder.close()
         if (
             reexec_retry(
                 "BENCH_PARITY_ATTEMPT", PARITY_RETRIES, 20.0, __file__
@@ -269,6 +349,9 @@ def _measure(n: int, ticks: int) -> dict:
     result["parity_attempts"] = tries + len(_HELPER_BACKOFFS) * int(
         os.environ.get("BENCH_PARITY_ATTEMPT", "0")
     )
+    if recorder is not None:
+        result["runlog"] = recorder.path
+        recorder.finish(result=result)
     return result
 
 
@@ -321,7 +404,12 @@ def main() -> int:
         "cpu" in os.environ.get("JAX_PLATFORMS", "")
         and not os.environ.get("BENCH_PINNED_FALLBACK")
     )
-    if not intentional_cpu:
+    # a bench-made CPU pin (this process's last resort, or inherited by a
+    # re-exec'd child) is TERMINAL: the tunnel already exhausted its
+    # budget when the pin was made, so children must not burn the re-exec
+    # budget re-probing it — they measure CPU and mark the artifact
+    pinned_fallback = bool(os.environ.get("BENCH_PINNED_FALLBACK"))
+    if not intentional_cpu and not pinned_fallback:
         _reexec_if_cpu_fallback()
 
     last_err = None
@@ -347,21 +435,30 @@ def main() -> int:
                 os.environ.get("BENCH_REEXEC_ATTEMPT", "0")
             )
             if result.get("platform") != "tpu" and not intentional_cpu:
-                # a SILENT mid-loop CPU fallback (an in-process backend
-                # re-init after a transient error can memoize a failed
-                # axon init and quietly hand back CPU) must not be
-                # accepted while fresh-interpreter budget remains — only
-                # a new process can re-attempt the plugin init
-                from ringpop_tpu.utils.util import reexec_retry
+                # re-read the pin: THIS process may have pinned CPU on
+                # its last-resort attempt after the snapshot above
+                pinned_fallback = pinned_fallback or bool(
+                    os.environ.get("BENCH_PINNED_FALLBACK")
+                )
+                if not pinned_fallback:
+                    # a SILENT mid-loop CPU fallback (an in-process
+                    # backend re-init after a transient error can memoize
+                    # a failed axon init and quietly hand back CPU) must
+                    # not be accepted while fresh-interpreter budget
+                    # remains — only a new process can re-attempt the
+                    # plugin init.  A PINNED fallback skips this: the pin
+                    # itself was the end of the budget, and a re-exec'd
+                    # child inherits the pinned env anyway.
+                    from ringpop_tpu.utils.util import reexec_retry
 
-                if (
-                    reexec_retry(
-                        "BENCH_REEXEC_ATTEMPT", RETRIES, RETRY_SLEEP_S,
-                        __file__,
-                    )
-                    is not False
-                ):  # pragma: no cover — execve does not return
-                    raise AssertionError("unreachable")
+                    if (
+                        reexec_retry(
+                            "BENCH_REEXEC_ATTEMPT", RETRIES, RETRY_SLEEP_S,
+                            __file__,
+                        )
+                        is not False
+                    ):  # pragma: no cover — execve does not return
+                        raise AssertionError("unreachable")
                 # explicit marker: this number is a CPU measurement taken
                 # because the TPU tunnel was unavailable (any path: pinned
                 # last-resort, exhausted re-exec budget, or a silent

@@ -22,6 +22,9 @@ Package layout
 - ``api``      — the Ringpop facade (bootstrap/lookup/whoami/handleOrProxy/
                  proxyReq/getStats...), admin control plane, request proxy,
                  tracer subsystem, CLI and tick-cluster harness.
+- ``obs``      — unified telemetry: JSONL run recorder, statsd bridge onto
+                 the reference key scheme, Prometheus text exposition
+                 (``/admin/metrics``), sim trace-tap adapters.
 
 Int64 note: SWIM incarnation numbers in the reference are `Date.now()`
 millisecond timestamps (member.js:80), which do not fit in int32.  The

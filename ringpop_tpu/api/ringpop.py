@@ -156,7 +156,10 @@ class Ringpop(EventEmitter):
         )
         self.ring.on("added", self._on_ring_server_added)
         self.ring.on("removed", self._on_ring_server_removed)
-        self.ring.on("checksumComputed", lambda: self.stat("increment", "ring.checksum-computed"))
+        self.ring.on(
+            "checksumComputed",
+            lambda *a: self.stat("increment", "ring.checksum-computed"),
+        )
         self.on("ready", self._on_ready)
 
     def _on_member_suppressed(self, member) -> None:

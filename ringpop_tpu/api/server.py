@@ -34,8 +34,10 @@ class RingpopServer:
         # forwarding + health (server/index.js:34-37)
         r("/proxy/req", self.proxy_req)
         r("/health", self.health)
-        # admin (server/admin/index.js:24-68)
+        # admin (server/admin/index.js:24-68; /admin/metrics is this
+        # port's addition — Prometheus text next to the JSON stats)
         r("/admin/stats", self.admin_stats)
+        r("/admin/metrics", self.admin_metrics)
         r("/admin/lookup", self.admin_lookup)
         r("/admin/reload", self.admin_reload)
         r("/admin/debugSet", self.admin_debug_set)
@@ -155,6 +157,17 @@ class RingpopServer:
 
     def admin_stats(self, head, body) -> Tuple[Any, Any]:
         return None, self.ringpop.get_stats()
+
+    def admin_metrics(self, head, body) -> Tuple[Any, Any]:
+        """Prometheus text exposition of this node's state (the modern
+        collector-facing twin of /admin/stats).  The body is the plain
+        exposition string; content-type negotiation is the HTTP
+        gateway's concern, not the channel's."""
+        from ringpop_tpu.obs.prometheus import render_ringpop_metrics
+
+        return {"contentType": "text/plain; version=0.0.4"}, (
+            render_ringpop_metrics(self.ringpop)
+        )
 
     def admin_lookup(self, head, body) -> Tuple[Any, Any]:
         key = (body or {}).get("key")

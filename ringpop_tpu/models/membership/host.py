@@ -501,9 +501,16 @@ class Membership(EventEmitter):
         exponentially toward dampScoringMin, so suppressed members recover
         *between* updates rather than only lazily at the next penalty.
         Idempotent; a no-op when dampScoringDecayEnabled is off or the
-        context has no timer plane (bare fixtures)."""
+        context has no timer plane (bare fixtures).
+
+        The generation bump invalidates any IN-FLIGHT timeout callback
+        from a previous loop: a callback that fired (clearing
+        ``decay_timer``) concurrently with this start() would otherwise
+        pass its stale-generation check and re-arm a SECOND live loop
+        alongside the one armed here."""
         if self.decay_timer is not None:
             return
+        self._decay_gen += 1
         self._schedule_decay()
 
     def stop_damp_score_decayer(self) -> None:

@@ -132,7 +132,11 @@ class HashRing(EventEmitter):
     def compute_checksum(self) -> int:
         server_name_str = ";".join(sorted(self.servers.keys()))
         self.checksum = self.hash_func(server_name_str)
-        self.emit("checksumComputed")
+        # blob payload for the ring.checksum.computed trace tap
+        self.emit(
+            "checksumComputed",
+            {"checksum": self.checksum, "serverCount": len(self.servers)},
+        )
         return self.checksum
 
     # -- queries ----------------------------------------------------------

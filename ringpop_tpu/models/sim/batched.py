@@ -106,19 +106,34 @@ class BatchedSimClusters:
         # shared per-(params, universe) executables, as in SimCluster
         self._scanned = _vscanned_fn(self.params, self.universe)
         self._vtick = _vtick_fn(self.params, self.universe)
+        # optional telemetry sink (obs.RunRecorder via attach_recorder)
+        self.recorder = None
+
+    def attach_recorder(self, recorder) -> None:
+        """Attach an obs.RunRecorder; bootstrap()/run() metrics fold into
+        it.  Rows carry per-cluster [B] vectors per counter (the vmapped
+        leading axis); totals fold only the scalar fields."""
+        recorder.describe("sim.engine[batched]", self.n, self.params, b=self.b)
+        self.recorder = recorder
 
     def bootstrap(self) -> engine.TickMetrics:
         inputs = engine.TickInputs.quiet(self.n)._replace(
             join=jnp.ones(self.n, bool)
         )
         self.state, m = self._vtick(self.state, inputs)
-        return jax.tree.map(np.asarray, m)
+        m = jax.tree.map(np.asarray, m)
+        if self.recorder is not None:
+            self.recorder.record_ticks(jax.tree.map(lambda a: a[None], m))
+        return m
 
     def run(self, schedule: EventSchedule) -> engine.TickMetrics:
         """Scan the same [T, N] event schedule through every cluster;
         metrics come back [T, B]-shaped."""
         self.state, ms = self._scanned(self.state, schedule.as_inputs())
-        return jax.tree.map(np.asarray, ms)
+        ms = jax.tree.map(np.asarray, ms)
+        if self.recorder is not None:
+            self.recorder.record_ticks(ms)
+        return ms
 
     def checksums(self) -> np.ndarray:
         """[B, N] per-cluster membership checksums."""

@@ -165,6 +165,18 @@ class SimCluster:
         # count of bounded-parity overflow replays (measurement honesty:
         # a bench window that replayed paid the exact-shape cost too)
         self.parity_replays = 0
+        # optional telemetry sink (obs.RunRecorder via attach_recorder):
+        # every step()/run() folds its metrics into the run log
+        self.recorder = None
+
+    def attach_recorder(self, recorder) -> None:
+        """Attach an obs.RunRecorder; subsequent step()/run() metrics are
+        folded into it (per-tick rows + totals/histograms), and bounded-
+        parity overflow replays are logged as events.  The recorder's
+        config is enriched with this cluster's static telemetry context
+        (engine params incl. which checksum-recompute path is compiled)."""
+        recorder.describe("sim.engine", self.params.n, self.params)
+        self.recorder = recorder
 
     # -- bounded-parity overflow fallback --------------------------------
 
@@ -195,6 +207,12 @@ class SimCluster:
         (state is immutable, so the pre-run snapshot is just a
         reference)."""
         self.parity_replays += 1
+        if self.recorder is not None:
+            self.recorder.record_event(
+                "parity_overflow_replay",
+                replays=self.parity_replays,
+                shape=self._exact_params().parity_recompute,
+            )
         return run(pre_state, *args)
 
     # -- lifecycle --------------------------------------------------------
@@ -215,7 +233,10 @@ class SimCluster:
             self.state, metrics = self._replay_exact(
                 pre, _tick_fn(self._exact_params(), self.universe), inputs
             )
-        return jax.tree.map(np.asarray, metrics)
+        metrics = jax.tree.map(np.asarray, metrics)
+        if self.recorder is not None:
+            self.recorder.record_ticks(metrics)
+        return metrics
 
     def run(self, schedule: EventSchedule):
         """Scan the tick over a dense event schedule; returns stacked
@@ -229,7 +250,10 @@ class SimCluster:
             self.state, metrics = self._replay_exact(
                 pre, _scanned_fn(self._exact_params(), self.universe), inputs
             )
-        return jax.tree.map(np.asarray, metrics)
+        metrics = jax.tree.map(np.asarray, metrics)
+        if self.recorder is not None:
+            self.recorder.record_ticks(metrics)
+        return metrics
 
     def run_until_converged(self, max_ticks: int = 200, quiet_after: int = 0) -> int:
         """Tick until every live+ready node shares one checksum; returns the

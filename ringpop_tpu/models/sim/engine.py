@@ -236,6 +236,32 @@ class TickMetrics(NamedTuple):
     # rows and the trajectory from here is not parity-exact: the driver
     # must replay from the pre-run state with an exact recompute shape.
     parity_overflow: jax.Array
+    # -- protocol counters the reference emits via statsd ---------------
+    # (all scalar int32, derived from the same masks that drive the
+    # trajectory — bitwise-identical under gate_phases True/False, and
+    # identical across parity-recompute shapes)
+    # applied self-refutes: a node saw itself suspect/faulty in an update
+    # and re-asserted alive with a fresh incarnation (member.js:76-81)
+    refutes: jax.Array
+    # changes retired at the 15*ceil(log10(n+1)) piggyback bound
+    # (dissemination.js:41), summed over the sender-select, receiver-bump
+    # and both ping-req budget bumps
+    piggyback_drops: jax.Array
+    # member records carried inside full-sync responses this tick (the
+    # bytes-equivalent of dissemination.js:101-114 full syncs; one record
+    # ~= one "addr + status + incarnation" wire entry)
+    full_sync_records: jax.Array
+    # failed direct pings whose ping-req round had NO responding
+    # intermediary: no verdict, no-op (ping-req-sender.js:249-262)
+    ping_req_inconclusive: jax.Array
+    # joiners that successfully merged a target's view this tick
+    # (join-sender.js + join-response-merge)
+    join_merges: jax.Array
+    # rows whose view changed and therefore hit the checksum-recompute
+    # path (mid-tick + end-of-tick dirty counts; which recompute SHAPE
+    # runs is static per SimParams.parity_recompute/checksum_mode and is
+    # recorded host-side by the run recorder)
+    dirty_rows: jax.Array
 
 
 def _overrides(u_status, u_inc, c_status, c_inc):
@@ -615,7 +641,8 @@ def _apply_updates(
 ):
     """Vectorized Member.evaluateUpdate over (observer, subject) pairs.
 
-    Returns (state', applied [N,N] bool, applied_status, applied_inc).
+    Returns (state', applied [N,N] bool, suspicion starts, suspicion
+    stops, refutes [N,N] bool — the self-refute cells, always applied).
     """
     n = state.known.shape[0]
     node = jnp.arange(n, dtype=jnp.int32)[:, None]
@@ -665,7 +692,7 @@ def _apply_updates(
         ch_pb=ch_pb,
         susp_deadline=susp,
     )
-    return new_state, gate, start_t, stop_t
+    return new_state, gate, start_t, stop_t, refute
 
 
 def _rows(m: jax.Array, idx: jax.Array, n: int) -> jax.Array:
@@ -888,7 +915,7 @@ def tick(
 
         ja_mask = jax.lax.fori_loop(0, params.join_size, scatter_join_alive, ja_mask)
         self_inc = state.inc[jnp.arange(n), jnp.arange(n)]
-        state, ja_applied, _, _ = _apply_updates(
+        state, ja_applied, _, _, _ = _apply_updates(
             state,
             now,
             ja_mask,
@@ -1045,13 +1072,13 @@ def tick(
         state = state._replace(
             ch_pb=ch_pb, ch_active=state.ch_active & ~over
         )
-        return state, sendable
+        return state, sendable, jnp.sum(over, dtype=jnp.int32)
 
-    state, sendable = _phase(
+    state, sendable, pb_drops_send = _phase(
         gate,
         jnp.any(state.ch_active),
         _sender_piggyback,
-        lambda s: (s, jnp.zeros((n, n), bool)),
+        lambda s: (s, jnp.zeros((n, n), bool), jnp.int32(0)),
         state,
     )
 
@@ -1111,7 +1138,7 @@ def tick(
             seg,
             num_segments=n + 1,
         )[:n]
-        state, applied_ping, started, _ = _apply_updates(
+        state, applied_ping, started, _, refuted = _apply_updates(
             state, now, recv_mask, u_status, u_inc, u_source, u_source_inc
         )
         state = state._replace(
@@ -1119,13 +1146,13 @@ def tick(
                 started, tick_next + params.suspicion_ticks, state.susp_deadline
             )
         )
-        return state, applied_ping
+        return state, applied_ping, jnp.sum(refuted, dtype=jnp.int32)
 
-    state, applied_ping = _phase(
+    state, applied_ping, refutes_recv = _phase(
         gate,
         jnp.any(msg_content),
         _receive_phase,
-        lambda s: (s, jnp.zeros((n, n), bool)),
+        lambda s: (s, jnp.zeros((n, n), bool), jnp.int32(0)),
         state,
     )
     dirty = dirty | jnp.any(applied_ping, axis=1)
@@ -1159,13 +1186,13 @@ def tick(
         state = state._replace(
             ch_pb=ch_pb, ch_active=state.ch_active & ~over_r
         )
-        return state, respondable
+        return state, respondable, jnp.sum(over_r, dtype=jnp.int32)
 
-    state, respondable = _phase(
+    state, respondable, pb_drops_recv = _phase(
         gate,
         jnp.any(state.ch_active),
         _receiver_bump,
-        lambda s: (s, jnp.zeros((n, n), bool)),
+        lambda s: (s, jnp.zeros((n, n), bool), jnp.int32(0)),
         state,
     )
 
@@ -1219,7 +1246,7 @@ def tick(
             _rows(state.ch_source_inc, tgt, n),
         )
         apply_resp = resp_mask | fs_mask
-        state, applied_resp, started_r, _ = _apply_updates(
+        state, applied_resp, started_r, _, refuted_r = _apply_updates(
             state, now, apply_resp, r_status, r_inc, r_source, r_source_inc
         )
         state = state._replace(
@@ -1227,13 +1254,25 @@ def tick(
                 started_r, tick_next + params.suspicion_ticks, state.susp_deadline
             )
         )
-        return state, applied_resp, full_sync
+        return (
+            state,
+            applied_resp,
+            full_sync,
+            jnp.sum(refuted_r, dtype=jnp.int32),
+            jnp.sum(fs_mask, dtype=jnp.int32),
+        )
 
-    state, applied_resp, full_sync = _phase(
+    state, applied_resp, full_sync, refutes_resp, fs_records = _phase(
         gate,
         jnp.any(resp_possible),
         _response_phase,
-        lambda s: (s, jnp.zeros((n, n), bool), jnp.zeros(n, bool)),
+        lambda s: (
+            s,
+            jnp.zeros((n, n), bool),
+            jnp.zeros(n, bool),
+            jnp.int32(0),
+            jnp.int32(0),
+        ),
         state,
     )
 
@@ -1290,6 +1329,11 @@ def tick(
         any_responded = jnp.any(responder, axis=1)
         target_reached = jnp.any(reached, axis=1)
         mark_suspect = need_pr & any_responded & ~target_reached
+        # no responders at all => inconclusive, no verdict
+        # (ping-req-sender.js:249-262 only judges when responses arrived)
+        pr_inconclusive = jnp.sum(
+            need_pr & ~any_responded, dtype=jnp.int32
+        )
         ping_req_count = jnp.sum(
             jnp.where(need_pr[:, None], pr_valid, False),
             dtype=jnp.int32,
@@ -1308,6 +1352,7 @@ def tick(
         new_pb = pb0 + jnp.where(active0, n_slots[:, None], 0)
         over_pr = active0 & (new_pb > max_pb[:, None])
         state = state._replace(ch_pb=new_pb, ch_active=active0 & ~over_pr)
+        pb_drops_pr = jnp.sum(over_pr, dtype=jnp.int32)
 
         karange = jnp.arange(K_pr, dtype=jnp.int32)
         send_k = (  # [N, K, N]: slot-k message content per sender
@@ -1354,7 +1399,7 @@ def tick(
         u_srcinc_pr = jax.ops.segment_max(
             jnp.where(final_w, srcinc3, NEG), segf, num_segments=n + 1
         )[:n]
-        state, applied_prm, started_m, _ = _apply_updates(
+        state, applied_prm, started_m, _, refuted_m = _apply_updates(
             state,
             now,
             recv_mask_pr,
@@ -1396,6 +1441,7 @@ def tick(
         state = state._replace(
             ch_pb=ch_pb2, ch_active=state.ch_active & ~over2
         )
+        pb_drops_pr = pb_drops_pr + jnp.sum(over2, dtype=jnp.int32)
 
         # response content per slot, winner-combined at the sender (max
         # key; ties keep the lowest slot): filtered changes, or the
@@ -1405,6 +1451,7 @@ def tick(
         best_src = jnp.full((n, n), -1, jnp.int32)
         best_srcinc = jnp.zeros((n, n), jnp.int32)
         pr_fs_count = jnp.int32(0)
+        pr_fs_records = jnp.int32(0)
         for k in range(K_pr):
             mk = pr_sel[:, k]
             ex_k = responder[:, k]
@@ -1424,6 +1471,9 @@ def tick(
             )
             pr_fs_count = pr_fs_count + jnp.sum(fs_k, dtype=jnp.int32)
             fs_mask_k = fs_k[:, None] & _rows(state.known, mk, n)
+            pr_fs_records = pr_fs_records + jnp.sum(
+                fs_mask_k, dtype=jnp.int32
+            )
             mask_k = resp_k | fs_mask_k
             st_k = jnp.where(
                 fs_mask_k,
@@ -1450,7 +1500,7 @@ def tick(
             best_key = jnp.where(better, key_k, best_key)
             best_src = jnp.where(better, src_k, best_src)
             best_srcinc = jnp.where(better, srcinc_k, best_srcinc)
-        state, applied_prr, started_r, _ = _apply_updates(
+        state, applied_prr, started_r, _, refuted_rr = _apply_updates(
             state,
             now,
             best_key >= 0,
@@ -1473,7 +1523,7 @@ def tick(
         sus_mask = jnp.zeros((n, n), bool).at[jnp.arange(n), tgt].set(mark_suspect)
         sus_inc = state.inc[jnp.arange(n), tgt]  # member's current inc
         cur_self = state.inc[jnp.arange(n), jnp.arange(n)]
-        state, applied_sus, started_s, _ = _apply_updates(
+        state, applied_sus, started_s, _, _ = _apply_updates(
             state,
             now,
             sus_mask,
@@ -1488,9 +1538,32 @@ def tick(
             )
         )
         applied_pr = applied_prm | applied_prr | applied_sus
-        return state, applied_sus, applied_pr, ping_req_count, pr_fs_count
+        refutes_pr = jnp.sum(refuted_m, dtype=jnp.int32) + jnp.sum(
+            refuted_rr, dtype=jnp.int32
+        )
+        return (
+            state,
+            applied_sus,
+            applied_pr,
+            ping_req_count,
+            pr_fs_count,
+            pr_fs_records,
+            pr_inconclusive,
+            pb_drops_pr,
+            refutes_pr,
+        )
 
-    state, applied_sus, applied_pr, ping_req_count, pr_fs_count = _phase(
+    (
+        state,
+        applied_sus,
+        applied_pr,
+        ping_req_count,
+        pr_fs_count,
+        pr_fs_records,
+        pr_inconclusive,
+        pb_drops_pr,
+        refutes_pr,
+    ) = _phase(
         gate,
         jnp.any(need_pr),
         _ping_req_phase,
@@ -1498,6 +1571,10 @@ def tick(
             s,
             jnp.zeros((n, n), bool),
             jnp.zeros((n, n), bool),
+            jnp.int32(0),
+            jnp.int32(0),
+            jnp.int32(0),
+            jnp.int32(0),
             jnp.int32(0),
             jnp.int32(0),
         ),
@@ -1526,7 +1603,7 @@ def tick(
         state = state._replace(
             susp_deadline=jnp.where(expired, -1, state.susp_deadline)
         )
-        state, applied_faulty, _, _ = _apply_updates(
+        state, applied_faulty, _, _, _ = _apply_updates(
             state,
             now,
             expired,
@@ -1585,6 +1662,13 @@ def tick(
         distinct_checksums=distinct,
         converged=distinct <= 1,
         parity_overflow=mid_overflow + late_overflow,
+        refutes=refutes_recv + refutes_resp + refutes_pr,
+        piggyback_drops=pb_drops_send + pb_drops_recv + pb_drops_pr,
+        full_sync_records=fs_records + pr_fs_records,
+        ping_req_inconclusive=pr_inconclusive,
+        join_merges=jnp.sum(joined, dtype=jnp.int32),
+        dirty_rows=jnp.sum(dirty, dtype=jnp.int32)
+        + jnp.sum(dirty_late, dtype=jnp.int32),
     )
 
     state = state._replace(rng=_fold(state.rng, 0x5EED))

@@ -180,6 +180,17 @@ class ScalableMetrics(NamedTuple):
     faulties_published: jax.Array
     refutes_published: jax.Array  # live defamed nodes re-asserting alive
     leaves_published: jax.Array  # graceful leaves this tick
+    # -- protocol counters (statsd-equivalent; all scalar int32, derived
+    # from the trajectory masks — bitwise-identical under gate_phases) --
+    pings_sent: jax.Array  # gossiping nodes initiating a direct exchange
+    pings_delivered: jax.Array  # direct exchanges that succeeded
+    # failed direct pings whose indirect round had NO responder: no
+    # verdict this tick (ping-req-sender.js:249-262 judges only on
+    # responses)
+    ping_req_inconclusive: jax.Array
+    # rumors retired this tick — aged past 15*ceil(log10(n+1)) (the
+    # batched analog of dissemination.js:41 piggyback drops) or recycled
+    rumors_retired: jax.Array
 
 
 class ChurnInputs(NamedTuple):
@@ -969,5 +980,13 @@ def tick(
         faulties_published=n_faulty,
         refutes_published=n_refute,
         leaves_published=n_leave,
+        pings_sent=jnp.sum(gossiping.astype(jnp.int32)),
+        pings_delivered=jnp.sum(direct_ok.astype(jnp.int32)),
+        # direct_fail ⊆ need_ind, so the cond-skipped (all-false)
+        # any_responder and the straight-line unmasked one agree here
+        ping_req_inconclusive=jnp.sum(
+            (direct_fail & ~any_responder).astype(jnp.int32)
+        ),
+        rumors_retired=jnp.sum(retired.astype(jnp.int32)),
     )
     return state, metrics

@@ -183,16 +183,29 @@ class ScalableCluster:
         self._ring_checksum = _ring_checksum_fn(
             self.params.n, self.replica_points
         )
+        # optional telemetry sink (obs.RunRecorder via attach_recorder)
+        self.recorder = None
+
+    def attach_recorder(self, recorder) -> None:
+        """Attach an obs.RunRecorder; step()/run() metrics fold into it."""
+        recorder.describe("sim.engine_scalable", self.params.n, self.params)
+        self.recorder = recorder
 
     def step(self, inputs: Optional[es.ChurnInputs] = None):
         if inputs is None:
             inputs = es.ChurnInputs.quiet(self.params.n)
         self.state, m = self._tick(self.state, inputs)
-        return jax.tree.map(np.asarray, m)
+        m = jax.tree.map(np.asarray, m)
+        if self.recorder is not None:
+            self.recorder.record_ticks(m)
+        return m
 
     def run(self, schedule: StormSchedule):
         self.state, ms = self._scanned(self.state, schedule.as_inputs())
-        return jax.tree.map(np.asarray, ms)
+        ms = jax.tree.map(np.asarray, ms)
+        if self.recorder is not None:
+            self.recorder.record_ticks(ms)
+        return ms
 
     def checksums(self) -> np.ndarray:
         if not bool(self.params.checksum_in_tick):

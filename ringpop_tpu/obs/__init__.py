@@ -1,0 +1,37 @@
+"""Unified telemetry layer: run recording, stats exposition, sim taps.
+
+The reference treats observability as a first-class subsystem — statsd
+emission on every protocol action (index.js:527-541), a protocol-period
+histogram feeding the adaptive gossip delay (lib/gossip/index.js:37,52-55)
+and remotely attachable trace taps (lib/trace/).  This package is the
+TPU port's host-side counterpart for the *simulation* plane: the scanned
+engines already return per-tick metrics time-series
+(``TickMetrics``/``ScalableMetrics`` stacked by ``lax.scan``); here they
+become durable and queryable:
+
+- :mod:`ringpop_tpu.obs.recorder` — ``RunRecorder``: folds stacked
+  metrics into the ``Meter``/``Histogram`` primitives and writes an
+  append-only JSONL run log (config, per-tick rows, wall-clock phases,
+  convergence tick, backend provenance) so BENCH_*/PARITY_* artifacts
+  are generated, not hand-curated.
+- :mod:`ringpop_tpu.obs.statsd_bridge` — maps device counters onto the
+  reference's statsd key names through ``Ringpop.stat()``'s
+  ``ringpop.<host_port>.`` scheme.
+- :mod:`ringpop_tpu.obs.prometheus` — Prometheus text exposition for
+  live nodes (the ``/admin/metrics`` endpoint) and for recorded runs.
+- :mod:`ringpop_tpu.obs.sim_tap` — adapter letting ``TracerStore`` /
+  ``Tracer`` attach to simulation drivers (the ``sim.tick.metrics``
+  trace event).
+"""
+
+from ringpop_tpu.obs.recorder import (  # noqa: F401
+    RunRecorder,
+    read_run_log,
+    validate_run_log,
+)
+from ringpop_tpu.obs.statsd_bridge import StatsdBridge  # noqa: F401
+from ringpop_tpu.obs.prometheus import (  # noqa: F401
+    render_ringpop_metrics,
+    render_tick_series,
+)
+from ringpop_tpu.obs.sim_tap import SimTracerHost  # noqa: F401

@@ -1,0 +1,57 @@
+"""SimTracerHost — TracerStore/Tracer against the simulation engines.
+
+The reference's trace taps (lib/trace/) attach to a live ringpop node's
+internal emitters.  The simulation drivers (SimCluster /
+BatchedSimClusters / ScalableCluster) have no facade, so this adapter
+provides the minimal surface ``Tracer``/``TracerStore`` need — a
+``logger``, a ``timers`` plane, an optional ``channel`` for forwarding
+sinks, and named emitters — and re-publishes per-tick metric rows as
+``tickMetrics`` events (the ``sim.tick.metrics`` trace event in
+utils/trace.py TRACE_EVENTS).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+from ringpop_tpu.net.timers import Timers
+from ringpop_tpu.utils.config import EventEmitter
+from ringpop_tpu.utils.stats import NullLogger
+from ringpop_tpu.utils.trace import TracerStore
+
+
+class SimTracerHost:
+    """Adapter: a simulation driver wearing enough of the Ringpop facade
+    for the trace subsystem (and other observers) to attach."""
+
+    def __init__(
+        self,
+        cluster: Any = None,
+        logger: Any = None,
+        timers: Optional[Timers] = None,
+        channel: Any = None,
+    ):
+        self.cluster = cluster
+        self.logger = logger or NullLogger()
+        self.timers = timers or Timers()
+        self.channel = channel
+        # the sim.tick.metrics trace event sources from this emitter
+        self.sim_events = EventEmitter()
+        self.tracers = TracerStore(self)
+
+    def publish_tick_metrics(self, metrics: Any, start_tick: int = 0) -> int:
+        """Re-publish a metrics row or stacked [T]-series as one
+        ``tickMetrics`` event per tick.  Returns ticks published."""
+        from ringpop_tpu.obs.recorder import _jsonable, iter_tick_rows
+
+        published = 0
+        for t, row in enumerate(iter_tick_rows(metrics)):
+            self.sim_events.emit(
+                "tickMetrics",
+                {"tick": start_tick + t, "metrics": _jsonable(row)},
+            )
+            published += 1
+        return published
+
+    def destroy(self) -> None:
+        self.tracers.destroy()

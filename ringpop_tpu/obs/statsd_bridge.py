@@ -1,0 +1,142 @@
+"""Statsd bridge: device-side tick counters -> reference statsd keys.
+
+The reference emits a statsd stat on every protocol action through
+``RingPop.stat()``'s per-key fq-name cache (index.js:527-541), with keys
+namespaced ``ringpop.<host_port with . and : -> _>.<key>``
+(index.js:162-164).  The simulation engines compute the same counters on
+device (``TickMetrics``/``ScalableMetrics``); this bridge replays a
+recorded tick (or a whole stacked series) onto a statsd client under the
+reference's key names, so existing dashboards/collectors written against
+ringpop-node keys read the simulated cluster unchanged.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Any, Dict, Optional, Tuple
+
+# TickMetrics / ScalableMetrics field -> (stat_type, reference key).
+# Key names follow the reference emission sites: ping/ping-req send+recv
+# (ping-sender.js, server/protocol/*.js), full-sync
+# (dissemination.js:101-114), membership-update.<status>
+# (on_membership_event.js), refuted-update (member.js:76-81), join
+# completion (join-sender.js).  Fields with no reference analog (the
+# sim-only diagnostics) ride under the "sim." namespace.
+TICK_KEY_MAP: Dict[str, Tuple[str, str]] = {
+    # full-fidelity engine (TickMetrics)
+    "pings_sent": ("increment", "ping.send"),
+    "pings_delivered": ("increment", "ping.recv"),
+    "ping_reqs": ("increment", "ping-req.send"),
+    "full_syncs": ("increment", "full-sync"),
+    "changes_applied": ("increment", "changes.apply"),
+    "suspects_marked": ("increment", "membership-update.suspect"),
+    "faulties_marked": ("increment", "membership-update.faulty"),
+    "refutes": ("increment", "refuted-update"),
+    "piggyback_drops": ("increment", "changes.drop"),
+    "full_sync_records": ("increment", "full-sync.records"),
+    "ping_req_inconclusive": ("increment", "ping-req.inconclusive"),
+    "join_merges": ("increment", "join.complete"),
+    "distinct_checksums": ("gauge", "checksums.distinct"),
+    "dirty_rows": ("gauge", "sim.checksum.dirty-rows"),
+    "parity_overflow": ("increment", "sim.parity.overflow"),
+    # scalable engine (ScalableMetrics) — shared fields above apply too
+    "live_nodes": ("gauge", "num-members"),
+    "active_rumors": ("gauge", "sim.rumors.active"),
+    "suspects_published": ("increment", "membership-update.suspect"),
+    "faulties_published": ("increment", "membership-update.faulty"),
+    "refutes_published": ("increment", "refuted-update"),
+    "leaves_published": ("increment", "membership-update.leave"),
+    "rumors_retired": ("increment", "changes.drop"),
+    "mean_heard_frac": ("gauge", "sim.rumors.mean-heard-frac"),
+}
+
+
+def stat_prefix(host_port: str) -> str:
+    """The reference's stats identity: ``ringpop.<host_port>`` with
+    non-alphanumeric separators flattened (index.js:162-164) — must stay
+    in lockstep with ``Ringpop.__init__``."""
+    return "ringpop.%s" % re.sub(r"[.:]", "_", host_port)
+
+
+class StatsdBridge:
+    """Emits tick counters through a ``Ringpop.stat``-style sink.
+
+    Construct with a live facade (``StatsdBridge(ringpop=rp)`` — every
+    emission rides ``rp.stat()`` and therefore its fq-key cache), or
+    standalone with ``StatsdBridge(statsd=client, host_port="h:p")``,
+    which replicates the same ``ringpop.<host_port>.`` scheme for
+    simulation runs that have no facade.
+    """
+
+    def __init__(
+        self,
+        ringpop: Any = None,
+        statsd: Any = None,
+        host_port: Optional[str] = None,
+        key_map: Optional[Dict[str, Tuple[str, str]]] = None,
+    ):
+        if ringpop is None and (statsd is None or host_port is None):
+            raise ValueError("need ringpop=, or statsd= AND host_port=")
+        self.key_map = dict(key_map or TICK_KEY_MAP)
+        if ringpop is not None:
+            self._stat = ringpop.stat
+        else:
+            prefix = stat_prefix(host_port)
+            fq: Dict[str, str] = {}
+
+            def _stat(stat_type: str, key: str, value: Any = None) -> None:
+                fq_key = fq.get(key)
+                if fq_key is None:
+                    fq_key = fq[key] = "%s.%s" % (prefix, key)
+                if stat_type == "increment":
+                    statsd.increment(
+                        fq_key, value if value is not None else 1
+                    )
+                elif stat_type == "gauge":
+                    statsd.gauge(fq_key, value)
+                elif stat_type == "timing":
+                    statsd.timing(fq_key, value)
+
+            self._stat = _stat
+
+    def emit_tick(self, row: Any) -> int:
+        """One tick's metrics (NamedTuple or dict).  Counters emit only
+        when nonzero (statsd increments are deltas); gauges always emit.
+        A [B]-vector value (the vmapped driver's per-cluster axis) is
+        summed for counters — aggregate events across the batch — and
+        skipped for gauges, which have no single-key meaning there.
+        Returns the number of emissions."""
+        if hasattr(row, "_asdict"):
+            row = row._asdict()
+        emitted = 0
+        for field, value in row.items():
+            mapped = self.key_map.get(field)
+            if mapped is None:
+                continue
+            stat_type, key = mapped
+            if getattr(value, "ndim", 0) > 0:
+                if stat_type != "increment":
+                    continue
+                value = value.sum()
+            if hasattr(value, "item"):
+                value = value.item()
+            if isinstance(value, bool):
+                value = int(value)
+            if not isinstance(value, (int, float)):
+                continue
+            if stat_type == "increment":
+                if value:
+                    self._stat("increment", key, int(value))
+                    emitted += 1
+            else:
+                self._stat(stat_type, key, value)
+                emitted += 1
+        return emitted
+
+    def emit_series(self, metrics: Any) -> int:
+        """A stacked [T]- (or vmapped [T, B]-) series, as the scan
+        drivers return: emits every tick in order.  Returns total
+        emissions."""
+        from ringpop_tpu.obs.recorder import iter_tick_rows
+
+        return sum(self.emit_tick(row) for row in iter_tick_rows(metrics))

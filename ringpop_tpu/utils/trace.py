@@ -2,10 +2,12 @@
 
 ``/trace/add`` subscribes a sink to a named internal event with a TTL;
 sinks either log locally or forward the event blob to another node over the
-channel (lib/trace/log.js, tchannel.js).  The only wired event — matching
-the reference (lib/trace/config.js:22-36) — is ``membership.checksum.update``,
-sourced from Membership's ``checksumUpdate`` emission
-(lib/membership/index.js:77-94).
+channel (lib/trace/log.js, tchannel.js).  Wired events:
+``membership.checksum.update`` — matching the reference
+(lib/trace/config.js:22-36), sourced from Membership's ``checksumUpdate``
+emission (lib/membership/index.js:77-94) — plus ``ring.checksum.computed``
+(HashRing rebuilds) and ``sim.tick.metrics`` (per-tick simulation metric
+rows via obs.sim_tap.SimTracerHost).
 """
 
 from __future__ import annotations
@@ -28,6 +30,19 @@ TRACE_EVENTS: Dict[str, Dict[str, str]] = {
         "emitter": "membership",
         "event": "checksumUpdate",
     },
+    # ring rebuilt + rehashed (models/ring/host.py compute_checksum; the
+    # blob carries {checksum, serverCount})
+    "ring.checksum.computed": {
+        "emitter": "ring",
+        "event": "checksumComputed",
+    },
+    # per-tick simulation metrics re-published by a SimTracerHost
+    # (obs/sim_tap.py) — lets TracerStore work against the simulation
+    # engines, not just live nodes
+    "sim.tick.metrics": {
+        "emitter": "sim_events",
+        "event": "tickMetrics",
+    },
 }
 
 
@@ -42,7 +57,17 @@ class Tracer:
         self.ringpop = ringpop
         self.event_name = event_name
         self.sink_spec = dict(sink_spec)
-        self.emitter = getattr(ringpop, spec["emitter"])
+        # a known event may still be unavailable on THIS host: e.g.
+        # sim.tick.metrics sources from a SimTracerHost's sim_events —
+        # a live Ringpop facade has no such emitter, and the miss must
+        # surface as a clean TraceError (-> ringpop.trace.invalid over
+        # the wire), not an unhandled AttributeError
+        self.emitter = getattr(ringpop, spec["emitter"], None)
+        if self.emitter is None:
+            raise TraceError(
+                "event %r is not available on this node (no %r emitter)"
+                % (event_name, spec["emitter"])
+            )
         self.internal_event = spec["event"]
         ttl = min(expires_in_ms or DEFAULT_TTL_MS, MAX_TTL_MS)
         self.expires_at_ms = time.time() * 1000.0 + ttl

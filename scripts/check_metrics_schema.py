@@ -1,0 +1,59 @@
+#!/usr/bin/env python
+"""Validate every JSONL run log in the repo against the recorder schema.
+
+The telemetry layer's CI gate: any ``*.runlog.jsonl`` under the repo
+root (committed artifacts in runlogs/, stray logs from local runs) must
+parse against ``obs.recorder``'s schema — one JSON object per line, a
+leading header row with the current schema version, monotonically
+increasing tick indices.  Runs standalone::
+
+    python scripts/check_metrics_schema.py [paths...]
+
+and inside the tier-1 suite via tests/obs/test_runlog_schema.py, which
+calls the same entry point.
+"""
+
+from __future__ import annotations
+
+import glob
+import os
+import sys
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def find_run_logs(root: str = REPO_ROOT) -> list:
+    return sorted(
+        glob.glob(os.path.join(root, "**", "*.runlog.jsonl"), recursive=True)
+    )
+
+
+def check(paths=None, verbose: bool = True) -> list:
+    """Returns the list of problems across all logs (empty == all valid)."""
+    from ringpop_tpu.obs.recorder import validate_run_log
+
+    paths = list(paths) if paths else find_run_logs()
+    problems = []
+    for path in paths:
+        found = validate_run_log(path)
+        problems.extend(found)
+        if verbose:
+            status = "OK" if not found else "%d problem(s)" % len(found)
+            print("%s: %s" % (os.path.relpath(path, REPO_ROOT), status))
+    return problems
+
+
+def main(argv) -> int:
+    sys.path.insert(0, REPO_ROOT)
+    paths = argv[1:] or None
+    if paths is None and not find_run_logs():
+        print("no *.runlog.jsonl files found under %s" % REPO_ROOT)
+        return 0
+    problems = check(paths)
+    for p in problems:
+        print(p, file=sys.stderr)
+    return 1 if problems else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv))

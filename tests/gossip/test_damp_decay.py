@@ -110,6 +110,36 @@ def test_decayer_disabled_by_config():
     assert member.damp_score == 500  # lazy decay only, on next penalty
 
 
+def test_start_during_inflight_callback_does_not_double_arm():
+    """Regression: a start() landing while a decay callback is mid-flight
+    (after it cleared decay_timer, before it re-armed) must not leave TWO
+    live loops.  start_damp_score_decayer bumps the generation, so the
+    in-flight callback's re-arm is suppressed and exactly one loop
+    survives.  The interleave is reproduced with a decay listener — it
+    runs at precisely the decay_timer=None / re-arm gap."""
+    rp, timers = make_ringpop()
+    member = penalize(rp, timers)
+    membership = rp.membership
+
+    member.once(
+        "dampScoreDecayed",
+        lambda *a: membership.start_damp_score_decayer(),
+    )
+    timers.advance(1.0)  # callback: decay -> concurrent start() -> re-arm
+
+    seen = []
+    member.on("dampScoreDecayed", lambda new, old: seen.append(new))
+    timers.advance(3.0)
+    assert len(seen) == 3, (
+        "decay loop double-armed: %d firings in 3 intervals" % len(seen)
+    )
+
+    # and the surviving loop still stops cleanly
+    membership.stop_damp_score_decayer()
+    timers.advance(3.0)
+    assert len(seen) == 3
+
+
 def test_decay_disabled_mid_run_stops_loop():
     rp, timers = make_ringpop()
     member = penalize(rp, timers)

@@ -33,6 +33,41 @@ def test_convergence_live_single_node():
     assert res["histogram"]["min"] > 0
 
 
+@pytest.mark.slow
+def test_bench_pinned_fallback_skips_reexec():
+    """Regression: a BENCH_PINNED_FALLBACK=1 child (inherited bench-made
+    CPU pin) must mark fallback='cpu' directly instead of burning the
+    re-exec budget re-probing a tunnel that already exhausted it —
+    attempts stays 1 and no BENCH_REEXEC_ATTEMPT round-trips happen."""
+    import json
+    import os
+    import subprocess
+    import sys
+
+    env = dict(
+        os.environ,
+        BENCH_N="12",
+        BENCH_TICKS="2",
+        BENCH_PINNED_FALLBACK="1",
+        BENCH_RETRIES="3",
+        JAX_PLATFORMS="cpu",
+    )
+    env.pop("BENCH_REEXEC_ATTEMPT", None)
+    env.pop("BENCH_ALLOW_CPU", None)
+    out = subprocess.run(
+        [sys.executable, os.path.join(os.path.dirname(__file__), "..", "..", "bench.py")],
+        env=env,
+        capture_output=True,
+        text=True,
+        timeout=300,
+    )
+    assert out.returncode == 0, out.stderr[-2000:]
+    result = json.loads(out.stdout.strip().splitlines()[-1])
+    assert result["fallback"] == "cpu"
+    assert result["platform"] == "cpu"
+    assert result["attempts"] == 1  # no re-exec round-trips
+
+
 @pytest.mark.parametrize("name", sorted(BENCHES))
 def test_micro_bench_smoke(name):
     if name in ("hashring", "large-membership-update", "join-response-merge",

@@ -149,6 +149,28 @@ def test_admin_debug_flags(cluster, client):
     assert not c.node(0).debug_flag_enabled("p")
 
 
+def test_admin_metrics_renders_prometheus_text(cluster):
+    """/admin/metrics — Prometheus text exposition next to /admin/stats
+    (obs.prometheus.render_ringpop_metrics over the channel)."""
+    c = cluster(n=3)
+    rp = c.node(0)
+    head, body = c.node(1).channel.request(
+        rp.whoami(), "/admin/metrics", body={}
+    )
+    assert head["contentType"].startswith("text/plain")
+    assert isinstance(body, str) and body.strip()
+    assert "# TYPE ringpop_members gauge" in body
+    assert "ringpop_members{" in body
+    assert 'instance="%s"' % rp.whoami() in body
+    assert "ringpop_membership_checksum" in body
+    # a converged 3-node cluster: every member alive on the serving node
+    assert 'ringpop_members_by_status{' in body
+    assert 'status="alive"' in body
+    assert "ringpop_ring_servers" in body
+    # request meters moved — this very request marked the server plane
+    assert 'ringpop_requests_total{' in body
+
+
 # -- trace subsystem over the wire (lib/trace/) ---------------------------
 
 

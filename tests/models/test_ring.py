@@ -35,7 +35,7 @@ def test_server_count_add_remove():
 def test_checksum_computed_once_per_bulk_change():
     ring = HashRing()
     count = []
-    ring.on("checksumComputed", lambda: count.append(1))
+    ring.on("checksumComputed", lambda *a: count.append(1))
     ring.add_remove_servers(SERVERS, SERVERS)
     assert len(count) == 1
 

@@ -1,0 +1,111 @@
+"""Prometheus text exposition: live-node rendering (the /admin/metrics
+body) and recorded-series rendering."""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ringpop_tpu.api.ringpop import Ringpop
+from ringpop_tpu.models.sim import engine
+from ringpop_tpu.models.sim.cluster import EventSchedule, SimCluster
+from ringpop_tpu.net.timers import FakeTimers
+from ringpop_tpu.obs.prometheus import (
+    PromWriter,
+    render_ringpop_metrics,
+    render_tick_series,
+)
+
+
+def make_ringpop():
+    timers = FakeTimers()
+    rp = Ringpop("prom-app", "127.0.0.1:3000", timers=timers)
+    rp.is_ready = True
+    rp.membership.make_alive(rp.whoami(), timers.now_ms())
+    rp.membership.make_alive("127.0.0.1:3001", timers.now_ms())
+    return rp
+
+
+def test_prom_writer_format():
+    w = PromWriter()
+    w.sample("x_total", 3, "a counter", "counter", {"app": 'a"b\n'})
+    w.sample("x_total", 4, "a counter", "counter", {"app": "c"})
+    text = w.render()
+    lines = text.splitlines()
+    assert lines[0] == "# HELP x_total a counter"
+    assert lines[1] == "# TYPE x_total counter"
+    # HELP/TYPE emitted once per metric name, labels escaped
+    assert lines[2] == 'x_total{app="a\\"b\\n"} 3'
+    assert lines[3] == 'x_total{app="c"} 4'
+    assert text.endswith("\n")
+
+
+def test_prom_writer_groups_interleaved_families():
+    """Regression: the text format requires all samples of one metric in
+    a single group — interleaved emission (per-plane loops) must come
+    out grouped per family, in first-seen order."""
+    w = PromWriter()
+    for plane in ("client", "server"):
+        w.sample("a_total", 1, "a", "counter", {"plane": plane})
+        w.sample("b_rate", 2.0, "b", "gauge", {"plane": plane})
+    lines = w.render().splitlines()
+    assert lines == [
+        "# HELP a_total a",
+        "# TYPE a_total counter",
+        'a_total{plane="client"} 1',
+        'a_total{plane="server"} 1',
+        "# HELP b_rate b",
+        "# TYPE b_rate gauge",
+        'b_rate{plane="client"} 2.0',
+        'b_rate{plane="server"} 2.0',
+    ]
+
+
+def test_live_exposition_families_are_contiguous():
+    """No metric family appears in two separate groups in the real
+    /admin/metrics body."""
+    text = render_ringpop_metrics(make_ringpop())
+    seen, last = set(), None
+    for line in text.splitlines():
+        name = line.split("{")[0].split(" ")[0]
+        if line.startswith("#"):
+            name = line.split(" ")[2]
+        if name != last:
+            assert name not in seen, "family %s split into two groups" % name
+            seen.add(name)
+            last = name
+
+
+def test_render_ringpop_metrics_exposes_core_families():
+    rp = make_ringpop()
+    text = render_ringpop_metrics(rp)
+    assert "# TYPE ringpop_members gauge" in text
+    assert "# TYPE ringpop_requests_total counter" in text
+    assert 'plane="server"' in text
+    assert "ringpop_membership_checksum" in text
+    assert "ringpop_ring_servers" in text
+    assert 'ringpop_members_by_status{' in text
+    assert 'status="alive"' in text
+    # instance label carries the host_port identity
+    assert 'instance="127.0.0.1:3000"' in text
+
+
+def test_render_tick_series_totals_and_gauges():
+    # n=16/T=12 matches the other tests/obs files: one shared compile
+    sim = SimCluster(
+        n=16, params=engine.SimParams(n=16, checksum_mode="fast")
+    )
+    sim.bootstrap()
+    m = sim.run(EventSchedule(ticks=12, n=16))
+    text = render_tick_series(m, labels={"run": "t1"})
+    assert "# TYPE ringpop_sim_pings_sent_total counter" in text
+    want = int(np.asarray(m.pings_sent).sum())
+    assert 'ringpop_sim_pings_sent_total{run="t1"} %d' % want in text
+    # non-counter fields render as last-value gauges
+    last_distinct = int(np.asarray(m.distinct_checksums)[-1])
+    assert (
+        'ringpop_sim_distinct_checksums{run="t1"} %d' % last_distinct
+        in text
+    )
+    # the new counters are all present
+    for f in ("refutes", "piggyback_drops", "ping_req_inconclusive"):
+        assert "ringpop_sim_%s_total" % f in text

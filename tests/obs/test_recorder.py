@@ -1,0 +1,237 @@
+"""RunRecorder: JSONL round-trip, striding, folding, and the CPU smoke
+run of the batched epidemic (the PR's acceptance scenario) — including
+the no-host-callback assertion on the scanned tick."""
+
+from __future__ import annotations
+
+import json
+
+import jax
+import numpy as np
+
+from ringpop_tpu.models.sim import engine
+from ringpop_tpu.models.sim.cluster import EventSchedule, SimCluster
+from ringpop_tpu.obs.recorder import (
+    SCHEMA_VERSION,
+    RunRecorder,
+    read_run_log,
+    validate_run_log,
+)
+
+
+def test_jsonl_round_trip(tmp_path):
+    rec = RunRecorder(
+        str(tmp_path) + "/", run_id="rt1", config={"scenario": "unit"}
+    )
+    with rec.phase("warm"):
+        pass
+    rec.record_tick({"pings_sent": 5, "refutes": 1, "converged": False})
+    rec.record_tick({"pings_sent": 5, "refutes": 0, "converged": True})
+    rec.record_event("note", detail="hello")
+    summary = rec.finish(extra_field=7)
+
+    log = read_run_log(rec.path)
+    assert log["header"]["schema"] == SCHEMA_VERSION
+    assert log["header"]["run_id"] == "rt1"
+    assert log["header"]["config"]["scenario"] == "unit"
+    assert "provenance" in log["header"]
+    assert [t["metrics"]["pings_sent"] for t in log["ticks"]] == [5, 5]
+    assert log["phases"][0]["name"] == "warm"
+    assert log["events"][0]["detail"] == "hello"
+    assert log["summary"]["totals"]["pings_sent"] == 10
+    assert log["summary"]["totals"]["refutes"] == 1
+    assert log["summary"]["convergence_tick"] == 1
+    assert log["summary"]["extra_field"] == 7
+    assert summary["ticks_recorded"] == 2
+    assert validate_run_log(rec.path) == []
+
+
+def test_stride_keeps_every_kth_row_and_batch_tail(tmp_path):
+    rec = RunRecorder(str(tmp_path) + "/", run_id="st1", stride=4)
+    series = {"pings_sent": np.arange(10, dtype=np.int32)}
+    rec.record_ticks(series)
+    rec.finish()
+    log = read_run_log(rec.path)
+    # rows at tick 0, 4, 8 (stride) plus 9 (batch tail)
+    assert [t["tick"] for t in log["ticks"]] == [0, 4, 8, 9]
+    # totals fold EVERY tick regardless of stride
+    assert log["summary"]["totals"]["pings_sent"] == sum(range(10))
+    assert log["summary"]["ticks_recorded"] == 10
+
+
+def test_histograms_and_meters_fold(tmp_path):
+    rec = RunRecorder(str(tmp_path) + "/", run_id="h1")
+    for v in (1, 2, 3, 4):
+        rec.record_tick({"changes_applied": v})
+    assert rec.histograms["changes_applied"].mean() == 2.5
+    assert rec.meters["changes_applied"].to_dict()["count"] == 10
+    s = rec.finish()
+    assert s["histograms"]["changes_applied"]["max"] == 4
+
+
+def test_validate_flags_corruption(tmp_path):
+    rec = RunRecorder(str(tmp_path) + "/", run_id="bad1")
+    rec.record_tick({"pings_sent": 1})
+    rec.finish()
+    with open(rec.path, "a") as fh:
+        fh.write("this is not json\n")
+        fh.write(json.dumps({"kind": "tick", "metrics": {}}) + "\n")
+        fh.write(json.dumps({"kind": "mystery"}) + "\n")
+    problems = validate_run_log(rec.path)
+    # the tick-less row trips both the missing-field and the index check
+    assert len(problems) == 4
+    assert any("not JSON" in p for p in problems)
+    assert any("missing 'tick'" in p for p in problems)
+    assert any("unknown kind" in p for p in problems)
+
+
+def test_vector_converged_rows_do_not_fake_convergence(tmp_path):
+    """Regression: a batched [B] converged row is a LIST after json
+    conversion — truthiness must not declare convergence until every
+    cluster converged."""
+    rec = RunRecorder(str(tmp_path) + "/", run_id="vc1")
+    rec.record_tick({"converged": [False, False]})
+    assert rec.convergence_tick is None
+    rec.record_tick({"converged": [True, False]})
+    assert rec.convergence_tick is None
+    rec.record_tick({"converged": [True, True]})
+    assert rec.convergence_tick == 2
+    rec.finish()
+    assert read_run_log(rec.path)["summary"]["convergence_tick"] == 2
+
+
+def test_default_run_ids_are_unique_within_a_second(tmp_path):
+    """Regression: bench retry loops construct recorders back-to-back;
+    same-second defaults must not append to one another's log."""
+    clock = lambda: 1234.5  # frozen second
+    a = RunRecorder(str(tmp_path) + "/", clock=clock)
+    b = RunRecorder(str(tmp_path) + "/", clock=clock)
+    assert a.run_id != b.run_id
+    assert a.path != b.path
+    a.record_tick({"pings_sent": 1})
+    b.record_tick({"pings_sent": 2})
+    a.finish()
+    b.finish()
+    assert validate_run_log(a.path) == []
+    assert validate_run_log(b.path) == []
+
+
+def test_aborted_run_leaves_valid_prefix(tmp_path):
+    rec = RunRecorder(str(tmp_path) + "/", run_id="ab1")
+    rec.record_tick({"pings_sent": 1})
+    rec.close()  # no finish(): crashed mid-run
+    assert validate_run_log(rec.path) == []
+    log = read_run_log(rec.path)
+    assert log["summary"] is None and len(log["ticks"]) == 1
+
+
+# -- acceptance: CPU smoke of the batched epidemic -------------------------
+
+
+def _iter_primitives(jaxpr):
+    for eqn in jaxpr.eqns:
+        yield eqn.primitive.name
+        for v in eqn.params.values():
+            vs = v if isinstance(v, (tuple, list)) else (v,)
+            for x in vs:
+                name = type(x).__name__
+                if name == "ClosedJaxpr":
+                    yield from _iter_primitives(x.jaxpr)
+                elif name == "Jaxpr":
+                    yield from _iter_primitives(x)
+
+
+def test_batched_epidemic_smoke_writes_runlog_with_new_counters(tmp_path):
+    """The acceptance scenario: a CPU batched-epidemic run records a
+    JSONL log whose per-tick rows carry the new protocol counters, and
+    the scanned tick contains NO host callbacks (one jit trace of the
+    driver proves it — per-tick metrics stacking is pure lax.scan).
+
+    b=3/n=48/T=28 deliberately matches tests/models/test_batched.py so
+    the tier-1 session reuses its lru-cached executables (the suite runs
+    close to its timeout; see ROADMAP tier-1)."""
+    from ringpop_tpu.models.sim.batched import BatchedSimClusters
+
+    rec = RunRecorder(
+        str(tmp_path) + "/", run_id="epidemic", config={"scenario": "epidemic"}
+    )
+    b, n, T = 3, 48, 28
+    bat = BatchedSimClusters(b=b, n=n, seed=3)
+    bat.attach_recorder(rec)
+    with rec.phase("bootstrap"):
+        bat.bootstrap()
+    sched = EventSchedule(ticks=T, n=n)
+    sched.kill[2, 5] = True
+    with rec.phase("run"):
+        bat.run(sched)
+    rec.finish()
+
+    assert validate_run_log(rec.path) == []
+    log = read_run_log(rec.path)
+    assert log["header"]["config"]["engine"] == "sim.engine[batched]"
+    assert log["header"]["config"]["b"] == b
+    # 1 bootstrap row + T scanned ticks
+    assert len(log["ticks"]) == T + 1
+    row = log["ticks"][1]["metrics"]
+    # per-tick TickMetrics rows include the NEW counters ([B]-vectors
+    # under the vmapped driver)
+    for field in (
+        "refutes",
+        "piggyback_drops",
+        "full_sync_records",
+        "ping_req_inconclusive",
+        "join_merges",
+        "dirty_rows",
+    ):
+        assert field in row, field
+    # the epidemic exercises the new counters: every node's bootstrap
+    # join merged, and the kill dirties membership views cluster-wide
+    # (piggyback-drop/refute nonzero coverage lives in
+    # tests/obs/test_counter_parity.py's lossy window)
+    assert np.asarray(log["ticks"][0]["metrics"]["join_merges"]).sum() == b * n
+    dirty = np.asarray(
+        [t["metrics"]["dirty_rows"] for t in log["ticks"]]
+    )
+    assert dirty.sum() > 0
+    suspects = np.asarray(
+        [t["metrics"]["suspects_marked"] for t in log["ticks"]]
+    )
+    assert suspects.sum() > 0  # the killed node was detected
+
+    # no host callback inside the scanned tick: jit-trace the driver once
+    params = bat.params
+    universe = bat.universe
+
+    def scanned(state, inputs):
+        return jax.lax.scan(
+            lambda st, inp: engine.tick(st, inp, params, universe),
+            state,
+            inputs,
+        )
+
+    single = jax.tree.map(lambda a: a[0], bat.state)
+    jaxpr = jax.make_jaxpr(scanned)(single, sched.as_inputs())
+    prims = set(_iter_primitives(jaxpr.jaxpr))
+    offenders = {p for p in prims if "callback" in p or "host" in p}
+    assert not offenders, offenders
+
+
+def test_sim_cluster_recorder_hook(tmp_path):
+    """SimCluster.attach_recorder folds step() and run() metrics and
+    stamps the engine config (incl. the static checksum-recompute path)
+    into the header."""
+    rec = RunRecorder(str(tmp_path) + "/", run_id="sc1")
+    # n=16/T=12 matches the other tests/obs files: one shared compile
+    sim = SimCluster(
+        n=16, params=engine.SimParams(n=16, checksum_mode="fast")
+    )
+    sim.attach_recorder(rec)
+    sim.bootstrap()
+    sim.run(EventSchedule(ticks=12, n=16))
+    rec.finish()
+    log = read_run_log(rec.path)
+    assert len(log["ticks"]) == 13
+    cfg = log["header"]["config"]["params"]
+    assert cfg["checksum_mode"] == "fast"
+    assert cfg["parity_recompute"] in ("gated", "bounded", "full", "auto")
+    assert log["summary"]["convergence_tick"] is not None

@@ -1,0 +1,41 @@
+"""Repo-wide run-log schema gate (the tier-1 twin of
+scripts/check_metrics_schema.py): every committed *.runlog.jsonl must
+validate against the recorder schema."""
+
+from __future__ import annotations
+
+import importlib.util
+import os
+
+REPO_ROOT = os.path.dirname(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+
+
+def _load_checker():
+    spec = importlib.util.spec_from_file_location(
+        "check_metrics_schema",
+        os.path.join(REPO_ROOT, "scripts", "check_metrics_schema.py"),
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_every_repo_runlog_validates():
+    checker = _load_checker()
+    logs = checker.find_run_logs()
+    # the sample artifact is committed, so the gate is never vacuous
+    assert any(
+        os.path.basename(p).startswith("sample_") for p in logs
+    ), "committed sample runlog missing (runlogs/sample_*.runlog.jsonl)"
+    problems = checker.check(logs, verbose=False)
+    assert problems == [], "\n".join(problems)
+
+
+def test_checker_catches_a_bad_log(tmp_path):
+    checker = _load_checker()
+    bad = tmp_path / "broken.runlog.jsonl"
+    bad.write_text('{"kind": "tick", "metrics": {}}\nnot json\n')
+    problems = checker.check([str(bad)], verbose=False)
+    assert problems, "checker accepted a log with no header + bad JSON"

@@ -1,0 +1,109 @@
+"""Trace taps beyond membership.checksum.update: the ring checksum event
+and the sim-tick metrics tap (TracerStore against simulation engines)."""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ringpop_tpu.models.sim import engine
+from ringpop_tpu.models.sim.cluster import EventSchedule, SimCluster
+from ringpop_tpu.obs.sim_tap import SimTracerHost
+from ringpop_tpu.utils.trace import TRACE_EVENTS, Tracer
+
+
+class ListLogger:
+    def __init__(self):
+        self.records = []
+
+    def info(self, msg, extra=None, **kw):
+        self.records.append((msg, extra or kw))
+
+    def debug(self, *a, **k):
+        pass
+
+    warning = warn = error = debug
+
+
+def test_trace_events_table_has_new_entries():
+    assert "ring.checksum.computed" in TRACE_EVENTS
+    assert TRACE_EVENTS["ring.checksum.computed"]["emitter"] == "ring"
+    assert "sim.tick.metrics" in TRACE_EVENTS
+    assert TRACE_EVENTS["sim.tick.metrics"]["emitter"] == "sim_events"
+
+
+def test_ring_checksum_computed_tap_fires():
+    """A log-sink tracer on ring.checksum.computed sees every ring
+    rebuild, blob included."""
+    from ringpop_tpu.api.ringpop import Ringpop
+    from ringpop_tpu.net.timers import FakeTimers
+
+    rp = Ringpop("tap-app", "127.0.0.1:3000", timers=FakeTimers())
+    logger = ListLogger()
+    rp.logger = logger
+    tracer = Tracer(rp, "ring.checksum.computed", {"type": "log"})
+    rp.tracers.add(tracer)
+    rp.ring.add_server("127.0.0.1:3001")
+    assert logger.records, "ring tap never fired"
+    _, extra = logger.records[-1]
+    blob = extra["blob"]
+    assert blob["serverCount"] == 1
+    assert blob["checksum"] == rp.ring.checksum
+    rp.destroy()
+
+
+def test_sim_tick_metrics_tap_through_tracer_store():
+    """The simulation engines have no facade; SimTracerHost adapts a
+    SimCluster so TracerStore/Tracer attach, and per-tick metric rows
+    flow to the sink."""
+    # n=16/T=12 matches the other tests/obs files: one shared compile
+    sim = SimCluster(
+        n=16, params=engine.SimParams(n=16, checksum_mode="fast")
+    )
+    host = SimTracerHost(sim, logger=ListLogger())
+    tracer = Tracer(host, "sim.tick.metrics", {"type": "log"})
+    host.tracers.add(tracer)
+
+    sim.bootstrap()
+    m = sim.run(EventSchedule(ticks=12, n=16))
+    published = host.publish_tick_metrics(m, start_tick=1)
+    assert published == 12
+
+    records = host.logger.records
+    assert len(records) == 12
+    _, extra = records[0]
+    blob = extra["blob"]
+    assert blob["tick"] == 1
+    assert blob["metrics"]["pings_sent"] == int(np.asarray(m.pings_sent)[0])
+    assert "refutes" in blob["metrics"]
+
+    # removal detaches the listener: further publishes stay silent
+    host.tracers.remove("sim.tick.metrics", {"type": "log"})
+    host.publish_tick_metrics(m)
+    assert len(records) == 12
+    host.destroy()
+
+
+def test_sim_event_on_live_node_rejected_cleanly():
+    """Regression: a known-but-unavailable event (sim.tick.metrics on a
+    live facade, which has no sim_events emitter) must raise TraceError
+    — so /trace/add answers ringpop.trace.invalid — not AttributeError."""
+    import pytest
+
+    from ringpop_tpu.api.ringpop import Ringpop
+    from ringpop_tpu.net.timers import FakeTimers
+    from ringpop_tpu.utils.trace import TraceError
+
+    rp = Ringpop("tap-app", "127.0.0.1:3000", timers=FakeTimers())
+    with pytest.raises(TraceError):
+        Tracer(rp, "sim.tick.metrics", {"type": "log"})
+    rp.destroy()
+
+
+def test_single_tick_publish():
+    host = SimTracerHost(logger=ListLogger())
+    seen = []
+    host.sim_events.on("tickMetrics", lambda blob: seen.append(blob))
+    host.publish_tick_metrics(
+        {"pings_sent": np.int32(7)}, start_tick=42
+    )
+    assert seen == [{"tick": 42, "metrics": {"pings_sent": 7}}]

@@ -1,0 +1,121 @@
+"""StatsdBridge: device counters land on the reference's statsd key
+scheme — ``ringpop.<host_port with . and : -> _>.<key>`` (index.js:162-164,
+527-541) — whether routed through a live facade's ``stat()`` or the
+standalone prefix replica."""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from ringpop_tpu.api.ringpop import Ringpop
+from ringpop_tpu.models.sim import engine
+from ringpop_tpu.models.sim.cluster import EventSchedule, SimCluster
+from ringpop_tpu.net.timers import FakeTimers
+from ringpop_tpu.obs.statsd_bridge import TICK_KEY_MAP, StatsdBridge, stat_prefix
+from ringpop_tpu.utils.stats import CapturingStatsd
+
+
+def test_prefix_matches_facade_scheme():
+    """The standalone prefix must be byte-identical to what
+    Ringpop.__init__ computes for the same host_port."""
+    statsd = CapturingStatsd()
+    rp = Ringpop(
+        "bridge-app",
+        "10.0.0.7:3001",
+        statsd=statsd,
+        timers=FakeTimers(),
+    )
+    assert stat_prefix("10.0.0.7:3001") == rp.stat_prefix
+    assert rp.stat_prefix == "ringpop.10_0_0_7_3001"
+
+
+def test_emit_through_ringpop_stat_uses_fq_cache():
+    statsd = CapturingStatsd()
+    rp = Ringpop(
+        "bridge-app",
+        "127.0.0.1:3000",
+        statsd=statsd,
+        timers=FakeTimers(),
+    )
+    bridge = StatsdBridge(ringpop=rp)
+    statsd.records.clear()  # drop constructor-era emissions
+    bridge.emit_tick(
+        {
+            "pings_sent": 12,
+            "ping_reqs": 3,
+            "refutes": 1,
+            "distinct_checksums": 4,
+            "converged": False,  # unmapped: ignored
+        }
+    )
+    keys = {r[1] for r in statsd.records}
+    assert keys == {
+        "ringpop.127_0_0_1_3000.ping.send",
+        "ringpop.127_0_0_1_3000.ping-req.send",
+        "ringpop.127_0_0_1_3000.refuted-update",
+        "ringpop.127_0_0_1_3000.checksums.distinct",
+    }
+    # the facade's fq-key cache saw the bridge's keys (index.js:527-541)
+    assert "ping.send" in rp.stat_keys
+
+
+def test_emit_series_from_engine_run_matches_reference_scheme():
+    """A real engine window through the standalone bridge: every
+    emission carries the ringpop.<host_port>. prefix, increments are
+    emitted only when nonzero, and window sums agree with the metrics."""
+    sim = SimCluster(
+        n=16, params=engine.SimParams(n=16, checksum_mode="fast")
+    )
+    sim.bootstrap()
+    m = sim.run(EventSchedule(ticks=12, n=16))
+
+    cap = CapturingStatsd()
+    bridge = StatsdBridge(statsd=cap, host_port="127.0.0.1:4040")
+    assert bridge.emit_series(m) > 0
+    prefix = "ringpop.127_0_0_1_4040."
+    assert cap.records  # something was emitted
+    assert all(r[1].startswith(prefix) for r in cap.records)
+    sent = sum(
+        r[2]
+        for r in cap.records
+        if r[0] == "increment" and r[1] == prefix + "ping.send"
+    )
+    assert sent == int(np.asarray(m.pings_sent).sum())
+    # gauges re-emit every tick
+    gauges = [r for r in cap.records if r[0] == "gauge"]
+    assert len([g for g in gauges if g[1] == prefix + "checksums.distinct"]) == 12
+
+
+def test_emit_series_handles_vmapped_batch_axis():
+    """Regression: [T, B] metrics from the batched driver must not
+    crash — counter vectors aggregate (sum across clusters), gauge
+    vectors are skipped (no single-key meaning)."""
+    cap = CapturingStatsd()
+    bridge = StatsdBridge(statsd=cap, host_port="127.0.0.1:4050")
+    series = {
+        "pings_sent": np.asarray([[3, 4], [5, 6]]),  # [T=2, B=2]
+        "distinct_checksums": np.asarray([[2, 2], [1, 1]]),  # gauge
+    }
+    assert bridge.emit_series(series) == 2
+    prefix = "ringpop.127_0_0_1_4050."
+    sends = [r for r in cap.records if r[1] == prefix + "ping.send"]
+    assert [r[2] for r in sends] == [7, 11]  # per-tick cross-cluster sums
+    assert not any("checksums.distinct" in r[1] for r in cap.records)
+
+
+def test_bridge_requires_a_sink():
+    with pytest.raises(ValueError):
+        StatsdBridge()
+    with pytest.raises(ValueError):
+        StatsdBridge(statsd=CapturingStatsd())  # host_port missing
+
+
+def test_key_map_covers_both_engines():
+    from ringpop_tpu.models.sim.engine import TickMetrics
+    from ringpop_tpu.models.sim.engine_scalable import ScalableMetrics
+
+    unmapped_ok = {"converged", "full_coverage"}  # booleans, no stat
+    for fields in (TickMetrics._fields, ScalableMetrics._fields):
+        for f in fields:
+            assert f in TICK_KEY_MAP or f in unmapped_ok, f
