@@ -336,6 +336,130 @@ def phase_encode_impls(results: dict) -> None:
             results["encode_%s" % impl] = {"error": str(e)[:300]}
 
 
+def phase_fused_parity(results: dict) -> None:
+    """The round-6 fused pipeline on-chip, A/B'd against the classic
+    composition at the 1k all-dirty parity shape, plus engine-level
+    quiet and churn windows under the fused bounded recompute.
+
+    The checksum-digest cross-check between the two pipelines is a
+    device-level bit-exactness gate (the same role
+    encode_unique_bitexact_on_device plays for the scatter promise):
+    interpret-mode tests can't catch a TPU-lowering-only divergence in
+    the streaming kernel's shift/select ladder."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from ringpop_tpu.models.sim.cluster import default_addresses
+    from ringpop_tpu.ops import checksum_encode as ce
+    from ringpop_tpu.ops import fused_checksum as fc
+    from ringpop_tpu.ops import jax_farmhash as jfh
+
+    n = 1024
+    u = ce.Universe.from_addresses(default_addresses(n))
+    pres = jnp.ones((n, n), bool)
+    stat = jnp.zeros((n, n), jnp.int32)
+    inc = jnp.full((n, n), 1414142122274, jnp.int64)
+    reps = 5
+
+    def timed(key, fn):
+        if not _todo(results, key):
+            return
+        try:
+
+            @jax.jit
+            def run(i0):
+                def body(carry, _):
+                    salt, acc = carry
+                    i = i0.at[0, 0].set(
+                        jnp.int64(1414142122274) + salt.astype(jnp.int64)
+                    )
+                    cs = fn(i)  # [n] uint32 checksums
+                    digest = jnp.sum(cs, dtype=jnp.uint32)
+                    return (
+                        (salt + 200, (acc + digest).astype(jnp.uint32)),
+                        digest,
+                    )
+
+                (s, acc), ds = jax.lax.scan(
+                    body, (jnp.int32(200), jnp.uint32(0)), None, length=reps
+                )
+                return acc, ds[-1]
+
+            np.asarray(run(inc)[0])  # compile + warm, forced
+            t0 = time.perf_counter()
+            acc, last = run(inc.at[1, 1].set(7))
+            last = int(np.asarray(last))
+            dt = (time.perf_counter() - t0) / reps
+            ref = results.get("fused_digest")
+            if ref is not None and last != ref:
+                results["fused_digest_MISMATCH_%s" % key] = last
+            elif ref is None:
+                results["fused_digest"] = last
+            row_bytes = int(
+                np.asarray(u.addr_len).sum() + n * (5 + 13 + 1) - 1
+            )
+            results[key] = {
+                "ms": round(dt * 1e3, 2),
+                "encode_mb_per_s": round(n * row_bytes / dt / 1e6, 1),
+                "protocol": "in-scan x%d" % reps,
+            }
+        except Exception as e:
+            results[key] = {"error": str(e)[:300]}
+        print(json.dumps({key: results.get(key)}), flush=True)
+
+    def composed(i):
+        bufs, lens = ce.membership_rows(u, pres, stat, i, max_digits=14)
+        return jfh.hash32_rows(bufs, lens)
+
+    def fused(i):
+        return fc.membership_checksums(u, pres, stat, i, max_digits=14)
+
+    timed("parity_composed_encode_hash", composed)
+    timed("parity_fused_encode_hash", fused)
+
+    # engine-level windows under the fused bounded recompute (auto
+    # resolution on TPU), quiet + churn, replay-accounted
+    from ringpop_tpu.models.sim import engine
+    from ringpop_tpu.models.sim.cluster import EventSchedule, SimCluster
+
+    for key, churn in (
+        ("fused_engine_quiet_1k", False),
+        ("fused_engine_churn_1k", True),
+    ):
+        if not _todo(results, key):
+            continue
+        try:
+            sim = SimCluster(
+                n=n, params=engine.SimParams(n=n, checksum_mode="farmhash")
+            )
+            sim.bootstrap()
+            if sim.run_until_converged(max_ticks=96, quiet_after=1) < 0:
+                raise RuntimeError("no convergence before window")
+            ticks = 256
+            sched = (
+                EventSchedule.churn_window(ticks, n)  # bench's shape
+                if churn
+                else EventSchedule(ticks=ticks, n=n)
+            )
+            sim.run(sched)
+            jax.block_until_ready(sim.state)
+            warm = sim.parity_replays
+            t0 = time.perf_counter()
+            sim.run(sched)
+            jax.block_until_ready(sim.state)
+            dt = time.perf_counter() - t0
+            results[key] = {
+                "node_ticks_per_sec": round(n * ticks / dt, 1),
+                "replays_in_window": sim.parity_replays - warm,
+                "fused": sim.params.fused_checksum,
+                "dirty_batch": sim.params.dirty_batch,
+            }
+        except Exception as e:
+            results[key] = {"error": str(e)[:300]}
+        print(json.dumps({key: results.get(key)}), flush=True)
+
+
 def phase_epidemic_100k(results: dict) -> None:
     import jax
     import numpy as np
@@ -614,6 +738,7 @@ def main() -> int:
         ("headline", phase_headline),
         ("pallas_vs_scan", phase_pallas_vs_scan),
         ("encode_impls", phase_encode_impls),
+        ("fused_parity", phase_fused_parity),
         ("epidemic_100k", phase_epidemic_100k),
         ("batched", phase_batched),
         ("convergence", phase_convergence),

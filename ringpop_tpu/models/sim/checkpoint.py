@@ -30,6 +30,11 @@ _TRAJECTORY_NEUTRAL_PARAMS = frozenset(
         "gate_phases",
         "hash_impl",
         "parity_recompute",
+        # fused parity pipeline: bitwise-identical checksums (pinned by
+        # tests/ops/test_fused_checksum.py), so a resume may toggle it —
+        # the record cache is rebuilt from (known, status, inc) on load
+        "fused_checksum",
+        "cell_batch",
     }
 )
 # v2: incarnation fields are int32 tick stamps (engine.stamp_to_ms), not
@@ -61,7 +66,14 @@ def save_state(path: str, state: Any, params: Any = None) -> None:
     fields = getattr(state, "_fields", None)
     if fields is None:
         raise TypeError("state must be a NamedTuple of arrays")
-    arrays = {name: np.asarray(getattr(state, name)) for name in fields}
+    # optional fields (e.g. the fused record cache) may be None — they
+    # are simply not stored; load_state restores their None default, and
+    # derived caches are rebuilt by the driver (SimCluster.load)
+    arrays = {
+        name: np.asarray(getattr(state, name))
+        for name in fields
+        if getattr(state, name) is not None
+    }
     arrays[_FORMAT_KEY] = np.array(
         [type(state).__name__, str(_FORMAT_VERSION)]
     )
@@ -115,10 +127,13 @@ def load_state(path: str, state_cls: Type[T], params: Any = None) -> T:
                     "checkpoint params differ from the resuming engine's "
                     "(saved, current): %r" % diff
                 )
+        optional = set(getattr(state_cls, "_field_defaults", {}))
         missing = [
             f
             for f in state_cls._fields
-            if f not in data.files and f not in _FIELD_DEFAULTS
+            if f not in data.files
+            and f not in _FIELD_DEFAULTS
+            and f not in optional
         ]
         extra = [
             f
@@ -133,8 +148,13 @@ def load_state(path: str, state_cls: Type[T], params: Any = None) -> T:
         out = {}
         for f in state_cls._fields:
             if f not in data.files:
-                sibling, default_of = _FIELD_DEFAULTS[f]
-                out[f] = jnp.asarray(default_of(np.asarray(data[sibling])))
+                if f in _FIELD_DEFAULTS:
+                    sibling, default_of = _FIELD_DEFAULTS[f]
+                    out[f] = jnp.asarray(
+                        default_of(np.asarray(data[sibling]))
+                    )
+                else:  # optional field: its NamedTuple default (None)
+                    out[f] = state_cls._field_defaults[f]
                 continue
             arr = jnp.asarray(data[f])
             if arr.dtype != data[f].dtype:

@@ -83,6 +83,29 @@ class EventSchedule:
         """Drop the memoized device inputs after mutating the schedule."""
         object.__setattr__(self, "_device_inputs", None)
 
+    @staticmethod
+    def churn_window(
+        ticks: int, n: int, victims: Optional[Sequence[int]] = None
+    ) -> "EventSchedule":
+        """The shared churn-capture shape: a kill wave early in the
+        window, revive at mid-window (suspect -> faulty escalation and
+        the rejoin dissemination both land INSIDE the measured window).
+        One definition for bench.py's churn_parity_* capture and
+        benchmarks/tpu_measure.py's fused_engine_churn phase, so the two
+        published numbers stay comparable.  Clamped for short windows
+        (ticks <= 5 still kills; the revive is dropped only when the
+        window cannot fit it after the kill)."""
+        sched = EventSchedule(ticks=ticks, n=n)
+        if victims is None:
+            victims = (3 % n, n // 2, max(0, n - 5))
+        kill_at = min(4, ticks - 1)
+        revive_at = min(max(kill_at + 1, ticks // 2), ticks - 1)
+        for v in victims:
+            sched.kill[kill_at, v % n] = True
+            if revive_at > kill_at:
+                sched.revive[revive_at, v % n] = True
+        return sched
+
 
 def _resolve_hash_impl(params: engine.SimParams) -> engine.SimParams:
     """Pin trace-environment-dependent params to CONCRETE values at
@@ -189,13 +212,14 @@ class SimCluster:
 
     def _exact_params(self) -> engine.SimParams:
         """The exact-recompute twin config for overflow replays: "full"
-        on TPU (the tunnel can't compile the gated loop), "gated"
-        elsewhere.  Bit-identical trajectories either way."""
+        for fused runs (dense cell re-encode, no overflow possible),
+        "full" on TPU (the tunnel can't compile the gated loop), "gated"
+        elsewhere.  Bit-identical trajectories every way."""
         import jax
 
         return self.params._replace(
-            parity_recompute=engine.resolve_parity_recompute(
-                jax.default_backend()
+            parity_recompute=engine.resolve_exact_recompute(
+                self.params, jax.default_backend()
             )
         )
 
@@ -368,3 +392,28 @@ class SimCluster:
         from ringpop_tpu.models.sim.checkpoint import load_state
 
         self.state = load_state(path, engine.SimState, self.params)
+        if self.params.fused_checksum == "on":
+            # the record cache is a pure function of (known, status,
+            # inc) — rebuild it UNCONDITIONALLY at this boundary.  A
+            # checkpoint's stored cache cannot be trusted: an
+            # intervening unfused resume (fused_checksum is
+            # trajectory-neutral, checkpoint.py) carries the saved
+            # cache through unchanged while the views evolve, and a
+            # later fused resume hashing those stale bytes would
+            # silently break the parity contract.
+            from ringpop_tpu.ops import fused_checksum as fc
+
+            rec_b, rec_l = fc.member_records(
+                self.universe,
+                self.state.known,
+                self.state.status,
+                engine.stamp_to_ms(self.state.inc, self.params),
+                self.params.max_digits,
+            )
+            self.state = self.state._replace(
+                rec_bytes=rec_b, rec_len=rec_l
+            )
+        elif self.state.rec_bytes is not None:
+            # unfused resume of a fused checkpoint: drop the cache so
+            # this run never saves forward bytes it does not maintain
+            self.state = self.state._replace(rec_bytes=None, rec_len=None)

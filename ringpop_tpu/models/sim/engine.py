@@ -141,6 +141,25 @@ class SimParams(NamedTuple):
     # checksums and checksums feed full-sync decisions.  "auto" =
     # resolved to the backend's right answer at SimCluster construction.
     parity_recompute: str = "auto"
+    # Fused encode+hash parity pipeline (ops.fused_checksum): "on" keeps
+    # a per-(observer, subject) record-byte cache in SimState, re-encodes
+    # only cells whose (known, status, incarnation) changed in the tick
+    # (a churn wave touches O(wave) records, not O(N*N) bytes), and
+    # hashes dirty rows with the gridless streaming Pallas kernel that
+    # assembles the checksum string in VMEM — the [N, row_bytes] buffer
+    # and its ~100 MB/s XLA byte-assembly floor are gone.  "off" is the
+    # classic membership_rows + hash32_rows composition.  "auto" resolves
+    # at SimCluster/ShardedSim construction (resolve_auto_parity): "on"
+    # for farmhash mode on TPU, "off" elsewhere.  Bitwise-identical
+    # checksums either way (pinned by tests/ops/test_fused_checksum.py
+    # and the lockstep suite).
+    fused_checksum: str = "auto"
+    # fused bounded-parity cell chunk: the per-tick changed-cell
+    # re-encode covers up to this many (observer, subject) cells in ONE
+    # straight-line gather/encode/scatter; more changed cells than this
+    # overflow exactly like dirty_batch row overflow (same replay
+    # contract, counted in the same TickMetrics.parity_overflow).
+    cell_batch: int = 16384
     # True: rare phases (revive, rejoin, join, reshuffle, piggyback,
     # apply, responses, ping-req, expiry) run under lax.cond and cost
     # nothing on ticks with nothing to do — the right call on CPU, where
@@ -186,6 +205,15 @@ class SimState(NamedTuple):
     rng: jax.Array  # [N, 2] uint32
     # cached checksums
     checksum: jax.Array  # [N] uint32
+    # fused-parity record cache (fused_checksum="on" only, else None):
+    # rec_bytes[i, j] holds observer i's encoded "addr+status+inc+';'"
+    # record for member j (zero-padded uint8, length rec_len[i, j]; 0 =
+    # unknown member).  uint8 — not packed words — so the bounded-chunk
+    # row gather can ride the one-hot f32 matmul (_rows) exactly.
+    # Derivable from (known, status, inc): a loaded checkpoint without
+    # the cache rebuilds it (SimCluster.load).
+    rec_bytes: Optional[jax.Array] = None  # [N, N, R] uint8
+    rec_len: Optional[jax.Array] = None  # [N, N] int32
 
 
 class TickInputs(NamedTuple):
@@ -403,6 +431,23 @@ def init_state(
         rng=jnp.asarray(keys),
         checksum=jnp.zeros(n, jnp.uint32),
     )
+    # fused parity mode: seed the per-(observer, subject) record cache
+    # with every row's self-view records (the cache is a pure function of
+    # (known, status, inc) — see SimState.rec_bytes)
+    if (
+        resolve_fused_checksum(params, jax.default_backend()) == "on"
+        and universe is not None
+    ):
+        from ringpop_tpu.ops import fused_checksum as fc
+
+        rec_b, rec_l = fc.member_records(
+            universe,
+            state.known,
+            state.status,
+            stamp_to_ms(state.inc, params),
+            params.max_digits,
+        )
+        state = state._replace(rec_bytes=rec_b, rec_len=rec_l)
     # Fast mode never touches the universe in compute_checksums, so the
     # cache can (and must) be seeded even without one — a fast-mode caller
     # omitting universe would otherwise see stale zero checksums for rows
@@ -437,6 +482,41 @@ def _hash_impl(params: SimParams):
     return None if params.hash_impl == "env" else params.hash_impl
 
 
+def resolve_fused_checksum(params: "SimParams", backend: str) -> str:
+    """Resolve ``fused_checksum="auto"`` to a concrete "on"/"off".
+
+    "on" for farmhash mode on TPU — where the fused record-cache +
+    streaming-kernel pipeline replaces the XLA byte-assembly floor — and
+    "off" elsewhere (the CPU's gated dirty-chunk recompute already skips
+    quiet ticks, and interpret-mode Pallas would be a slowdown).  An
+    explicit "on"/"off" is honored as-is ("on" requires farmhash mode)."""
+    if params.fused_checksum != "auto":
+        if (
+            params.fused_checksum == "on"
+            and params.checksum_mode != "farmhash"
+        ):
+            raise ValueError(
+                "fused_checksum='on' requires checksum_mode='farmhash' "
+                "(fast mode has no checksum strings to fuse)"
+            )
+        return params.fused_checksum
+    return (
+        "on"
+        if backend == "tpu" and params.checksum_mode == "farmhash"
+        else "off"
+    )
+
+
+def resolve_exact_recompute(params: "SimParams", backend: str) -> str:
+    """The exact (overflow-free) recompute shape for replay twins: fused
+    runs always replay under "full" (the fused pipeline has no gated
+    loop form — the bounded chunk IS its only sparse shape), unfused
+    runs keep the per-backend choice (resolve_parity_recompute)."""
+    if params.fused_checksum == "on":
+        return "full"
+    return resolve_parity_recompute(backend)
+
+
 def resolve_parity_recompute(backend: str) -> str:
     """The EXACT recompute shape per backend — every dirty row covered,
     no overflow possible: "gated" (dirty-chunk while_loop, the CPU win)
@@ -468,9 +548,32 @@ def resolve_auto_parity(params: "SimParams", backend: str) -> "SimParams":
     few ticks where K=32 wouldn't — each a cheap single-tick exact
     replay), and replay exactness covers both.  An explicit
     ``parity_recompute="bounded"`` keeps the caller's dirty_batch
-    untouched (diagnostic sweeps need K above the auto pick)."""
+    untouched (diagnostic sweeps need K above the auto pick).
+
+    Fused re-tune — the K ladder collapses: the K=4 optimum above was
+    measured against the XLA byte-assembly encode, whose per-chunk cost
+    grew with K.  The fused streaming kernel processes rows in fixed
+    [8, 128] = 1024-lane tiles, so every K <= 1024 runs the SAME kernel
+    work; encode cost no longer scales with K at all (the bounded shape
+    re-encodes changed CELLS into the record cache — cell_batch — and
+    rows are only reassembled from cached bytes).  The auto chunk is
+    therefore K = min(n, 1024): at headline scale (n <= 1024) the chunk
+    covers EVERY row — row overflow is impossible by construction, the
+    row gather/scatter drops out (k == n hashes rows in natural order),
+    and a churn window can only replay on cell overflow (> cell_batch
+    changed cells in one tick — bootstrap-scale merges, not SWIM churn
+    waves).  Re-validate on-chip via benchmarks/tpu_measure.py's fused
+    phase when the tunnel is up."""
+    params = params._replace(
+        fused_checksum=resolve_fused_checksum(params, backend)
+    )
     if params.parity_recompute == "auto":
-        if backend == "tpu":
+        if params.fused_checksum == "on":
+            params = params._replace(
+                parity_recompute="bounded",
+                dirty_batch=min(params.n, 1024),
+            )
+        elif backend == "tpu":
             params = params._replace(
                 parity_recompute="bounded",
                 dirty_batch=min(params.dirty_batch, 4),
@@ -486,12 +589,16 @@ def _checksums_where(
     params: SimParams,
     dirty: jax.Array,  # [N] bool — rows whose view changed since `cached`
     cached: jax.Array,  # [N] uint32
+    changed: "Optional[jax.Array]" = None,  # [N, N] bool changed cells
 ):
     """Per-row checksum with dirty-row caching.
 
-    Returns ``(checksum [N] uint32, overflow scalar int32)`` — overflow
-    is nonzero only in "bounded" parity mode, when more rows were dirty
-    than the one bounded chunk covers (see SimParams.parity_recompute).
+    Returns ``(checksum [N] uint32, overflow scalar int32, state)`` —
+    overflow is nonzero only in "bounded" parity mode, when more rows
+    were dirty than the one bounded chunk covers (or, fused mode, more
+    cells changed than cell_batch — see SimParams.parity_recompute /
+    fused_checksum); the returned state carries the updated fused record
+    cache (untouched in unfused modes).
 
     The farmhash-parity string build + hash is by far the hottest op in the
     tick; a row's checksum only changes when its VIEW changed, so unchanged
@@ -516,6 +623,17 @@ def _checksums_where(
                 n_dirty > 0, recompute_all, lambda _: cached, operand=None
             ),
             no_overflow,
+            state,
+        )
+
+    import jax as _jax
+
+    if (
+        resolve_fused_checksum(params, _jax.default_backend()) == "on"
+        and changed is not None
+    ):
+        return _fused_checksums_where(
+            state, universe, params, dirty, cached, changed, n_dirty
         )
 
     recompute_shape = params.parity_recompute
@@ -529,7 +647,7 @@ def _checksums_where(
     if recompute_shape == "full":
         # straight-line: no cond, no while.  Recomputing a clean row is
         # bit-neutral, so dirty tracking is simply unused here.
-        return compute_checksums(state, universe, params), no_overflow
+        return compute_checksums(state, universe, params), no_overflow, state
 
     if recompute_shape == "bounded":
         # ONE bounded K-row chunk, no loop: gather the first K dirty rows
@@ -573,7 +691,7 @@ def _checksums_where(
             lambda _: cached,
             None,
         )
-        return out, jnp.maximum(n_dirty - k, 0)
+        return out, jnp.maximum(n_dirty - k, 0), state
 
     k = min(params.dirty_batch, params.n)
 
@@ -623,6 +741,151 @@ def _checksums_where(
             n_dirty > 0, recompute_chunked, lambda _: cached, operand=None
         ),
         no_overflow,
+        state,
+    )
+
+
+def _fused_stream_impl(params: SimParams) -> "Optional[str]":
+    """Streaming-kernel lowering for fused_hash_rows, derived from the
+    same hash_impl knob the classic path uses: any Pallas variant ->
+    the gridless streaming kernel, "scan" -> the scanned XLA twin,
+    "env" -> backend default at trace time (None)."""
+    if params.hash_impl == "env":
+        return None
+    return "pallas" if "pallas" in params.hash_impl else "xla"
+
+
+def _fused_checksums_where(
+    state: SimState,
+    universe: ce.Universe,
+    params: SimParams,
+    dirty: jax.Array,  # [N] bool
+    cached: jax.Array,  # [N] uint32
+    changed: jax.Array,  # [N, N] bool — cells whose view changed
+    n_dirty: jax.Array,
+):
+    """Fused-pipeline recompute: bounded changed-cell re-encode into the
+    persistent record cache, then a K-dirty-row gather hashed by the
+    streaming kernel.  Shapes: "bounded" (the production TPU shape) or
+    "full" (the exact replay twin — dense re-encode of every cell, no
+    overflow possible); "auto"/"gated" collapse to "full" (the fused
+    pipeline's only exact shape; the gated dirty-chunk loop form has no
+    fused equivalent and direct engine users get exactness, not replay
+    plumbing).  Cell overflow (> cell_batch changed cells) and row
+    overflow (> dirty_batch dirty rows) share one parity_overflow
+    counter and the same driver replay contract."""
+    from ringpop_tpu.ops import fused_checksum as fc
+
+    n = params.n
+    r = fc.record_width(universe, params.max_digits)
+    impl = _fused_stream_impl(params)
+    no_overflow = jnp.int32(0)
+    if state.rec_bytes is None:
+        raise ValueError(
+            "fused_checksum='on' but the state carries no record cache — "
+            "build the state with init_state(params, universe=...) or "
+            "rebuild the cache after loading an unfused checkpoint"
+        )
+
+    shape = params.parity_recompute
+    if shape in ("auto", "gated"):
+        shape = "full"
+
+    if shape == "full":
+        rec_b, rec_l = fc.member_records(
+            universe,
+            state.known,
+            state.status,
+            stamp_to_ms(state.inc, params),
+            params.max_digits,
+        )
+        fresh = fc.fused_hash_rows(
+            fc.pack_record_words(rec_b), rec_l, impl=impl
+        )
+        return (
+            fresh,
+            no_overflow,
+            state._replace(rec_bytes=rec_b, rec_len=rec_l),
+        )
+
+    # -- "bounded": ONE cell chunk + ONE row chunk, both straight-line --
+    k = min(params.dirty_batch, n)
+    cbatch = min(params.cell_batch, n * n)
+
+    def update_and_hash(_):
+        # 1. re-encode up to cell_batch changed cells into the cache
+        flat = changed.reshape(-1)
+        n_changed = jnp.sum(flat, dtype=jnp.int32)
+        (cidx,) = jnp.nonzero(flat, size=cbatch, fill_value=n * n)
+        cidx = cidx.astype(jnp.int32)
+        crow = jnp.clip(cidx // n, 0, n - 1)
+        ccol = jnp.clip(cidx % n, 0, n - 1)
+        cell_b, cell_l = fc.member_records_at(
+            universe,
+            ccol,
+            state.status[crow, ccol],
+            stamp_to_ms(state.inc[crow, ccol], params),
+            state.known[crow, ccol],
+            params.max_digits,
+        )
+        rec_b = (
+            state.rec_bytes.reshape(n * n, r)
+            .at[cidx]
+            .set(cell_b, mode="drop")  # fill cells target n*n: dropped
+            .reshape(n, n, r)
+        )
+        rec_l = (
+            state.rec_len.reshape(n * n)
+            .at[cidx]
+            .set(cell_l, mode="drop")
+            .reshape(n, n)
+        )
+        cell_over = jnp.maximum(n_changed - cbatch, 0)
+
+        # 2. hash the dirty rows' cached records with the streaming
+        # kernel.  k == n (the auto pick at n <= 1024: one kernel row
+        # tile covers the whole cluster) skips the gather/scatter and
+        # hashes rows in natural order — rehashing a clean row is
+        # bit-neutral, and row overflow is impossible.  k < n gathers
+        # the first K dirty rows; the byte cache rides the one-hot f32
+        # matmul row-select (_rows — exact for uint8), sidestepping the
+        # ~0.4 GB/s TPU dynamic-gather path the round-4 trace found.
+        if k == n:
+            fresh = fc.fused_hash_rows(
+                fc.pack_record_words(rec_b), rec_l, impl=impl
+            )
+            out = jnp.where(dirty, fresh, cached)
+            return out, cell_over, rec_b, rec_l
+        (idx,) = jnp.nonzero(dirty, size=k, fill_value=0)
+        idx = idx.astype(jnp.int32)
+        lane_ok = jnp.arange(k, dtype=jnp.int32) < n_dirty
+        rows_b = _rows(rec_b.reshape(n, n * r), idx, n).reshape(k, n, r)
+        rows_l = _rows(rec_l, idx, n)
+        fresh = fc.fused_hash_rows(
+            fc.pack_record_words(rows_b), rows_l, impl=impl
+        )
+        tgt = jnp.where(lane_ok, idx, n)  # n drops
+        return (
+            cached.at[tgt].set(fresh, mode="drop"),
+            cell_over,
+            rec_b,
+            rec_l,
+        )
+
+    import jax as _jax
+
+    chunk_gate = params.gate_phases and _jax.default_backend() != "tpu"
+    out, cell_over, rec_b, rec_l = _phase(
+        chunk_gate,
+        n_dirty > 0,
+        update_and_hash,
+        lambda _: (cached, no_overflow, state.rec_bytes, state.rec_len),
+        None,
+    )
+    return (
+        out,
+        jnp.maximum(n_dirty - k, 0) + cell_over,
+        state._replace(rec_bytes=rec_b, rec_len=rec_l),
     )
 
 
@@ -941,6 +1204,24 @@ def tick(
     if inputs.leave is not None:
         dirty = dirty | lv
 
+    # fused parity mode additionally tracks WHICH cells changed, so the
+    # record cache re-encodes O(changed cells), not O(dirty rows * N).
+    # Conservative over-approximations (whole revived/joined rows) are
+    # bit-neutral: re-encoding an unchanged cell reproduces its bytes.
+    fused = params.checksum_mode == "farmhash" and (
+        resolve_fused_checksum(params, jax.default_backend()) == "on"
+    )
+    changed_mid = None
+    if fused:
+        changed_mid = (
+            rv[:, None]  # row reset: cells became unknown too
+            | (joined[:, None] & state.known)
+            | (rejoin[:, None] & is_self)
+            | ja_applied
+        )
+        if inputs.leave is not None:
+            changed_mid = changed_mid | (lv[:, None] & is_self)
+
     # checksum each sender advertises in its ping body this tick — its value
     # as of the end of the previous tick (ping-sender.js:70-76 reads it at
     # message-build time, before any same-period receives land)
@@ -1156,6 +1437,8 @@ def tick(
         state,
     )
     dirty = dirty | jnp.any(applied_ping, axis=1)
+    if fused:
+        changed_mid = changed_mid | applied_ping
 
     # receiver-side piggyback bump: one issueAsReceiver per delivered ping.
     # The receiver-origin filter runs BEFORE the bump (dissemination.js:
@@ -1198,8 +1481,8 @@ def tick(
 
     # mid-tick checksums (receivers respond with post-update checksums);
     # only rows whose view changed since last tick's cache are rehashed
-    mid_checksum, mid_overflow = _checksums_where(
-        state, universe, params, dirty, state.checksum
+    mid_checksum, mid_overflow, state = _checksums_where(
+        state, universe, params, dirty, state.checksum, changed_mid
     )
 
     # ---- phase 6: responses (issueAsReceiver + full-sync) -------------
@@ -1630,8 +1913,11 @@ def tick(
         | jnp.any(applied_pr, axis=1)
         | jnp.any(applied_faulty, axis=1)
     )
-    checksum, late_overflow = _checksums_where(
-        state, universe, params, dirty_late, mid_checksum
+    changed_late = None
+    if fused:
+        changed_late = applied_resp | applied_pr | applied_faulty
+    checksum, late_overflow, state = _checksums_where(
+        state, universe, params, dirty_late, mid_checksum, changed_late
     )
     state = state._replace(checksum=checksum)
 

@@ -497,6 +497,56 @@ def compute_checksums(
     )
 
 
+def farmhash_truth_checksum(
+    state: ScalableState,
+    universe,
+    params: ScalableParams,
+    max_digits: int = 14,
+    impl: "str | None" = None,
+) -> jax.Array:
+    """Bit-exact reference FarmHash32 membership checksum of the TRUTH
+    view — the parity tick of the scalable engine.
+
+    The rumor model keeps no per-(observer, subject) matrix, so a
+    per-observer string checksum does not exist at O(N*U); what IS
+    defined bit-exactly is the checksum a fully-caught-up observer's
+    reference ``Membership.computeChecksum()`` would report: every
+    subject at its latest asserted ``(status, incarnation)`` — the
+    ``truth_status`` / ``truth_inc`` chain.  Computed with the fused
+    record encode + streaming hash (ops.fused_checksum), which at
+    N = 100k-1M is the only formulation that doesn't materialize a
+    multi-GB string buffer: the encode is O(N*R) elementwise and the
+    stream walks record words straight from HBM through VMEM.
+
+    Returns a scalar uint32.  Used by the parity spot-checks and the
+    roofline capture (scripts/prof_parity_roofline.py); the engine's
+    in-tick checksums remain the commutative record-mix sums (equal
+    views <=> equal sums), exactly as documented in the module
+    docstring.  The universe must hold the same addresses the cluster
+    was built over (sorted order = checksum string order)."""
+    from ringpop_tpu.ops import fused_checksum as fc
+
+    n = params.n
+    # stamp -> the reference's epoch-ms incarnation (period fixed at
+    # 200 ms in this engine's clock — see ScalableState.truth_inc)
+    inc_ms = jnp.where(
+        state.truth_inc > 0,
+        jnp.int64(params.epoch)
+        + (state.truth_inc.astype(jnp.int64) - 1) * 200,
+        jnp.int64(0),
+    )
+    rec_b, rec_l = fc.member_records(
+        universe,
+        jnp.ones((1, n), bool),
+        state.truth_status[None, :],
+        inc_ms[None, :],
+        max_digits,
+    )
+    return fc.fused_hash_rows(
+        fc.pack_record_words(rec_b), rec_l, impl=impl
+    )[0]
+
+
 def tick(
     state: ScalableState, inputs: ChurnInputs, params: ScalableParams
 ) -> tuple[ScalableState, ScalableMetrics]:

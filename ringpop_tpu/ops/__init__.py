@@ -1,2 +1,4 @@
 """Hash and kernel ops: FarmHash32 (host oracle, numpy batch, in-jit JAX,
-Pallas TPU), checksum-string encoding, and ring-table kernels."""
+Pallas TPU), checksum-string encoding, record-mix hashing, and the fused
+checksum pipeline (record-granularity encode + gridless streaming
+assemble+hash kernel — :mod:`ringpop_tpu.ops.fused_checksum`)."""

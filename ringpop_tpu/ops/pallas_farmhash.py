@@ -143,6 +143,289 @@ def block_loop(h0, g0, f0, blocks, iters, *, interpret: bool = False):
     return h, g, f
 
 
+# ---------------------------------------------------------------------------
+# Fused encode+hash streaming: assemble checksum rows from per-member record
+# words IN VMEM and block-walk them in the same kernel, so the [B, row_bytes]
+# string buffer never exists in HBM (the ~100 MB/s XLA byte-assembly floor —
+# VERDICT.md round 5 "Next round" item 1).
+#
+# The stream state per row is tiny: the three mixing carries, a <RES_W-word
+# residual of not-yet-consumed bytes, the residual byte count, and the count
+# of 20-byte blocks already mixed.  Appending a member's record is a per-lane
+# variable byte shift (word shift Wq in [0, 4] + bit shift, both vectorized);
+# consuming a block is a 5-word shift-down (20 bytes are word-aligned, so no
+# bit shifting).  Invariant: residual bytes at or beyond ``res_len`` are zero
+# (records are zero-padded past their length), so append is a plain OR and
+# consume needs no re-zeroing.
+# ---------------------------------------------------------------------------
+
+
+def stream_geometry(rec_words: int):
+    """(RES_W, ROUNDS) for a record capacity of ``rec_words`` uint32 words:
+    residual capacity covers 19 carried bytes + one full record; ROUNDS is
+    the most 20-byte blocks one append can complete."""
+    cap = 19 + 4 * rec_words
+    return (cap + 3) // 4, cap // 20
+
+
+def stream_member_step(carry, rec, rec_len):
+    """Append ONE member record to each row's residual and consume every
+    completed 20-byte block (bit-exact farmhashmk mixing order).
+
+    ``carry``: (h, g, f, res tuple[RES_W], res_len, done, total_blocks) —
+    all arrays of one broadcast row shape; ``rec``: tuple[RW] of uint32
+    record words (zero-padded past ``rec_len``); ``rec_len``: int32 record
+    byte length (0 for an absent member).  Shape-agnostic: the same
+    function body runs inside the gridless Pallas kernel on [S, LANE]
+    tiles and inside the pure-XLA ``lax.scan`` fallback on [B] vectors.
+    """
+    h, g, f, res, res_len, done, total_blocks = carry
+    res_w = len(res)
+    rw = len(rec)
+    rounds = stream_geometry(rw)[1]
+
+    # -- append: shift the record up by res_len bytes and OR it in --------
+    appending = done < total_blocks
+    q = res_len
+    wq = q >> 2  # word shift, in [0, 4] (res_len <= 19 while appending)
+    bq = ((q & 3) << 3).astype(jnp.uint32)  # bit shift within the word
+
+    def rec_ext(k):
+        if 0 <= k < rw:
+            return rec[k]
+        return jnp.zeros_like(rec[0])
+
+    new_res = []
+    zero32 = jnp.uint32(0)
+    for w in range(res_w):
+        cand = jnp.zeros_like(rec[0])
+        prev = jnp.zeros_like(rec[0])
+        for k in range(min(w, 4) + 1):
+            sel = wq == k
+            cand = jnp.where(sel, rec_ext(w - k), cand)
+            prev = jnp.where(sel, rec_ext(w - k - 1), prev)
+        # (32 - bq) & 31 keeps the shift amount defined at bq == 0; the
+        # where() discards that lane's value anyway
+        spill = prev >> ((jnp.uint32(32) - bq) & jnp.uint32(31))
+        shifted = jnp.where(bq == 0, cand, (cand << bq) | spill)
+        new_res.append(res[w] | jnp.where(appending, shifted, zero32))
+    res = new_res
+    res_len = res_len + jnp.where(appending, rec_len, 0)
+
+    # -- consume completed blocks (at most ``rounds`` per append) ---------
+    for _ in range(rounds):
+        can = (res_len >= 20) & (done < total_blocks)
+        a, b, c, d, e = res[0], res[1], res[2], res[3], res[4]
+        nh = h + a
+        ng = g + b
+        nf = f + c
+        nh = _mur(d, nh) + e
+        ng = _mur(c, ng) + a
+        nf = _mur(b + e * C1, nf) + d
+        nf = nf + ng
+        ng = ng + nf
+        h = jnp.where(can, nh, h)
+        g = jnp.where(can, ng, g)
+        f = jnp.where(can, nf, f)
+        res = [
+            jnp.where(
+                can,
+                res[w + 5] if w + 5 < res_w else zero32,
+                res[w],
+            )
+            for w in range(res_w)
+        ]
+        res_len = res_len - jnp.where(can, 20, 0)
+        done = done + jnp.where(can, 1, 0)
+    return (h, g, f, tuple(res), res_len, done, total_blocks)
+
+
+def _fused_stream_kernel(slab_ref, len_ref, tb_ref, h0_ref, g0_ref, f0_ref,
+                         res0_ref, rl0_ref, dn0_ref,
+                         oh_ref, og_ref, of_ref, ores_ref, orl_ref, odn_ref):
+    """One gridless call streams ``cm`` member records through the row
+    tile's residual state: slab [CM, RW, S, LANE] uint32, len [CM, S, LANE]
+    int32, carries in/out as plain operands (the only Pallas shape the
+    axon tunnel's compile helper accepts — PALLAS_BISECT.json)."""
+    cm = slab_ref.shape[0]
+    rw = slab_ref.shape[1]
+    res_w = res0_ref.shape[0]
+
+    def body(k, carry):
+        h, g, f, res, rl, dn, tb = carry
+        rec = tuple(slab_ref[k, w] for w in range(rw))
+        return stream_member_step(
+            (h, g, f, res, rl, dn, tb), rec, len_ref[k]
+        )
+
+    out = jax.lax.fori_loop(
+        0,
+        cm,
+        body,
+        (
+            h0_ref[:],
+            g0_ref[:],
+            f0_ref[:],
+            tuple(res0_ref[w] for w in range(res_w)),
+            rl0_ref[:],
+            dn0_ref[:],
+            tb_ref[:],
+        ),
+    )
+    h, g, f, res, rl, dn, _ = out
+    oh_ref[:] = h
+    og_ref[:] = g
+    of_ref[:] = f
+    for w in range(res_w):
+        ores_ref[w] = res[w]
+    orl_ref[:] = rl
+    odn_ref[:] = dn
+
+
+def fused_stream_nogrid(
+    h0,
+    g0,
+    f0,
+    rec_words,  # [B, N, RW] uint32 — per-member record words, zero-padded
+    rec_len,  # [B, N] int32 — record byte lengths (0 = absent)
+    total_blocks,  # [B] int32 — (len-1)//20 for long rows, 0 otherwise
+    *,
+    chunk: int = 64,
+    interpret: bool = False,
+    vmem_budget: int = 8 * 1024 * 1024,
+):
+    """Fused encode+hash block walk: returns the (h, g, f) carries after
+    streaming every member record through the farmhashmk 20-byte mixing
+    loop, rows vectorized [S, LANE]-wide, the assembled string living
+    only in the VMEM residual.  Gridless (tunnel-compilable): the member
+    axis rides an outer ``lax.scan`` of ``chunk``-member slabs; large row
+    counts tile the sublane axis through the same kernel."""
+    from jax.experimental import pallas as pl
+
+    B, N, RW = rec_words.shape
+    res_w, _ = stream_geometry(RW)
+    pad = (-B) % TILE
+    if pad:
+        h0 = jnp.pad(h0, (0, pad))
+        g0 = jnp.pad(g0, (0, pad))
+        f0 = jnp.pad(f0, (0, pad))
+        rec_words = jnp.pad(rec_words, ((0, pad), (0, 0), (0, 0)))
+        rec_len = jnp.pad(rec_len, ((0, pad), (0, 0)))
+        total_blocks = jnp.pad(total_blocks, (0, pad))
+    bp = B + pad
+    s = bp // LANE
+
+    # VMEM levers, in order: shrink the member chunk, then tile rows
+    chunk = max(1, min(chunk, N))
+    while chunk > 1 and chunk * (RW + 1) * s * LANE * 4 > vmem_budget:
+        chunk //= 2
+    s_t = s
+    while s_t > 8 and chunk * (RW + 1) * s_t * LANE * 4 > vmem_budget:
+        s_t = ((s_t + 1) // 2 + 7) // 8 * 8
+    rt = -(-s // s_t)
+    if rt > 1 and rt * s_t > s:
+        extra = (rt * s_t - s) * LANE
+        h0 = jnp.pad(h0, (0, extra))
+        g0 = jnp.pad(g0, (0, extra))
+        f0 = jnp.pad(f0, (0, extra))
+        rec_words = jnp.pad(rec_words, ((0, extra), (0, 0), (0, 0)))
+        rec_len = jnp.pad(rec_len, ((0, extra), (0, 0)))
+        total_blocks = jnp.pad(total_blocks, (0, extra))
+        s = rt * s_t
+    mpad = (-N) % chunk
+    if mpad:
+        # zero-length pad members append nothing
+        rec_words = jnp.pad(rec_words, ((0, 0), (0, mpad), (0, 0)))
+        rec_len = jnp.pad(rec_len, ((0, 0), (0, mpad)))
+    nm = N + mpad
+    steps = nm // chunk
+
+    # [B, N, RW] -> [rt, steps, CM, RW, s_t, LANE]
+    slabs = (
+        rec_words.reshape(rt, s_t, LANE, steps, chunk, RW)
+        .transpose(0, 3, 4, 5, 1, 2)
+    )
+    lens = (
+        rec_len.reshape(rt, s_t, LANE, steps, chunk)
+        .transpose(0, 3, 4, 1, 2)
+    )
+
+    call = pl.pallas_call(
+        _fused_stream_kernel,
+        out_shape=[
+            jax.ShapeDtypeStruct((s_t, LANE), jnp.uint32),  # h
+            jax.ShapeDtypeStruct((s_t, LANE), jnp.uint32),  # g
+            jax.ShapeDtypeStruct((s_t, LANE), jnp.uint32),  # f
+            jax.ShapeDtypeStruct((res_w, s_t, LANE), jnp.uint32),
+            jax.ShapeDtypeStruct((s_t, LANE), jnp.int32),  # res_len
+            jax.ShapeDtypeStruct((s_t, LANE), jnp.int32),  # done
+        ],
+        interpret=interpret,
+    )
+
+    def tiles(x):
+        return x.reshape(rt, s_t, LANE)
+
+    def inner(carry, x):
+        slab, ln = x
+        h, g, f, res, rl, dn, tb = carry
+        h, g, f, res, rl, dn = call(slab, ln, tb, h, g, f, res, rl, dn)
+        return (h, g, f, res, rl, dn, tb), None
+
+    def outer(_, tile):
+        slab_t, len_t, ht, gt, ft, tbt = tile
+        izero = jnp.zeros((s_t, LANE), jnp.int32)
+        res0 = jnp.zeros((res_w, s_t, LANE), jnp.uint32)
+        (h, g, f, _, _, _, _), __ = jax.lax.scan(
+            inner, (ht, gt, ft, res0, izero, izero, tbt), (slab_t, len_t)
+        )
+        return None, (h, g, f)
+
+    _, (h, g, f) = jax.lax.scan(
+        outer,
+        None,
+        (
+            slabs,
+            lens,
+            tiles(h0),
+            tiles(g0),
+            tiles(f0),
+            tiles(total_blocks.astype(jnp.int32)),
+        ),
+    )
+    h, g, f = (x.reshape(s * LANE)[:B] for x in (h, g, f))
+    return h, g, f
+
+
+def fused_stream_xla(h0, g0, f0, rec_words, rec_len, total_blocks):
+    """Pure-XLA twin of :func:`fused_stream_nogrid`: the same
+    ``stream_member_step`` scanned over the member axis with [B]-vector
+    rows — the CPU fallback and the off-chip reference the interpret
+    tests pin the kernel against.  Bit-exact by construction (shared
+    step function)."""
+    B, N, RW = rec_words.shape
+    res_w, _ = stream_geometry(RW)
+    tb = total_blocks.astype(jnp.int32)
+    res0 = tuple(jnp.zeros(B, jnp.uint32) for _ in range(res_w))
+    izero = jnp.zeros(B, jnp.int32)
+
+    def body(carry, x):
+        rec_m, len_m = x
+        return (
+            stream_member_step(
+                carry, tuple(rec_m[:, w] for w in range(RW)), len_m
+            ),
+            None,
+        )
+
+    (h, g, f, _, _, _, _), __ = jax.lax.scan(
+        body,
+        (h0, g0, f0, res0, izero, izero, tb),
+        (rec_words.transpose(1, 0, 2), rec_len.T),
+    )
+    return h, g, f
+
+
 def _nogrid_kernel(blk_ref, act_ref, h0_ref, g0_ref, f0_ref,
                    oh_ref, og_ref, of_ref):
     """One gridless call = ``chunk`` mixing rounds over a [S, LANE] row

@@ -114,12 +114,20 @@ def shard_state(state: engine.SimState, mesh: Mesh) -> engine.SimState:
     return jax.device_put(state, state_shardings(mesh, state))
 
 
-def _abstract_state(params: engine.SimParams):
+def _abstract_state(params: engine.SimParams, universe=None):
     """Shape-only SimState (no arrays built) for deriving shardings.
-    Checksum mode does not affect shapes, so evaluate in fast mode — the
-    farmhash mode requires a universe to seed the checksum cache, which a
-    shape probe neither has nor needs."""
-    shape_params = params._replace(checksum_mode="fast")
+    Unfused checksum modes share one shape, so evaluate in fast mode —
+    the farmhash mode requires a universe to seed the checksum cache,
+    which a shape probe neither has nor needs.  Fused mode DOES change
+    the state shape (the [N, N, R] record cache, R universe-dependent),
+    so it traces the real init with the universe."""
+    if params.fused_checksum == "on" and universe is not None:
+        return jax.eval_shape(
+            lambda: engine.init_state(params, universe=universe)
+        )
+    shape_params = params._replace(
+        checksum_mode="fast", fused_checksum="off"
+    )
     return jax.eval_shape(lambda: engine.init_state(shape_params))
 
 
@@ -142,7 +150,7 @@ def make_sharded_tick(
     drivers: fresh ShardedSim instances with the same config reuse the
     compiled executable instead of re-tracing.
     """
-    st_sh = state_shardings(mesh, _abstract_state(params))
+    st_sh = state_shardings(mesh, _abstract_state(params, universe))
     in_sh = inputs_shardings(mesh, engine.TickInputs.quiet(params.n))
     metrics_sh = _replicated_metrics(mesh)
     fn = functools.partial(engine.tick, params=params, universe=universe)
@@ -157,7 +165,7 @@ def make_sharded_scan(
 ):
     """Compile a ``lax.scan`` of the tick over a [T, N] event schedule.
     lru_cached like :func:`make_sharded_tick`."""
-    st_sh = state_shardings(mesh, _abstract_state(params))
+    st_sh = state_shardings(mesh, _abstract_state(params, universe))
     axis = _node_axis(mesh)
     sched_sh = jax.tree.map(
         lambda x: NamedSharding(mesh, P(None, axis)),
@@ -241,10 +249,11 @@ class ShardedSim:
 
     def _exact_params(self) -> engine.SimParams:
         """Exact-recompute twin for bounded-parity overflow replays (same
-        contract as SimCluster's — see engine.SimParams.parity_recompute)."""
+        contract as SimCluster's — see engine.SimParams.parity_recompute;
+        fused runs always replay under "full")."""
         return self.params._replace(
-            parity_recompute=engine.resolve_parity_recompute(
-                jax.default_backend()
+            parity_recompute=engine.resolve_exact_recompute(
+                self.params, jax.default_backend()
             )
         )
 

@@ -39,6 +39,14 @@ def main(argv=None) -> int:
     p.add_argument("--ticks", type=int, default=48)
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--output", "-o", default="PARITY_REPLAY.json")
+    # default ON: the artifact attests the production parity pipeline —
+    # the fused record-cache encode + streaming hash (trajectory is
+    # bitwise-identical either way; --no-fused re-derives it through the
+    # classic membership_rows + hash32_rows composition as a cross-check)
+    p.add_argument(
+        "--fused", action="store_true", default=True, dest="fused"
+    )
+    p.add_argument("--no-fused", action="store_false", dest="fused")
     args = p.parse_args(argv)
     if args.ticks < 32:
         p.error(
@@ -57,7 +65,13 @@ def main(argv=None) -> int:
 
     n = args.n
     params = engine.SimParams(
-        n=n, checksum_mode="farmhash", suspicion_ticks=6
+        n=n,
+        checksum_mode="farmhash",
+        suspicion_ticks=6,
+        # fused: direct engine use (no driver replay plumbing), so the
+        # exact full-recompute shape — parity_recompute stays "auto",
+        # which the fused path resolves to "full"
+        fused_checksum="on" if args.fused else "off",
     )
     addresses = default_addresses(n)
     universe = ce.Universe.from_addresses(addresses)
@@ -136,7 +150,14 @@ def main(argv=None) -> int:
             "and compare farmhash.hash32(str) >>> 0 to expected_checksum."
         ),
         "generator": "scripts/export_parity_replay.py",
-        "engine": "ringpop_tpu full-fidelity engine, farmhash mode",
+        "engine": (
+            "ringpop_tpu full-fidelity engine, farmhash mode"
+            + (
+                " (fused record-cache encode + streaming hash)"
+                if args.fused
+                else ""
+            )
+        ),
         "n": n,
         "ticks": args.ticks,
         "seed": args.seed,

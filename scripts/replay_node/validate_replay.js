@@ -1,0 +1,93 @@
+#!/usr/bin/env node
+// Validates PARITY_REPLAY.json against real ringpop-node code: for every
+// snapshot, rebuild the reference's generateChecksumString
+// (lib/membership/index.js:101-123 — members sorted by address,
+// address + status + incarnationNumber concatenated, joined with ';')
+// and compare farmhash.hash32(str) >>> 0 with the engine's checksum.
+//
+// Two validation modes, strongest available wins:
+//  1. RINGPOP_NODE_DIR set (or /root/reference present): require() the
+//     actual checksum-string builder from a ringpop-node checkout and
+//     feed it the snapshot's member records verbatim.
+//  2. Otherwise: rebuild the string by hand per the documented contract
+//     (still hashes with the REAL farmhash native addon ringpop loads).
+//
+// Usage: npm install && node validate_replay.js ../../PARITY_REPLAY.json
+
+'use strict';
+
+var fs = require('fs');
+var path = require('path');
+var farmhash = require('farmhash');
+
+var artifactPath = process.argv[2] || '../../PARITY_REPLAY.json';
+var refDir = process.env.RINGPOP_NODE_DIR || '/root/reference';
+
+function manualChecksumString(members) {
+    // lib/membership/index.js:101-123, bytewise ASCII sort by address
+    var sorted = members.slice().sort(function (a, b) {
+        return a.address < b.address ? -1 : a.address > b.address ? 1 : 0;
+    });
+    return sorted
+        .map(function (m) {
+            return m.address + m.status + m.incarnationNumber;
+        })
+        .join(';');
+}
+
+function referenceChecksumString(members) {
+    // Drive the real module: a Membership instance populated with the
+    // snapshot's member records, asked for its own checksum string.
+    var Membership = require(path.join(refDir, 'lib', 'membership', 'index.js'));
+    var Member = require(path.join(refDir, 'lib', 'membership', 'member.js'));
+    var stub = {
+        logger: { debug: noop, info: noop, warn: noop, error: noop, trace: noop },
+        stat: noop,
+        whoami: function () { return members[0] && members[0].address; },
+        config: { get: function () { return undefined; } },
+        loggerFactory: { getLogger: function () { return stub.logger; } },
+        timers: { setTimeout: noop, clearTimeout: noop },
+    };
+    function noop() {}
+    var membership = new Membership({ ringpop: stub });
+    members.forEach(function (m) {
+        var member = new Member(stub, {
+            address: m.address,
+            status: m.status,
+            incarnationNumber: m.incarnationNumber,
+        });
+        membership.members.push(member);
+        membership.membersByAddress[m.address] = member;
+    });
+    return membership.generateChecksumString();
+}
+
+var useReference = false;
+try {
+    fs.accessSync(path.join(refDir, 'lib', 'membership', 'index.js'));
+    referenceChecksumString([
+        { address: '127.0.0.1:3000', status: 'alive', incarnationNumber: 1 },
+    ]);
+    useReference = true;
+    console.log('mode: ringpop-node Membership module (' + refDir + ')');
+} catch (e) {
+    console.log('mode: manual string rebuild (' + e.message + ')');
+}
+
+var artifact = JSON.parse(fs.readFileSync(artifactPath, 'utf8'));
+var bad = 0;
+artifact.snapshots.forEach(function (snap) {
+    var str = useReference
+        ? referenceChecksumString(snap.members)
+        : manualChecksumString(snap.members);
+    var got = farmhash.hash32(str) >>> 0;
+    if (got !== snap.expected_checksum) {
+        bad++;
+        console.error(
+            'MISMATCH tick=' + snap.tick + ' observer=' + snap.observer +
+            ' got=' + got + ' want=' + snap.expected_checksum
+        );
+    }
+});
+console.log(artifact.snapshots.length + ' snapshots, ' + bad + ' mismatches');
+process.exit(bad ? 1 : 0);

@@ -1,0 +1,51 @@
+#!/usr/bin/env node
+// Validates PARITY_TRAJECTORY.json: a complete scripted tick-cluster run
+// (bootstrap -> kill wave -> suspect -> faulty -> revive -> reconverge)
+// where every tick carries the checksum-group view the reference's
+// tick-cluster harness prints (scripts/tick-cluster.js:87-114), each
+// represented group with one observer's full membership view.  Rebuilds
+// the reference checksum string for every representative and compares
+// farmhash.hash32 (the real native addon) with the engine's checksum.
+//
+// Usage: npm install && node validate_trajectory.js ../../PARITY_TRAJECTORY.json
+
+'use strict';
+
+var fs = require('fs');
+var farmhash = require('farmhash');
+
+var art = JSON.parse(
+    fs.readFileSync(process.argv[2] || '../../PARITY_TRAJECTORY.json', 'utf8')
+);
+var checked = 0;
+var bad = 0;
+art.ticks_data.forEach(function (t) {
+    t.groups.forEach(function (g) {
+        if (!g.representative) return; // counts-only group (capped)
+        var sorted = g.representative.members.slice().sort(function (a, b) {
+            return a[0] < b[0] ? -1 : a[0] > b[0] ? 1 : 0;
+        });
+        var str = sorted
+            .map(function (m) {
+                return m[0] + m[1] + m[2]; // address + status + incarnation
+            })
+            .join(';');
+        var got = farmhash.hash32(str) >>> 0;
+        checked++;
+        if (got !== g.checksum) {
+            bad++;
+            console.error(
+                'MISMATCH tick=' + t.tick +
+                ' observer=' + g.representative.observer +
+                ' got=' + got + ' want=' + g.checksum
+            );
+        }
+    });
+});
+console.log(
+    checked + ' group checksums checked across ' + art.ticks_data.length +
+    ' ticks, ' + bad + ' mismatches; final tick has ' +
+    art.ticks_data[art.ticks_data.length - 1].distinct_checksums +
+    ' distinct checksum(s)'
+);
+process.exit(bad ? 1 : 0);

@@ -444,3 +444,49 @@ def test_gate_phases_off_is_bitwise_identical_scalable():
         for f in mt._fields:
             a, b = np.asarray(getattr(mt, f)), np.asarray(getattr(mf, f))
             assert (a == b).all(), "metric %s diverges" % f
+
+
+def test_farmhash_truth_checksum_matches_reference():
+    """The scalable engine's on-demand parity export: the truth view's
+    fused-encoded FarmHash32 must equal the host-built reference
+    checksum string's hash, before and after churn mutates the truth
+    chain (kill -> faulty escalation with a fresh status)."""
+    from ringpop_tpu.models.sim.cluster import default_addresses
+    from ringpop_tpu.ops import checksum_encode as ce
+    from ringpop_tpu.ops import farmhash32 as fh
+
+    n = 64
+    params = es.ScalableParams(n=n, u=128, suspicion_ticks=3)
+    uni = ce.Universe.from_addresses(default_addresses(n))
+    st = es.init_state(params, seed=0)
+    step = jax.jit(functools.partial(es.tick, params=params))
+
+    def host_truth(state):
+        status = np.asarray(state.truth_status)
+        inc = np.asarray(state.truth_inc)
+        members = []
+        for j, a in enumerate(uni.addresses):
+            ms = params.epoch + (int(inc[j]) - 1) * 200 if inc[j] > 0 else 0
+            members.append(
+                (a, ce.STATUS_STRINGS[int(status[j])], ms)
+            )
+        return fh.hash32(
+            ";".join("%s%s%d" % m for m in sorted(members))
+        )
+
+    assert int(
+        es.farmhash_truth_checksum(st, uni, params, impl="xla")
+    ) == host_truth(st)
+
+    kill = np.zeros(n, bool)
+    kill[7] = True
+    st, _ = step(st, es.ChurnInputs(kill=jnp.asarray(kill),
+                                    revive=jnp.zeros(n, bool)))
+    for _ in range(10):  # escalate to faulty in the truth chain
+        st, _ = step(st, es.ChurnInputs.quiet(n))
+    assert {0, 2} <= set(
+        np.unique(np.asarray(st.truth_status)).tolist()
+    ), "churn must mutate the truth chain for this test to bite"
+    assert int(
+        es.farmhash_truth_checksum(st, uni, params, impl="xla")
+    ) == host_truth(st)

@@ -277,18 +277,37 @@ def test_bounded_parity_straightline_matches_gated():
 
 
 def test_resolve_auto_parity_policy():
-    """The driver-level auto resolution: bounded K=4 on TPU (the round-5
-    ladder optimum), gated on CPU with dirty_batch untouched; explicit
-    bounded keeps the caller's K; the exact-fallback resolver never
-    returns bounded (a bounded replay would overflow again and loop)."""
+    """The driver-level auto resolution: on TPU the fused pipeline is on
+    and the bounded chunk is K=min(n, 1024) — one streaming-kernel row
+    tile covers every row, so row overflow is impossible (the unfused
+    K=4 ladder optimum applies only with fused_checksum="off"); gated +
+    unfused on CPU with dirty_batch untouched; explicit bounded keeps
+    the caller's K; the exact-fallback resolvers never return bounded
+    (a bounded replay would overflow again and loop)."""
     p = engine.SimParams(n=64, checksum_mode="farmhash")
     t = engine.resolve_auto_parity(p, "tpu")
-    assert (t.parity_recompute, t.dirty_batch) == ("bounded", 4)
+    assert (t.parity_recompute, t.dirty_batch, t.fused_checksum) == (
+        "bounded",
+        64,
+        "on",
+    )
+    tu = engine.resolve_auto_parity(p._replace(fused_checksum="off"), "tpu")
+    assert (tu.parity_recompute, tu.dirty_batch) == ("bounded", 4)
     c = engine.resolve_auto_parity(p, "cpu")
-    assert (c.parity_recompute, c.dirty_batch) == ("gated", p.dirty_batch)
+    assert (c.parity_recompute, c.dirty_batch, c.fused_checksum) == (
+        "gated",
+        p.dirty_batch,
+        "off",
+    )
     e = engine.resolve_auto_parity(
         p._replace(parity_recompute="bounded", dirty_batch=64), "tpu"
     )
     assert e.dirty_batch == 64  # explicit bounded: caller's K kept
     for backend in ("tpu", "cpu"):
         assert engine.resolve_parity_recompute(backend) != "bounded"
+        assert (
+            engine.resolve_exact_recompute(
+                p._replace(fused_checksum="on"), backend
+            )
+            == "full"
+        )  # fused replays have exactly one exact shape

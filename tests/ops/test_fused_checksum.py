@@ -1,0 +1,227 @@
+"""Fused encode+hash pipeline vs the classic composition, bit for bit.
+
+The fused path (ops.fused_checksum: record encode -> streaming VMEM
+assemble+hash) must produce the SAME uint32 as
+``hash32_rows(*membership_rows(...))`` on every view — that composition is
+itself pinned to the host oracle and Google's compiled farmhash by the
+existing suites, so equality here extends the parity chain to the fused
+kernel.  Interpret-mode Pallas runs everywhere, keeping the kernel logic
+itself under test off-chip (tier-1 budget: all cases are small)."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from ringpop_tpu.ops import checksum_encode as ce
+from ringpop_tpu.ops import fused_checksum as fc
+from ringpop_tpu.ops import jax_farmhash as jfh
+
+
+def _views(seed=3, n_extra="10.0.0.9:99"):
+    """A small universe + adversarial view batch: empty row, full row,
+    single member (short-string buckets), pairs, every status, digit
+    counts 1..14 including zero incarnations."""
+    addrs = ["127.0.0.1:%d" % (3000 + i) for i in range(17)] + [n_extra]
+    uni = ce.Universe.from_addresses(addrs)
+    n = uni.n
+    rng = np.random.default_rng(seed)
+    B = 9
+    present = rng.random((B, n)) > 0.3
+    present[0] = True  # full membership
+    present[1] = False  # empty row -> len 0
+    present[4] = False
+    present[4, 2] = True  # single member -> short bucket
+    present[5] = False
+    present[5, [0, 9]] = True
+    status = rng.integers(0, 4, size=(B, n))
+    status[6] = 3  # all-leave records
+    inc = rng.integers(1, 10**14, size=(B, n))
+    inc[2, :] = 7  # single-digit incarnations
+    inc[3, :5] = 0  # zero incarnation edge ("0" is one digit)
+    inc[7] = 99999999999999  # 14-digit boundary
+    return uni, present, status, inc
+
+
+def test_member_records_rebuild_row_strings():
+    """Concatenating present members' records (dropping the final ';')
+    must reproduce membership_rows' assembled string byte-for-byte."""
+    uni, present, status, inc = _views()
+    bufs, lens = ce.membership_rows(
+        uni, jnp.asarray(present), jnp.asarray(status), jnp.asarray(inc)
+    )
+    rec_b, rec_l = fc.member_records(
+        uni, jnp.asarray(present), jnp.asarray(status), jnp.asarray(inc)
+    )
+    bufs, lens = np.asarray(bufs), np.asarray(lens)
+    rec_b, rec_l = np.asarray(rec_b), np.asarray(rec_l)
+    for b in range(present.shape[0]):
+        parts = [
+            bytes(rec_b[b, j, : rec_l[b, j]])
+            for j in range(uni.n)
+            if rec_l[b, j]
+        ]
+        want = b"".join(parts)[:-1] if parts else b""
+        assert want == bytes(bufs[b, : lens[b]]), b
+        # zero-padding invariant past each record's length (the stream
+        # kernel ORs records in; garbage there would corrupt the row)
+        for j in range(uni.n):
+            assert not rec_b[b, j, rec_l[b, j] :].any(), (b, j)
+
+
+@pytest.mark.parametrize("max_digits", [14, 19])
+def test_fused_matches_composition(max_digits):
+    uni, present, status, inc = _views()
+    bufs, lens = ce.membership_rows(
+        uni,
+        jnp.asarray(present),
+        jnp.asarray(status),
+        jnp.asarray(inc),
+        max_digits=max_digits,
+    )
+    want = np.asarray(jfh.hash32_rows(bufs, lens, impl="scan"))
+    got = np.asarray(
+        fc.membership_checksums(
+            uni,
+            jnp.asarray(present),
+            jnp.asarray(status),
+            jnp.asarray(inc),
+            max_digits=max_digits,
+            impl="xla",
+        )
+    )
+    assert (got == want).all(), np.flatnonzero(got != want)
+
+
+def test_fused_pallas_interpret_matches_composition():
+    """The gridless streaming kernel (interpret mode off-chip), with a
+    small member chunk to exercise the scan-of-slabs path."""
+    uni, present, status, inc = _views(seed=11)
+    bufs, lens = ce.membership_rows(
+        uni, jnp.asarray(present), jnp.asarray(status), jnp.asarray(inc)
+    )
+    want = np.asarray(jfh.hash32_rows(bufs, lens, impl="scan"))
+    rec_b, rec_l = fc.member_records(
+        uni, jnp.asarray(present), jnp.asarray(status), jnp.asarray(inc)
+    )
+    got = np.asarray(
+        fc.fused_hash_rows(
+            fc.pack_record_words(rec_b), rec_l, impl="pallas", chunk=4
+        )
+    )
+    assert (got == want).all(), np.flatnonzero(got != want)
+
+
+def test_incremental_cell_update_matches_dense():
+    """The sparse cache-update path (member_records_at + scatter) must
+    land exactly the bytes a dense re-encode would: flip a few members'
+    (status, incarnation) and an unknown->known edge, update only those
+    cells, and compare the whole cache against a fresh dense encode —
+    untouched cells byte-identical (reused), touched cells fresh."""
+    uni, present, status, inc = _views(seed=7)
+    n = uni.n
+    rec_b, rec_l = fc.member_records(
+        uni, jnp.asarray(present), jnp.asarray(status), jnp.asarray(inc)
+    )
+    rec_b, rec_l = np.asarray(rec_b).copy(), np.asarray(rec_l).copy()
+
+    # mutate: (row, member) cells — status flip, incarnation bump with a
+    # digit-count change, a member appearing, a member leaving
+    edits = [(0, 3), (0, 11), (2, 2), (4, 2), (5, 9)]
+    present2 = present.copy()
+    status2 = status.copy()
+    inc2 = inc.copy()
+    status2[0, 3] = (status[0, 3] + 1) % 4
+    inc2[0, 11] = 10**13  # 7 -> 14 digits on row 2's scale
+    status2[2, 2] = 2
+    present2[4, 2] = False  # row 4 empties out
+    inc2[5, 9] = 0
+
+    rows = np.array([e[0] for e in edits])
+    cols = np.array([e[1] for e in edits])
+    cell_b, cell_l = fc.member_records_at(
+        uni,
+        jnp.asarray(cols),
+        jnp.asarray(status2[rows, cols]),
+        jnp.asarray(inc2[rows, cols]),
+        jnp.asarray(present2[rows, cols]),
+    )
+    rec_b[rows, cols] = np.asarray(cell_b)
+    rec_l[rows, cols] = np.asarray(cell_l)
+
+    dense_b, dense_l = fc.member_records(
+        uni, jnp.asarray(present2), jnp.asarray(status2), jnp.asarray(inc2)
+    )
+    assert (rec_b == np.asarray(dense_b)).all()
+    assert (rec_l == np.asarray(dense_l)).all()
+
+    # and the fused hash over the incrementally-updated cache equals the
+    # composition over the mutated views
+    bufs, lens = ce.membership_rows(
+        uni, jnp.asarray(present2), jnp.asarray(status2), jnp.asarray(inc2)
+    )
+    want = np.asarray(jfh.hash32_rows(bufs, lens, impl="scan"))
+    got = np.asarray(
+        fc.fused_hash_rows(
+            fc.pack_record_words(jnp.asarray(rec_b)),
+            jnp.asarray(rec_l),
+            impl="xla",
+        )
+    )
+    assert (got == want).all()
+
+
+def test_engine_cache_invariant_under_churn():
+    """Engine-level incremental recompute: through a kill -> suspect ->
+    faulty -> revive lifecycle, the fused engine's record cache must
+    equal a dense re-encode of the live (known, status, inc) state after
+    EVERY tick (i.e. every changed cell was re-encoded, every untouched
+    cell kept its bytes), and its checksums must match an unfused twin
+    run bitwise."""
+    from ringpop_tpu.models.sim import engine
+    from ringpop_tpu.models.sim.cluster import SimCluster
+
+    # shared params with tests/models/test_churn_window.py so the
+    # lru-cached compiled ticks are reused across the two files (tier-1
+    # runs them in one process; a second compile set costs ~30 s)
+    from tests.models.test_churn_window import _fused_params
+
+    n = 16
+    fused = SimCluster(n=n, params=_fused_params(n))
+    plain = SimCluster(
+        n=n,
+        params=fused.params._replace(
+            fused_checksum="off", parity_recompute="gated"
+        ),
+    )
+    kill = np.zeros(n, bool)
+    kill[5] = True
+    revive = np.zeros(n, bool)
+    revive[5] = True
+    sched = (
+        [{"join": np.ones(n, bool)}]
+        + [{}] * 4
+        + [{"kill": kill}]
+        + [{}] * 10  # suspicion_ticks=6: faulty escalates in-window
+        + [{"revive": revive}]
+        + [{}] * 6
+    )
+    for t, ev in enumerate(sched):
+        inputs = engine.TickInputs.quiet(n)._replace(
+            **{k: jnp.asarray(v) for k, v in ev.items()}
+        )
+        fused.step(inputs)
+        plain.step(inputs)
+        assert (fused.checksums() == plain.checksums()).all(), t
+        dense_b, dense_l = fc.member_records(
+            fused.universe,
+            fused.state.known,
+            fused.state.status,
+            engine.stamp_to_ms(fused.state.inc, fused.params),
+            fused.params.max_digits,
+        )
+        assert (
+            np.asarray(fused.state.rec_bytes) == np.asarray(dense_b)
+        ).all(), t
+        assert (
+            np.asarray(fused.state.rec_len) == np.asarray(dense_l)
+        ).all(), t
