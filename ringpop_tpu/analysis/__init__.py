@@ -1,0 +1,19 @@
+"""jaxgate: repo-native static analysis for the device path.
+
+Two prongs (see ISSUE 3 / README "Static analysis"):
+
+- :mod:`ringpop_tpu.analysis.astlint` — syntax rules over ``ringpop_tpu/``
+  (tick purity, dtype discipline, host-sync hygiene).
+- :mod:`ringpop_tpu.analysis.jaxpr_audit` — traced-graph audit of the real
+  entry points (callback-free scanned tick, uint32 hash-dataflow taint).
+- :mod:`ringpop_tpu.analysis.retrace` — compile-count probes against the
+  committed ``ANALYSIS_BUDGET.json`` manifest.
+
+CLI: ``python -m ringpop_tpu.analysis`` (see ``--help``).
+"""
+
+from ringpop_tpu.analysis.findings import (  # noqa: F401
+    Finding,
+    render_json,
+    render_text,
+)

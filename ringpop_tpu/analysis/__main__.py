@@ -1,0 +1,192 @@
+"""jaxgate CLI: ``python -m ringpop_tpu.analysis``.
+
+Runs the AST lint (prong B) and the jaxpr auditor (prong A) over the
+repo and exits non-zero on any unsuppressed finding.  The retrace-budget
+prong compiles real entry points and is opt-in (``--prong all`` or
+``--prong retrace``); CI runs it via ``scripts/check_retrace_budget.py``.
+
+Examples::
+
+    python -m ringpop_tpu.analysis                       # lint + jaxpr audit
+    python -m ringpop_tpu.analysis --format json
+    python -m ringpop_tpu.analysis --prong ast ringpop_tpu/ops/native.py
+    python -m ringpop_tpu.analysis --changed-only        # pre-commit speed
+    python -m ringpop_tpu.analysis --list-rules
+"""
+
+from __future__ import annotations
+
+import argparse
+import subprocess
+import sys
+from pathlib import Path
+from typing import List, Optional
+
+from ringpop_tpu.analysis import astlint, findings as fmod
+
+PKG_ROOT = Path(__file__).resolve().parents[1]  # .../ringpop_tpu
+REPO_ROOT = PKG_ROOT.parent
+
+# jaxpr-audited modules: a scoped run skips the (slower) trace prong
+# unless one of these is in scope.  Derived from the jit-root registry so
+# a newly registered entry module is automatically covered; gating.py is
+# traced through both engines' phase wrappers without being a root itself.
+_JAXPR_SOURCES = tuple(astlint.TRACED_ENTRIES) + ("models/sim/gating.py",)
+
+
+def _changed_files() -> List[Path]:
+    out: set = set()
+    for cmd in (
+        ["git", "diff", "--name-only"],
+        ["git", "diff", "--name-only", "--cached"],
+        # brand-new files the developer has not staged yet
+        ["git", "ls-files", "--others", "--exclude-standard"],
+    ):
+        try:
+            proc = subprocess.run(
+                cmd,
+                cwd=REPO_ROOT,
+                capture_output=True,
+                text=True,
+                check=True,
+            )
+        except (subprocess.CalledProcessError, FileNotFoundError):
+            continue
+        out.update(line.strip() for line in proc.stdout.splitlines())
+    return [
+        REPO_ROOT / f
+        for f in sorted(out)
+        if f.endswith(".py")
+        and f.startswith("ringpop_tpu/")
+        and (REPO_ROOT / f).exists()
+    ]
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m ringpop_tpu.analysis",
+        description="jaxgate: jaxpr auditor + AST lint for ringpop-tpu",
+    )
+    parser.add_argument(
+        "paths",
+        nargs="*",
+        help="files/dirs to lint (default: the ringpop_tpu package)",
+    )
+    parser.add_argument(
+        "--format", choices=("text", "json"), default="text"
+    )
+    parser.add_argument(
+        "--prong",
+        default="ast,jaxpr",
+        help=(
+            "comma list of prongs to run: ast, jaxpr, retrace "
+            "(or 'all'; default ast,jaxpr)"
+        ),
+    )
+    parser.add_argument(
+        "--changed-only",
+        action="store_true",
+        help="lint only files named by git diff --name-only (+ --cached)",
+    )
+    parser.add_argument(
+        "--budget",
+        default=None,
+        help="retrace manifest path (default: ANALYSIS_BUDGET.json at repo root)",
+    )
+    parser.add_argument(
+        "--list-rules", action="store_true", help="print the rule table"
+    )
+    args = parser.parse_args(argv)
+
+    if args.list_rules:
+        for rule in astlint.ALL_RULES:
+            print(f"{rule.name:20s} [{rule.scope}]")
+            print(f"    {rule.summary}")
+        print(
+            "\njaxpr prong: callback-primitive, wide-dtype-on-hash-path, "
+            "trace-failure\nretrace prong: retrace-budget"
+        )
+        print(
+            "\nsuppress per line with  # jaxgate: ignore[rule-a,rule-b]  "
+            "(bare 'ignore' silences all);\nmark a trace-time host helper "
+            "with  # jaxgate: host  on its def line"
+        )
+        return 0
+
+    prongs = (
+        {"ast", "jaxpr", "retrace"}
+        if args.prong.strip() == "all"
+        else {p.strip() for p in args.prong.split(",") if p.strip()}
+    )
+    unknown = prongs - {"ast", "jaxpr", "retrace"}
+    if unknown:
+        parser.error(f"unknown prong(s): {sorted(unknown)}")
+
+    all_findings: List[fmod.Finding] = []
+
+    files: Optional[List[Path]] = None
+    if args.changed_only:
+        files = _changed_files()
+    if args.paths:
+        explicit: List[Path] = []
+        for p in args.paths:
+            path = Path(p)
+            if not path.exists() and not path.is_absolute():
+                # repo-relative paths must work from any cwd (pre-commit
+                # hooks run wherever they please)
+                anchored = REPO_ROOT / p
+                if anchored.exists():
+                    path = anchored
+            if path.is_dir():
+                explicit.extend(
+                    sorted(
+                        f
+                        for f in path.rglob("*.py")
+                        if "__pycache__" not in f.parts
+                    )
+                )
+            else:
+                explicit.append(path)
+        if files is None:
+            files = explicit
+        else:
+            explicit_set = {e.resolve() for e in explicit}
+            files = [f for f in files if f.resolve() in explicit_set]
+
+    if "ast" in prongs:
+        all_findings.extend(astlint.lint_paths(PKG_ROOT, files=files))
+
+    if "jaxpr" in prongs:
+        run_jaxpr = True
+        if files is not None:
+            # a scoped run (--changed-only or explicit paths) only pays
+            # for the multi-second entry-point traces when a file the
+            # jaxpr prong actually covers is in scope
+            scoped_rel = {
+                f.resolve().relative_to(PKG_ROOT).as_posix()
+                for f in files
+                if f.resolve().is_relative_to(PKG_ROOT)
+            }
+            run_jaxpr = any(
+                src in scoped_rel for src in _JAXPR_SOURCES
+            )
+        if run_jaxpr:
+            from ringpop_tpu.analysis import jaxpr_audit
+
+            all_findings.extend(jaxpr_audit.audit_entries())
+
+    if "retrace" in prongs:
+        from ringpop_tpu.analysis import retrace
+
+        path = Path(args.budget) if args.budget else None
+        all_findings.extend(retrace.check_against_manifest(path=path))
+
+    if args.format == "json":
+        print(fmod.render_json(all_findings))
+    else:
+        print(fmod.render_text(all_findings))
+    return 1 if all_findings else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

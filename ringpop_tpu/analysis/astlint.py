@@ -1,0 +1,759 @@
+"""jaxgate prong B: AST lint over ``ringpop_tpu/``.
+
+The rules encode the repo's device-path conventions as syntax checks:
+
+==================  =====================================================
+rule                invariant
+==================  =====================================================
+host-coerce         no ``float()/int()/bool()/.item()`` on traced values
+                    inside jit contexts (host sync / TracerConversion)
+np-on-traced        no ``np.asarray/np.prod/np.sum/...`` on traced values
+                    inside jit contexts (silent device->host transfer)
+implicit-dtype      ``jnp.array/zeros/ones/full/empty/arange`` in ``ops/``
+                    and ``models/sim/`` must pass an explicit dtype (the
+                    x64-flag-dependent default breaks uint32 discipline)
+py-random-time      no ``random``/``time``/``np.random`` calls inside jit
+                    contexts (trace-time nondeterminism baked into the
+                    compiled program)
+mutable-default     no mutable / array-valued default arguments
+block-until-ready   ``block_until_ready`` only in obs (device sync in
+                    library code serializes the dispatch pipeline)
+callback-in-device  no ``io_callback/pure_callback/debug_callback`` or
+                    ``jax.debug.print`` in device modules (the scanned
+                    tick must stay gate-equivalence-safe)
+assert-on-traced    no ``assert`` over traced values inside jit contexts
+                    (trace-time only; raises on a concrete tracer)
+==================  =====================================================
+
+Jit contexts — where the traced-value rules apply — are inferred per
+module: functions decorated with / passed to ``jax.jit`` or ``jax.lax``
+control flow, functions named in :data:`TRACED_ENTRIES` (entry points
+jitted from *other* modules), every ``def`` nested inside a jit context,
+and (to a fixpoint) every module-level function called by name from one.
+A ``# jaxgate: host`` comment on the ``def`` line opts a trace-time host
+helper out (e.g. a static-table builder invoked during tracing).
+
+Traced values are approximated by local taint: function parameters and
+``jnp``/``lax`` call results, propagated through assignments.
+"""
+
+from __future__ import annotations
+
+import ast
+from pathlib import Path
+from typing import Dict, Iterable, Iterator, List, Optional, Set, Tuple
+
+from ringpop_tpu.analysis import findings as fmod
+from ringpop_tpu.analysis.findings import Finding
+
+# Entry points jitted from other modules (cluster.py, mesh.py, the bench):
+# module suffix -> function names to treat as jit roots.
+TRACED_ENTRIES: Dict[str, Set[str]] = {
+    "models/sim/engine.py": {"tick", "compute_checksums"},
+    "models/sim/engine_scalable.py": {
+        "tick",
+        "compute_checksums",
+        "farmhash_truth_checksum",
+    },
+    "ops/jax_farmhash.py": {"hash32_rows"},
+    "ops/fused_checksum.py": {"membership_checksums", "fused_hash_rows"},
+    "ops/checksum_encode.py": {"membership_rows", "ring_rows"},
+    "ops/pallas_farmhash.py": {
+        "block_loop",
+        "block_loop_nogrid",
+        "fused_stream_nogrid",
+        "fused_stream_xla",
+    },
+    "ops/record_mix.py": {"record_mix"},
+    "models/ring/device.py": {"build_ring", "lookup", "lookup_n"},
+}
+
+# Device modules: code on (or feeding) the compiled path.
+DEVICE_PATHS = ("ops/", "models/sim/", "models/ring/", "parallel/")
+# Paths where implicit-dtype applies (ISSUE: constructors feeding the
+# uint32 hash dataflow and the scanned tick state).
+DTYPE_PATHS = ("ops/", "models/sim/")
+# block_until_ready is legitimate in observability / bench plumbing.
+SYNC_OK_PATHS = ("obs/",)
+
+_JIT_WRAPPERS = {"jit", "pjit", "vmap", "pmap", "shard_map", "named_call"}
+_LAX_CONSUMERS = {
+    "scan",
+    "while_loop",
+    "fori_loop",
+    "cond",
+    "switch",
+    "map",
+    "associative_scan",
+    "custom_root",
+}
+_COERCERS = {"int", "float", "bool", "complex"}
+_NP_HOST_FUNCS = {
+    "asarray",
+    "array",
+    "prod",
+    "sum",
+    "any",
+    "all",
+    "max",
+    "min",
+    "mean",
+}
+# constructors whose DEFAULT dtype depends on the x64 flag / weak-type
+# promotion.  jnp.asarray is deliberately absent: it is the host->device
+# upload idiom and preserves the (concrete) numpy dtype; 64-bit uploads
+# into the hash dataflow are the jaxpr prong's job.
+_DTYPE_CTORS = {"array", "zeros", "ones", "full", "empty", "arange"}
+# positional index at which each constructor accepts dtype
+_DTYPE_POS = {
+    "array": 1,
+    "zeros": 1,
+    "ones": 1,
+    "empty": 1,
+    "full": 2,
+    "arange": 3,
+}
+_CALLBACK_NAMES = {"io_callback", "pure_callback", "debug_callback"}
+
+
+def _attr_chain(node: ast.AST) -> Optional[str]:
+    """Dotted name of a Name/Attribute chain ('jax.lax.scan'), else None."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+# attribute reads that yield static (trace-time) metadata, not traced
+# values: names reached only through these do not carry taint
+_STATIC_ATTRS = {"shape", "ndim", "dtype", "size", "aval"}
+
+
+def _names_in(node: ast.AST) -> Set[str]:
+    """Names referenced by ``node``, excluding those reached only through
+    static-metadata attributes (``x.shape[0]`` is host math, not a trace)."""
+    out: Set[str] = set()
+
+    def visit(n: ast.AST) -> None:
+        if isinstance(n, ast.Attribute) and n.attr in _STATIC_ATTRS:
+            return
+        if isinstance(n, ast.Name):
+            out.add(n.id)
+        for child in ast.iter_child_nodes(n):
+            visit(child)
+
+    visit(node)
+    return out
+
+
+def _own_nodes(fn: ast.AST) -> Iterator[ast.AST]:
+    """Walk ``fn``'s body, stopping at nested function boundaries: nested
+    defs are jit contexts of their own and get their own rule pass (one
+    finding per violation, not one per enclosing context)."""
+    stack = list(ast.iter_child_nodes(fn))
+    while stack:
+        node = stack.pop()
+        if isinstance(
+            node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+        ):
+            continue
+        yield node
+        stack.extend(ast.iter_child_nodes(node))
+
+
+class ModuleInfo:
+    """Parsed module + shared analyses consumed by the rules."""
+
+    def __init__(self, path: Path, rel: str, source: str):
+        self.path = path
+        self.rel = rel  # relative to the package root's parent (posix)
+        self.source = source
+        self.tree = ast.parse(source, filename=str(path))
+        self.lines = source.splitlines()
+        self.suppressions = fmod.parse_suppressions(source)
+        self.host_lines = fmod.host_marked_lines(source)
+        self._parents: Dict[ast.AST, ast.AST] = {}
+        for parent in ast.walk(self.tree):
+            for child in ast.iter_child_nodes(parent):
+                self._parents[child] = parent
+        self.functions: List[ast.AST] = [
+            n
+            for n in ast.walk(self.tree)
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda))
+        ]
+        self.jit_contexts: Set[ast.AST] = self._infer_jit_contexts()
+        self._taint_cache: Dict[ast.AST, Set[str]] = {}
+
+    # -- jit-context inference ------------------------------------------
+
+    def _is_host_marked(self, fn: ast.AST) -> bool:
+        return getattr(fn, "lineno", 0) in self.host_lines
+
+    def enclosing_function(self, node: ast.AST) -> Optional[ast.AST]:
+        cur = self._parents.get(node)
+        while cur is not None:
+            if isinstance(
+                cur, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+            ):
+                return cur
+            cur = self._parents.get(cur)
+        return None
+
+    def _decorated_jit(self, fn: ast.AST) -> bool:
+        for dec in getattr(fn, "decorator_list", []):
+            target = dec.func if isinstance(dec, ast.Call) else dec
+            chain = _attr_chain(target) or ""
+            leaf = chain.rsplit(".", 1)[-1]
+            if leaf in _JIT_WRAPPERS:
+                return True
+            if leaf == "partial" and isinstance(dec, ast.Call) and dec.args:
+                inner = _attr_chain(dec.args[0]) or ""
+                if inner.rsplit(".", 1)[-1] in _JIT_WRAPPERS:
+                    return True
+        return False
+
+    def _infer_jit_contexts(self) -> Set[ast.AST]:
+        by_name: Dict[str, List[ast.AST]] = {}
+        module_level: Dict[str, ast.AST] = {}
+        for fn in self.functions:
+            name = getattr(fn, "name", None)
+            if name:
+                by_name.setdefault(name, []).append(fn)
+                if isinstance(self._parents.get(fn), ast.Module):
+                    module_level[name] = fn
+
+        roots: Set[ast.AST] = set()
+        # 1. decorator-jitted
+        for fn in self.functions:
+            if self._decorated_jit(fn):
+                roots.add(fn)
+        # 2. configured cross-module entry points
+        for suffix, names in TRACED_ENTRIES.items():
+            if self.rel.endswith(suffix):
+                for name in names:
+                    roots.update(by_name.get(name, []))
+        # 3. function names passed to jax.jit / lax control flow
+        for call in ast.walk(self.tree):
+            if not isinstance(call, ast.Call):
+                continue
+            chain = _attr_chain(call.func) or ""
+            leaf = chain.rsplit(".", 1)[-1]
+            if leaf not in (_JIT_WRAPPERS | _LAX_CONSUMERS):
+                continue
+            for arg in list(call.args) + [k.value for k in call.keywords]:
+                if isinstance(arg, ast.Lambda):
+                    roots.add(arg)
+                elif isinstance(arg, ast.Name) and arg.id in by_name:
+                    roots.update(by_name[arg.id])
+
+        roots = {fn for fn in roots if not self._is_host_marked(fn)}
+
+        # 4. fixpoint: nested defs + module functions called from a context
+        contexts = set(roots)
+        changed = True
+        while changed:
+            changed = False
+            for fn in self.functions:
+                if fn in contexts or self._is_host_marked(fn):
+                    continue
+                enc = self.enclosing_function(fn)
+                if enc is not None and enc in contexts:
+                    contexts.add(fn)
+                    changed = True
+            for fn in list(contexts):
+                for call in ast.walk(fn):
+                    if isinstance(call, ast.Call) and isinstance(
+                        call.func, ast.Name
+                    ):
+                        callee = module_level.get(call.func.id)
+                        if (
+                            callee is not None
+                            and callee not in contexts
+                            and not self._is_host_marked(callee)
+                        ):
+                            contexts.add(callee)
+                            changed = True
+        return contexts
+
+    # -- traced-name taint ----------------------------------------------
+
+    def traced_names(self, fn: ast.AST) -> Set[str]:
+        """Names in ``fn`` that (approximately) hold traced values:
+        parameters plus jnp/lax-derived assignments, to a fixpoint."""
+        cached = self._taint_cache.get(fn)
+        if cached is not None:
+            return cached
+        taint: Set[str] = set()
+        args = fn.args
+        for a in (
+            list(args.posonlyargs)
+            + list(args.args)
+            + list(args.kwonlyargs)
+            + ([args.vararg] if args.vararg else [])
+            + ([args.kwarg] if args.kwarg else [])
+        ):
+            if a.arg not in ("self", "cls"):
+                taint.add(a.arg)
+
+        def rhs_tainted(expr: ast.AST) -> bool:
+            if _names_in(expr) & taint:
+                return True
+            for sub in ast.walk(expr):
+                if isinstance(sub, ast.Call):
+                    chain = _attr_chain(sub.func) or ""
+                    root = chain.split(".", 1)[0]
+                    if root in ("jnp", "lax", "jax"):
+                        return True
+            return False
+
+        def bind_targets(target: ast.AST) -> Iterator[str]:
+            for sub in ast.walk(target):
+                if isinstance(sub, ast.Name):
+                    yield sub.id
+
+        changed = True
+        while changed:
+            changed = False
+            for node in ast.walk(fn):
+                if isinstance(node, ast.Assign) and rhs_tainted(node.value):
+                    for t in node.targets:
+                        for name in bind_targets(t):
+                            if name not in taint:
+                                taint.add(name)
+                                changed = True
+                elif isinstance(node, ast.AugAssign) and rhs_tainted(
+                    node.value
+                ):
+                    for name in bind_targets(node.target):
+                        if name not in taint:
+                            taint.add(name)
+                            changed = True
+                elif isinstance(node, ast.For) and rhs_tainted(node.iter):
+                    for name in bind_targets(node.target):
+                        if name not in taint:
+                            taint.add(name)
+                            changed = True
+        self._taint_cache[fn] = taint
+        return taint
+
+    def scope_taint(self, fn: ast.AST) -> Set[str]:
+        """Traced names visible in ``fn`` including closure captures from
+        enclosing functions (conservatively unioned)."""
+        taint = set(self.traced_names(fn))
+        enc = self.enclosing_function(fn)
+        while enc is not None:
+            taint |= self.traced_names(enc)
+            enc = self.enclosing_function(enc)
+        return taint
+
+    def src(self, node: ast.AST) -> str:
+        line = getattr(node, "lineno", 0)
+        if 1 <= line <= len(self.lines):
+            return self.lines[line - 1].strip()
+        return ""
+
+
+# ---------------------------------------------------------------------------
+# rule framework
+
+
+class Rule:
+    name: str = ""
+    summary: str = ""
+    scope: str = "ringpop_tpu/"  # human-readable scope note
+
+    def applies(self, mod: ModuleInfo) -> bool:
+        return True
+
+    def check(self, mod: ModuleInfo) -> Iterator[Finding]:
+        raise NotImplementedError
+
+    def finding(self, mod: ModuleInfo, node: ast.AST, message: str) -> Finding:
+        return Finding(
+            rule=self.name,
+            path=mod.rel,
+            line=getattr(node, "lineno", 0),
+            message=message,
+            prong="ast",
+            source=mod.src(node),
+            end_line=getattr(node, "end_lineno", 0) or 0,
+        )
+
+
+def _in_device_paths(mod: ModuleInfo, paths: Tuple[str, ...]) -> bool:
+    rel = mod.rel.split("ringpop_tpu/", 1)[-1]
+    return rel.startswith(paths)
+
+
+class HostCoerceRule(Rule):
+    name = "host-coerce"
+    summary = (
+        "float()/int()/bool()/complex()/.item() on a traced value inside a "
+        "jit context forces a host sync (or raises at trace time)"
+    )
+    scope = "jit contexts"
+
+    def check(self, mod: ModuleInfo) -> Iterator[Finding]:
+        for fn in mod.functions:
+            if fn not in mod.jit_contexts:
+                continue
+            taint = mod.scope_taint(fn)
+            for node in _own_nodes(fn):
+                if not isinstance(node, ast.Call):
+                    continue
+                if isinstance(node.func, ast.Name) and node.func.id in _COERCERS:
+                    if node.args and _names_in(node.args[0]) & taint:
+                        yield self.finding(
+                            mod,
+                            node,
+                            f"{node.func.id}() applied to traced value "
+                            f"{sorted(_names_in(node.args[0]) & taint)} — "
+                            "use jnp dtype ops or hoist to the host side",
+                        )
+                elif (
+                    isinstance(node.func, ast.Attribute)
+                    and node.func.attr == "item"
+                    and _names_in(node.func.value) & taint
+                ):
+                    yield self.finding(
+                        mod,
+                        node,
+                        ".item() on traced value forces device->host sync",
+                    )
+
+
+class NpOnTracedRule(Rule):
+    name = "np-on-traced"
+    summary = (
+        "np.asarray/np.prod/np.sum/... on a traced value silently pulls the "
+        "array to host inside a jit context"
+    )
+    scope = "jit contexts"
+
+    def check(self, mod: ModuleInfo) -> Iterator[Finding]:
+        for fn in mod.functions:
+            if fn not in mod.jit_contexts:
+                continue
+            taint = mod.scope_taint(fn)
+            for node in _own_nodes(fn):
+                if not isinstance(node, ast.Call):
+                    continue
+                chain = _attr_chain(node.func) or ""
+                parts = chain.split(".")
+                if (
+                    len(parts) == 2
+                    and parts[0] in ("np", "numpy")
+                    and parts[1] in _NP_HOST_FUNCS
+                ):
+                    hit = set()
+                    for arg in node.args:
+                        hit |= _names_in(arg) & taint
+                    if hit:
+                        yield self.finding(
+                            mod,
+                            node,
+                            f"{chain}() on traced value {sorted(hit)} — use "
+                            f"the jnp twin (or math.* for static shapes)",
+                        )
+
+
+class ImplicitDtypeRule(Rule):
+    name = "implicit-dtype"
+    summary = (
+        "array constructor without an explicit dtype: the default depends "
+        "on the x64 flag and breaks uint32/int32 discipline"
+    )
+    scope = "ops/, models/sim/"
+
+    def applies(self, mod: ModuleInfo) -> bool:
+        return _in_device_paths(mod, DTYPE_PATHS)
+
+    def check(self, mod: ModuleInfo) -> Iterator[Finding]:
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            chain = _attr_chain(node.func) or ""
+            parts = chain.split(".")
+            if len(parts) != 2 or parts[0] != "jnp":
+                continue
+            ctor = parts[1]
+            if ctor not in _DTYPE_CTORS:
+                continue
+            if any(k.arg == "dtype" for k in node.keywords):
+                continue
+            if len(node.args) > _DTYPE_POS[ctor]:
+                continue  # positional dtype
+            yield self.finding(
+                mod,
+                node,
+                f"jnp.{ctor}(...) without explicit dtype",
+            )
+
+
+class PyRandomTimeRule(Rule):
+    name = "py-random-time"
+    summary = (
+        "random/time/np.random calls inside a jit context bake trace-time "
+        "nondeterminism into the compiled program"
+    )
+    scope = "jit contexts"
+
+    _MODULES = ("random", "time", "datetime", "numpy.random")
+
+    def _from_imports(self, mod: ModuleInfo) -> Dict[str, str]:
+        """local alias -> fully qualified origin, for both
+        `from X import Y [as Z]` and `import X as Z` over the
+        nondeterminism-bearing modules."""
+        out: Dict[str, str] = {}
+        for node in ast.walk(mod.tree):
+            if isinstance(node, ast.ImportFrom):
+                if node.module in self._MODULES:
+                    for alias in node.names:
+                        out[alias.asname or alias.name] = (
+                            f"{node.module}.{alias.name}"
+                        )
+            elif isinstance(node, ast.Import):
+                for alias in node.names:
+                    if alias.name in self._MODULES and alias.asname:
+                        out[alias.asname] = alias.name
+        return out
+
+    @staticmethod
+    def _nondeterministic(chain: str) -> bool:
+        leaf = chain.rsplit(".", 1)[-1]
+        if chain.startswith(("random.", "time.", "np.random.", "numpy.random.")):
+            return True
+        # datetime is mostly deterministic constructors; only the clock
+        # reads are trace-time hazards
+        return chain.startswith("datetime.") and leaf in (
+            "now",
+            "utcnow",
+            "today",
+        )
+
+    def check(self, mod: ModuleInfo) -> Iterator[Finding]:
+        aliases = self._from_imports(mod)
+        for fn in mod.functions:
+            if fn not in mod.jit_contexts:
+                continue
+            for node in _own_nodes(fn):
+                if not isinstance(node, ast.Call):
+                    continue
+                chain = _attr_chain(node.func) or ""
+                if not chain:
+                    continue
+                # resolve `from time import time`-style local names back
+                # to their origin module before testing
+                head, _, rest = chain.partition(".")
+                if head in aliases:
+                    chain = aliases[head] + (f".{rest}" if rest else "")
+                if self._nondeterministic(chain):
+                    yield self.finding(
+                        mod,
+                        node,
+                        f"{chain}() inside a jit context is evaluated once "
+                        "at trace time — thread rng state / stamps instead",
+                    )
+
+
+class MutableDefaultRule(Rule):
+    name = "mutable-default"
+    summary = (
+        "mutable or array-valued default argument: one instance is shared "
+        "across calls (and an array default pins a device buffer at import)"
+    )
+    scope = "ringpop_tpu/"
+
+    def check(self, mod: ModuleInfo) -> Iterator[Finding]:
+        for fn in mod.functions:
+            args = fn.args
+            for default in list(args.defaults) + [
+                d for d in args.kw_defaults if d is not None
+            ]:
+                bad = None
+                if isinstance(default, (ast.List, ast.Dict, ast.Set)):
+                    bad = "mutable literal"
+                elif isinstance(default, ast.Call):
+                    chain = _attr_chain(default.func) or ""
+                    root = chain.split(".", 1)[0]
+                    if root in ("np", "numpy", "jnp", "jax"):
+                        bad = f"array constructor {chain}()"
+                    elif chain in ("list", "dict", "set", "bytearray"):
+                        bad = f"{chain}()"
+                if bad:
+                    yield self.finding(
+                        mod,
+                        default,
+                        f"default argument is a {bad} — use None + "
+                        "in-function construction",
+                    )
+
+
+class BlockUntilReadyRule(Rule):
+    name = "block-until-ready"
+    summary = (
+        "block_until_ready in library code serializes the dispatch "
+        "pipeline; only bench/obs code may sync"
+    )
+    scope = "ringpop_tpu/ except obs/"
+
+    def applies(self, mod: ModuleInfo) -> bool:
+        rel = mod.rel.split("ringpop_tpu/", 1)[-1]
+        return not rel.startswith(SYNC_OK_PATHS)
+
+    def check(self, mod: ModuleInfo) -> Iterator[Finding]:
+        for node in ast.walk(mod.tree):
+            if (
+                isinstance(node, ast.Attribute)
+                and node.attr == "block_until_ready"
+            ):
+                yield self.finding(
+                    mod,
+                    node,
+                    "block_until_ready outside bench/obs",
+                )
+
+
+class CallbackInDeviceRule(Rule):
+    name = "callback-in-device"
+    summary = (
+        "host callback primitives in device modules break the "
+        "gate-equivalence-safe scanned tick (and multi-chip SPMD)"
+    )
+    scope = "ops/, models/sim/, models/ring/, parallel/"
+
+    def applies(self, mod: ModuleInfo) -> bool:
+        return _in_device_paths(mod, DEVICE_PATHS)
+
+    def check(self, mod: ModuleInfo) -> Iterator[Finding]:
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            chain = _attr_chain(node.func) or ""
+            leaf = chain.rsplit(".", 1)[-1]
+            if leaf in _CALLBACK_NAMES or chain in (
+                "jax.debug.print",
+                "jax.debug.callback",
+                "debug.print",
+                "debug.callback",
+            ):
+                yield self.finding(
+                    mod,
+                    node,
+                    f"host callback {chain or leaf}() in a device module",
+                )
+
+
+class AssertOnTracedRule(Rule):
+    name = "assert-on-traced"
+    summary = (
+        "assert over a traced value inside a jit context either raises at "
+        "trace time or silently checks nothing per step"
+    )
+    scope = "jit contexts"
+
+    def check(self, mod: ModuleInfo) -> Iterator[Finding]:
+        for fn in mod.functions:
+            if fn not in mod.jit_contexts:
+                continue
+            taint = mod.scope_taint(fn)
+            for node in _own_nodes(fn):
+                if isinstance(node, ast.Assert):
+                    hit = _names_in(node.test) & taint
+                    if hit:
+                        yield self.finding(
+                            mod,
+                            node,
+                            f"assert over traced value {sorted(hit)} — use "
+                            "checkify or a host-side validation path",
+                        )
+
+
+ALL_RULES: List[Rule] = [
+    HostCoerceRule(),
+    NpOnTracedRule(),
+    ImplicitDtypeRule(),
+    PyRandomTimeRule(),
+    MutableDefaultRule(),
+    BlockUntilReadyRule(),
+    CallbackInDeviceRule(),
+    AssertOnTracedRule(),
+]
+
+RULES_BY_NAME: Dict[str, Rule] = {r.name: r for r in ALL_RULES}
+
+
+def lint_source(
+    source: str,
+    rel: str,
+    path: Optional[Path] = None,
+    rules: Optional[Iterable[Rule]] = None,
+    respect_suppressions: bool = True,
+) -> List[Finding]:
+    """Lint one module's source; returns unsuppressed findings."""
+    mod = ModuleInfo(path or Path(rel), rel, source)
+    out: List[Finding] = []
+    for rule in ALL_RULES if rules is None else rules:
+        if not rule.applies(mod):
+            continue
+        for f in rule.check(mod):
+            if respect_suppressions and fmod.is_suppressed(
+                f, mod.suppressions
+            ):
+                continue
+            out.append(f)
+    return out
+
+
+def lint_paths(
+    root: Path,
+    files: Optional[Iterable[Path]] = None,
+    rules: Optional[Iterable[Rule]] = None,
+) -> List[Finding]:
+    """Lint every ``*.py`` under ``root`` (or just ``files``); paths in
+    findings are relative to ``root``'s parent."""
+    base = root.parent
+    targets = (
+        sorted(p for p in root.rglob("*.py") if "__pycache__" not in p.parts)
+        if files is None
+        else [Path(f) for f in files]
+    )
+    explicit = files is not None
+    out: List[Finding] = []
+    for path in targets:
+        try:
+            rel = path.resolve().relative_to(base.resolve()).as_posix()
+        except ValueError:
+            rel = path.as_posix()
+        try:
+            source = path.read_text()
+        except (OSError, UnicodeDecodeError) as e:
+            if explicit:
+                # a typo'd CI/pre-commit target must not read as "clean"
+                out.append(
+                    Finding(
+                        rule="unreadable-file",
+                        path=rel,
+                        line=0,
+                        message=f"could not read explicit lint target: {e}",
+                        prong="ast",
+                    )
+                )
+            continue
+        try:
+            out.extend(lint_source(source, rel, path=path, rules=rules))
+        except SyntaxError as e:
+            out.append(
+                Finding(
+                    rule="syntax-error",
+                    path=rel,
+                    line=e.lineno or 0,
+                    message=f"could not parse: {e.msg}",
+                    prong="ast",
+                )
+            )
+    return out

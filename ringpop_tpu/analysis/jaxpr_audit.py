@@ -1,0 +1,489 @@
+"""jaxgate prong A: ClosedJaxpr audit of the real compiled entry points.
+
+Traces the repo's device entry points at toy shapes (n=8 — tracing only,
+no compile) and walks the resulting jaxprs, recursively through ``pjit`` /
+``scan`` / ``while`` / ``cond`` / ``pallas_call`` sub-jaxprs, asserting:
+
+- **callback-primitive**: zero host-callback primitives
+  (``pure_callback`` / ``io_callback`` / ``debug_callback``) anywhere, and
+  doubly so inside scanned or while bodies — one callback inside the
+  scanned SWIM tick both breaks the multi-chip gate-equivalence contract
+  and serializes the scan on the host.
+- **wide-dtype-on-hash-path**: taint-propagate from the FarmHash mixing
+  constants along uint32 dataflow; any equation consuming a tainted value
+  that produces a floating-point or 64-bit result breaks the mod-2^32
+  arithmetic the bitwise-parity claim rests on.  ``convert_element_type``
+  is deliberately NOT exempt: implicit promotions (a missing-dtype
+  ``jnp.zeros``, an int64 stamp mixed into the hash state) lower to the
+  same primitive as an explicit ``astype``, so the conversion itself is
+  the reportable boundary.
+
+Entry points covered (``default_entries``): the scanned full-fidelity
+tick, the O(N·U) scalable tick, the fused checksum pipeline (both the
+Pallas streaming kernel and its pure-XLA twin), the farmhash block walk
+(scan and Pallas lowerings), and the ring device lookup.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable, Dict, Iterable, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ringpop_tpu.analysis.findings import Finding
+
+# farmhashmk / murmur3 mixing constants — the uint32 taint seeds.  Any
+# equation touching these IS the hash dataflow.
+HASH_CONSTANTS = frozenset(
+    {0xCC9E2D51, 0x1B873593, 0xE6546B64, 0x85EBCA6B, 0xC2B2AE35}
+)
+
+CALLBACK_PRIMITIVES = frozenset(
+    {"pure_callback", "io_callback", "debug_callback", "callback"}
+)
+
+_LOOP_PRIMS = frozenset({"scan", "while"})
+
+
+def _aval_dtype(var):
+    aval = getattr(var, "aval", None)
+    return getattr(aval, "dtype", None)
+
+
+def _is_hash_const_literal(var) -> bool:
+    import jax
+
+    if not isinstance(var, jax.core.Literal):
+        return False
+    val = var.val
+    if isinstance(val, (np.ndarray, np.generic)):
+        if np.ndim(val) != 0:
+            return False
+        val = val.item()
+    if isinstance(val, bool) or not isinstance(val, (int, float)):
+        return False
+    if isinstance(val, float):
+        if not val.is_integer():
+            return False
+        val = int(val)
+    return (val % (1 << 32)) in HASH_CONSTANTS
+
+
+def _sub_jaxprs(eqn) -> List[Tuple[str, object, Optional[List[int]]]]:
+    """(label, ClosedJaxpr-or-Jaxpr, invar-mapping) sub-jaxprs of ``eqn``.
+
+    The mapping gives, for each inner invar position, the index into
+    ``eqn.invars`` that feeds it — or None when the correspondence is not
+    trivially positional (then only constant-seeded taint applies inside).
+    """
+    import jax
+
+    prim = eqn.primitive.name
+    params = eqn.params
+    out: List[Tuple[str, object, Optional[List[int]]]] = []
+
+    def positional(j) -> Optional[List[int]]:
+        n_inner = len(j.jaxpr.invars if hasattr(j, "jaxpr") else j.invars)
+        if n_inner == len(eqn.invars):
+            return list(range(len(eqn.invars)))
+        return None
+
+    if prim in ("pjit", "closed_call", "core_call", "xla_call", "remat"):
+        j = params.get("jaxpr") or params.get("call_jaxpr")
+        if j is not None:
+            out.append((prim, j, positional(j)))
+    elif prim == "scan":
+        j = params["jaxpr"]
+        out.append((prim, j, positional(j)))
+    elif prim == "while":
+        out.append(("while_cond", params["cond_jaxpr"], None))
+        out.append(("while_body", params["body_jaxpr"], None))
+    elif prim == "cond":
+        for k, branch in enumerate(params["branches"]):
+            n_inner = len(branch.jaxpr.invars)
+            mapping = (
+                list(range(1, len(eqn.invars)))
+                if n_inner == len(eqn.invars) - 1
+                else None
+            )
+            out.append((f"cond_branch{k}", branch, mapping))
+    elif prim in ("custom_jvp_call", "custom_vjp_call", "custom_jvp_call_jaxpr"):
+        j = params.get("call_jaxpr") or params.get("fun_jaxpr")
+        if j is not None:
+            out.append((prim, j, positional(j)))
+    else:
+        # generic fallback (pallas_call kernels, checkpoint, ...): find
+        # any jaxpr-valued param and audit it with constant-only seeding
+        for key, val in params.items():
+            if isinstance(val, jax.core.ClosedJaxpr) or isinstance(
+                val, jax.core.Jaxpr
+            ):
+                out.append((f"{prim}.{key}", val, None))
+            elif isinstance(val, (tuple, list)):
+                for k, item in enumerate(val):
+                    if isinstance(
+                        item, (jax.core.ClosedJaxpr, jax.core.Jaxpr)
+                    ):
+                        out.append((f"{prim}.{key}[{k}]", item, None))
+    return out
+
+
+def _audit_jaxpr(
+    jaxpr,
+    consts: Sequence,
+    entry: str,
+    stack: Tuple[str, ...],
+    tainted_invars: Sequence[bool],
+    findings: List[Finding],
+) -> List[bool]:
+    """Walk one (open) jaxpr; returns per-outvar taint flags."""
+    import jax
+
+    taint = set()
+    for var, is_t in zip(jaxpr.invars, tainted_invars):
+        if is_t:
+            taint.add(var)
+    for var, const in zip(jaxpr.constvars, consts):
+        val = const
+        if isinstance(val, (np.ndarray, np.generic)) and np.ndim(val) == 0:
+            v = val.item()
+            if (
+                isinstance(v, int)
+                and not isinstance(v, bool)
+                and (v % (1 << 32)) in HASH_CONSTANTS
+            ):
+                taint.add(var)
+
+    def var_tainted(v) -> bool:
+        if isinstance(v, jax.core.Literal):
+            return _is_hash_const_literal(v)
+        return v in taint
+
+    loc = "/".join(stack) or "<top>"
+    for eqn in jaxpr.eqns:
+        prim = eqn.primitive.name
+        # matches every known callback primitive (CALLBACK_PRIMITIVES)
+        # plus any future *_callback variant
+        if "callback" in prim:
+            in_loop = any(p in _LOOP_PRIMS or p.startswith("while") for p in stack)
+            where = (
+                "inside a scanned/while body — breaks the "
+                "gate-equivalence-safe tick contract"
+                if in_loop
+                else "in the compiled entry graph"
+            )
+            findings.append(
+                Finding(
+                    rule="callback-primitive",
+                    path=f"<entry:{entry}>",
+                    line=0,
+                    message=f"host callback '{prim}' at {loc} {where}",
+                    prong="jaxpr",
+                )
+            )
+
+        in_tainted = [var_tainted(v) for v in eqn.invars]
+        subs = _sub_jaxprs(eqn)
+        sub_out_taint: List[List[bool]] = []
+        for label, sub, mapping in subs:
+            closed = isinstance(sub, jax.core.ClosedJaxpr)
+            inner = sub.jaxpr if closed else sub
+            inner_consts = sub.consts if closed else ()
+            n_inner = len(inner.invars)
+            if mapping is not None:
+                inner_taint = [
+                    in_tainted[mapping[i]] if i < len(mapping) else False
+                    for i in range(n_inner)
+                ]
+            else:
+                inner_taint = [False] * n_inner
+            sub_out_taint.append(
+                _audit_jaxpr(
+                    inner,
+                    inner_consts,
+                    entry,
+                    stack + (label,),
+                    inner_taint,
+                    findings,
+                )
+            )
+
+        any_tainted_in = any(in_tainted)
+        # map taint out of sub-jaxprs.  Positionally where the layouts
+        # line up; otherwise (pallas_call kernels, while loops)
+        # conservatively: if ANY inner value on the hash dataflow reaches
+        # the sub-jaxpr's outputs, every output of the equation is
+        # treated as tainted — dropping taint at the boundary would let
+        # e.g. a Pallas-produced checksum be widened downstream unseen
+        out_taint_from_subs = [False] * len(eqn.outvars)
+        for (label, sub, mapping), ot in zip(subs, sub_out_taint):
+            if mapping is not None:
+                for i, flag in enumerate(ot[: len(eqn.outvars)]):
+                    out_taint_from_subs[i] = out_taint_from_subs[i] or flag
+            elif any(ot) or any_tainted_in:
+                # unmapped boundary (while, pallas_call): taint born
+                # inside the body OR entering it from outside can reach
+                # any output — treat them all as tainted
+                out_taint_from_subs = [True] * len(eqn.outvars)
+
+        for i, ov in enumerate(eqn.outvars):
+            dt = _aval_dtype(ov)
+            if dt is None:
+                continue
+            propagate = out_taint_from_subs[i] or (
+                any_tainted_in and not subs
+            )
+            if not propagate:
+                continue
+            kind = None
+            if np.issubdtype(dt, np.floating):
+                kind = f"floating ({dt})"
+            elif dt in (np.dtype(np.int64), np.dtype(np.uint64)):
+                # convert_element_type is NOT exempt: implicit promotions
+                # lower to the same primitive as explicit astype, so an
+                # exemption here would make this arm unreachable
+                kind = f"64-bit ({dt})"
+            if kind is not None:
+                findings.append(
+                    Finding(
+                        rule="wide-dtype-on-hash-path",
+                        path=f"<entry:{entry}>",
+                        line=0,
+                        message=(
+                            f"'{prim}' at {loc} produces a {kind} value "
+                            "from the uint32 hash dataflow — an implicit "
+                            "promotion breaks mod-2^32 parity"
+                        ),
+                        prong="jaxpr",
+                    )
+                )
+            elif dt in (np.dtype(np.uint32), np.dtype(np.int32)):
+                # int32 is a bit-preserving hop for mod-2^32 values —
+                # dropping taint there would launder the dataflow one
+                # eqn before a float widening
+                taint.add(ov)
+
+    return [var_tainted(v) for v in jaxpr.outvars]
+
+
+def audit_fn(
+    name: str, fn: Callable, args: Tuple
+) -> List[Finding]:
+    """Trace ``fn(*args)`` and audit the resulting ClosedJaxpr."""
+    import jax
+
+    findings: List[Finding] = []
+    try:
+        closed = jax.make_jaxpr(fn)(*args)
+    except Exception as e:  # a broken entry point is itself a finding
+        findings.append(
+            Finding(
+                rule="trace-failure",
+                path=f"<entry:{name}>",
+                line=0,
+                message=f"entry point failed to trace: {type(e).__name__}: {e}",
+                prong="jaxpr",
+            )
+        )
+        return findings
+    _audit_jaxpr(
+        closed.jaxpr,
+        closed.consts,
+        name,
+        (),
+        [False] * len(closed.jaxpr.invars),
+        findings,
+    )
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# entry-point registry
+
+
+@dataclasses.dataclass(frozen=True)
+class EntryPoint:
+    name: str
+    build: Callable[[], Tuple[Callable, Tuple]]  # () -> (fn, args)
+
+
+def _toy_universe(n: int = 8):
+    from ringpop_tpu.ops import checksum_encode as ce
+
+    return ce.Universe.from_addresses(
+        [f"10.0.0.{i}:3000" for i in range(n)]
+    )
+
+
+def _sim_setup(n: int = 8):
+    import jax
+
+    from ringpop_tpu.models.sim import engine
+
+    universe = _toy_universe(n)
+    params = engine.SimParams(n=n, hash_impl="scan")
+    params = engine.resolve_auto_parity(params, jax.default_backend())
+    state = engine.init_state(params, seed=0, universe=universe)
+    return engine, params, universe, state
+
+
+def _entry_engine_tick_scan() -> Tuple[Callable, Tuple]:
+    import jax
+    import jax.numpy as jnp
+
+    engine, params, universe, state = _sim_setup(8)
+    n, t = 8, 2
+    inputs = engine.TickInputs(
+        kill=jnp.zeros((t, n), bool),
+        revive=jnp.zeros((t, n), bool),
+        join=jnp.zeros((t, n), bool),
+        partition=jnp.full((t, n), -1, jnp.int32),
+    )
+
+    def scanned(state, inputs):
+        def body(st, inp):
+            return engine.tick(st, inp, params, universe)
+
+        return jax.lax.scan(body, state, inputs)
+
+    return scanned, (state, inputs)
+
+
+def _entry_engine_scalable_tick() -> Tuple[Callable, Tuple]:
+    from ringpop_tpu.models.sim import engine_scalable as es
+
+    params = es.ScalableParams(n=8, u=128)
+    state = es.init_state(params, seed=0)
+    inputs = es.ChurnInputs.quiet(8)
+
+    def one(state, inputs):
+        return es.tick(state, inputs, params)
+
+    return one, (state, inputs)
+
+
+def _fused_args(n: int = 8, b: int = 4, seed: int = 0):
+    import jax.numpy as jnp
+    import numpy as np
+
+    universe = _toy_universe(n)
+    rng = np.random.default_rng(seed)
+    present = jnp.asarray(rng.random((b, n)) < 0.8)
+    status = jnp.asarray(rng.integers(0, 4, size=(b, n)), dtype=jnp.int32)
+    # int32 epoch stamps: x64 stays off in tests, so int64 ms values
+    # would silently truncate anyway — digit-count coverage is identical
+    inc = jnp.asarray(
+        rng.integers(1, 2**31 - 1, size=(b, n)), dtype=jnp.int32
+    )
+    return universe, present, status, inc
+
+
+def _entry_fused_checksum(impl: str) -> Tuple[Callable, Tuple]:
+    from ringpop_tpu.ops import fused_checksum as fc
+
+    universe, present, status, inc = _fused_args()
+
+    def fused(present, status, inc):
+        return fc.membership_checksums(
+            universe, present, status, inc, impl=impl
+        )
+
+    return fused, (present, status, inc)
+
+
+def _farmhash_args(b: int = 8, width: int = 64):
+    import jax.numpy as jnp
+    import numpy as np
+
+    rng = np.random.default_rng(1)
+    mat = jnp.asarray(
+        rng.integers(0, 256, size=(b, width)), dtype=jnp.uint8
+    )
+    lens = jnp.asarray(
+        rng.integers(0, width - 4, size=(b,)), dtype=jnp.int32
+    )
+    return mat, lens
+
+
+def _entry_farmhash(impl: str) -> Tuple[Callable, Tuple]:
+    from ringpop_tpu.ops import jax_farmhash as jfh
+
+    mat, lens = _farmhash_args()
+
+    def hash_rows(mat, lens):
+        return jfh.hash32_rows(mat, lens, impl=impl)
+
+    return hash_rows, (mat, lens)
+
+
+def _ring_fn() -> Callable:
+    """build_ring + lookup + lookup_n composition — the single
+    definition shared by the jaxpr entry and the retrace probe."""
+    from ringpop_tpu.models.ring import device
+
+    def ring_lookup(table, mask, key_hash):
+        ring = device.build_ring(table, mask)
+        n_points = device.ring_size(mask, table.shape[1])
+        one = device.lookup(ring, n_points, key_hash)
+        many = device.lookup_n(ring, n_points, key_hash, 3)
+        return one, many
+
+    return ring_lookup
+
+
+def _ring_args(n: int = 8, seed: int = 2) -> Tuple:
+    import jax.numpy as jnp
+    import numpy as np
+
+    rng = np.random.default_rng(seed)
+    table = jnp.asarray(
+        rng.integers(0, 2**32, size=(n, 100), dtype=np.uint32)
+    )
+    mask = jnp.asarray(rng.random(n) < 0.75)
+    key_hash = jnp.uint32(rng.integers(0, 2**32))
+    return table, mask, key_hash
+
+
+def _entry_ring_device() -> Tuple[Callable, Tuple]:
+    return _ring_fn(), _ring_args()
+
+
+DEFAULT_ENTRIES: List[EntryPoint] = [
+    EntryPoint("engine-tick-scan", _entry_engine_tick_scan),
+    EntryPoint("engine-scalable-tick", _entry_engine_scalable_tick),
+    EntryPoint("fused-checksum-xla", lambda: _entry_fused_checksum("xla")),
+    EntryPoint(
+        "fused-checksum-pallas", lambda: _entry_fused_checksum("pallas")
+    ),
+    EntryPoint("farmhash-scan", lambda: _entry_farmhash("scan")),
+    EntryPoint(
+        "farmhash-pallas-nogrid",
+        lambda: _entry_farmhash("pallas_nogrid"),
+    ),
+    EntryPoint("ring-device-lookup", _entry_ring_device),
+]
+
+
+def audit_entries(
+    entries: Optional[Iterable[EntryPoint]] = None,
+) -> List[Finding]:
+    out: List[Finding] = []
+    for ep in DEFAULT_ENTRIES if entries is None else entries:
+        try:
+            fn, args = ep.build()
+        except Exception as e:
+            out.append(
+                Finding(
+                    rule="trace-failure",
+                    path=f"<entry:{ep.name}>",
+                    line=0,
+                    message=(
+                        f"entry point setup failed: {type(e).__name__}: {e}"
+                    ),
+                    prong="jaxpr",
+                )
+            )
+            continue
+        out.extend(audit_fn(ep.name, fn, args))
+    return out

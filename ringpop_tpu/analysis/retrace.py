@@ -1,0 +1,333 @@
+"""jaxgate prong A': retrace-budget probes against a committed manifest.
+
+A silent retrace on the parity hot path costs seconds per occurrence on
+the chip tunnel and usually signals a shape- or structure-dependent bug
+(a Python branch on a traced value, a pytree whose structure flips
+between calls).  Each probe here builds a FRESH jitted entry point and
+drives it through a fixed call sequence:
+
+1. canonical shape, values A        -> must compile (cache size 1)
+2. same shape, different values     -> must HIT the cache (still 1)
+3. a legitimately different shape / pytree structure -> must MISS (2)
+
+After every step the probe records ``fn._cache_size()``.  The expected
+sequences live in ``ANALYSIS_BUDGET.json`` at the repo root; a mismatch —
+either direction — is a finding.  Extra compiles mean a silent retrace
+crept in; fewer mean the manifest is stale and must be regenerated with
+``scripts/check_retrace_budget.py --write`` (an intentional, reviewed
+change).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+from pathlib import Path
+from typing import Callable, Dict, Iterable, List, Optional, Tuple
+
+from ringpop_tpu.analysis.findings import Finding
+
+MANIFEST_NAME = "ANALYSIS_BUDGET.json"
+
+
+@dataclasses.dataclass(frozen=True)
+class Probe:
+    name: str
+    # () -> (jitted_fn, [(step description, args tuple), ...])
+    build: Callable[[], Tuple[Callable, List[Tuple[str, Tuple]]]]
+
+
+def run_probe(probe: Probe) -> List[dict]:
+    fn, steps = probe.build()
+    out: List[dict] = []
+    for desc, args in steps:
+        fn(*args)
+        out.append({"desc": desc, "cache_size": int(fn._cache_size())})
+    return out
+
+
+def run_probes(probes: Optional[Iterable[Probe]] = None) -> Dict[str, list]:
+    """Run every probe; a probe whose entry point breaks yields a single
+    ``{"error": ...}`` step instead of crashing the tool (the jaxpr
+    prong's trace-failure analog — compare_to_manifest turns it into a
+    finding, write_manifest refuses to commit it)."""
+    out: Dict[str, list] = {}
+    for p in DEFAULT_PROBES if probes is None else probes:
+        try:
+            out[p.name] = run_probe(p)
+        except Exception as e:
+            out[p.name] = [
+                {"error": f"{type(e).__name__}: {e}"}
+            ]
+    return out
+
+
+def compare_to_manifest(
+    actual: Dict[str, list], manifest: dict
+) -> List[Finding]:
+    findings: List[Finding] = []
+    expected = manifest.get("probes", {})
+    for name, exp_steps in sorted(expected.items()):
+        if name not in actual:
+            findings.append(
+                Finding(
+                    rule="retrace-budget",
+                    path=f"<probe:{name}>",
+                    line=0,
+                    message="probe in manifest but not run",
+                    prong="retrace",
+                )
+            )
+            continue
+        act_steps = actual[name]
+        if any("error" in s for s in act_steps):
+            err = next(s["error"] for s in act_steps if "error" in s)
+            findings.append(
+                Finding(
+                    rule="probe-failure",
+                    path=f"<probe:{name}>",
+                    line=0,
+                    message=f"probe failed to run: {err}",
+                    prong="retrace",
+                )
+            )
+            continue
+        if len(act_steps) != len(exp_steps):
+            findings.append(
+                Finding(
+                    rule="retrace-budget",
+                    path=f"<probe:{name}>",
+                    line=0,
+                    message=(
+                        f"step count changed: manifest {len(exp_steps)}, "
+                        f"probe ran {len(act_steps)}"
+                    ),
+                    prong="retrace",
+                )
+            )
+            continue
+        for i, (exp, act) in enumerate(zip(exp_steps, act_steps)):
+            if act["cache_size"] != exp["cache_size"]:
+                direction = (
+                    "silent retrace"
+                    if act["cache_size"] > exp["cache_size"]
+                    else "stale manifest (fewer compiles than committed)"
+                )
+                findings.append(
+                    Finding(
+                        rule="retrace-budget",
+                        path=f"<probe:{name}>",
+                        line=0,
+                        message=(
+                            f"step {i} ({act['desc']}): cache size "
+                            f"{act['cache_size']} != manifest "
+                            f"{exp['cache_size']} — {direction}"
+                        ),
+                        prong="retrace",
+                    )
+                )
+    for name in sorted(set(actual) - set(expected)):
+        errs = [s["error"] for s in actual[name] if "error" in s]
+        findings.append(
+            Finding(
+                rule="probe-failure" if errs else "retrace-budget",
+                path=f"<probe:{name}>",
+                line=0,
+                message=(
+                    f"probe failed to run: {errs[0]}"
+                    if errs
+                    else (
+                        "probe has no manifest entry — regenerate with "
+                        "scripts/check_retrace_budget.py --write"
+                    )
+                ),
+                prong="retrace",
+            )
+        )
+    return findings
+
+
+def manifest_path(root: Optional[Path] = None) -> Path:
+    if root is None:
+        root = Path(__file__).resolve().parents[2]
+    return root / MANIFEST_NAME
+
+
+def load_manifest(path: Optional[Path] = None) -> dict:
+    p = path or manifest_path()
+    with open(p) as f:
+        return json.load(f)
+
+
+def write_manifest(
+    actual: Dict[str, list], path: Optional[Path] = None
+) -> Path:
+    broken = {
+        name: steps[0]["error"]
+        for name, steps in actual.items()
+        if any("error" in s for s in steps)
+    }
+    if broken:
+        raise ValueError(
+            f"refusing to write a manifest with failed probes: {broken}"
+        )
+    p = path or manifest_path()
+    doc = {
+        "version": 1,
+        "note": (
+            "jaxgate retrace budget: expected jit cache sizes after each "
+            "probe step (see ringpop_tpu/analysis/retrace.py).  Regenerate "
+            "with scripts/check_retrace_budget.py --write after an "
+            "INTENTIONAL compile-count change."
+        ),
+        "probes": actual,
+    }
+    with open(p, "w") as f:
+        json.dump(doc, f, indent=2, sort_keys=True)
+        f.write("\n")
+    return p
+
+
+def check_against_manifest(
+    probes: Optional[Iterable[Probe]] = None,
+    path: Optional[Path] = None,
+) -> List[Finding]:
+    try:
+        manifest = load_manifest(path)
+    except FileNotFoundError:
+        return [
+            Finding(
+                rule="retrace-budget",
+                path=MANIFEST_NAME,
+                line=0,
+                message=(
+                    "manifest missing — generate with "
+                    "scripts/check_retrace_budget.py --write"
+                ),
+                prong="retrace",
+            )
+        ]
+    return compare_to_manifest(run_probes(probes), manifest)
+
+
+# ---------------------------------------------------------------------------
+# probes
+
+
+def _probe_farmhash_scan() -> Tuple[Callable, List[Tuple[str, Tuple]]]:
+    import functools
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from ringpop_tpu.ops import jax_farmhash as jfh
+
+    fn = jax.jit(functools.partial(jfh.hash32_rows, impl="scan"))
+
+    def args(b, w, seed):
+        r = np.random.default_rng(seed)
+        return (
+            jnp.asarray(r.integers(0, 256, size=(b, w)), dtype=jnp.uint8),
+            jnp.asarray(r.integers(0, w - 4, size=(b,)), dtype=jnp.int32),
+        )
+
+    return fn, [
+        ("[8,64] values A", args(8, 64, 0)),
+        ("[8,64] values B (expect cache hit)", args(8, 64, 1)),
+        ("[8,128] wider rows (expect recompile)", args(8, 128, 2)),
+    ]
+
+
+def _probe_fused_checksum_xla() -> Tuple[Callable, List[Tuple[str, Tuple]]]:
+    import jax
+
+    from ringpop_tpu.analysis import jaxpr_audit as ja
+    from ringpop_tpu.ops import fused_checksum as fc
+
+    universe = ja._toy_universe(8)
+
+    @jax.jit
+    def fn(present, status, inc):
+        return fc.membership_checksums(
+            universe, present, status, inc, impl="xla"
+        )
+
+    def args(b, seed):
+        # shared generator with the jaxpr entry (universe dropped: it is
+        # closed over by fn, not a call argument)
+        return ja._fused_args(n=8, b=b, seed=seed)[1:]
+
+    return fn, [
+        ("B=2 values A", args(2, 0)),
+        ("B=2 values B (expect cache hit)", args(2, 1)),
+        ("B=4 (expect recompile)", args(4, 2)),
+    ]
+
+
+def _probe_ring_lookup() -> Tuple[Callable, List[Tuple[str, Tuple]]]:
+    import jax
+
+    from ringpop_tpu.analysis import jaxpr_audit as ja
+
+    fn = jax.jit(ja._ring_fn())
+    return fn, [
+        ("N=8 values A", ja._ring_args(8, 0)),
+        ("N=8 values B (expect cache hit)", ja._ring_args(8, 1)),
+        ("N=12 universe (expect recompile)", ja._ring_args(12, 2)),
+    ]
+
+
+def _probe_engine_tick() -> Tuple[Callable, List[Tuple[str, Tuple]]]:
+    import functools
+
+    import jax
+    import jax.numpy as jnp
+
+    from ringpop_tpu.analysis import jaxpr_audit as ja
+
+    engine, params, universe, state = ja._sim_setup(8)
+    fn = jax.jit(
+        functools.partial(engine.tick, params=params, universe=universe)
+    )
+    quiet = engine.TickInputs.quiet(8)
+    churn = quiet._replace(kill=jnp.zeros(8, bool).at[3].set(True))
+    # resume=None -> dense array flips the pytree STRUCTURE: a legitimate,
+    # budgeted recompile (cluster.py EventSchedule keeps unused planes None
+    # for exactly this reason)
+    resumed = quiet._replace(resume=jnp.zeros(8, bool))
+    return fn, [
+        ("n=8 quiet tick", (state, quiet)),
+        ("n=8 churn tick, same structure (expect cache hit)", (state, churn)),
+        ("n=8 resume plane present (expect recompile)", (state, resumed)),
+    ]
+
+
+def _probe_engine_scalable_tick() -> Tuple[Callable, List[Tuple[str, Tuple]]]:
+    import functools
+
+    import jax
+    import jax.numpy as jnp
+
+    from ringpop_tpu.models.sim import engine_scalable as es
+
+    params = es.ScalableParams(n=8, u=128)
+    fn = jax.jit(functools.partial(es.tick, params=params))
+    state = es.init_state(params, seed=0)
+    quiet = es.ChurnInputs.quiet(8)
+    churn = quiet._replace(kill=jnp.zeros(8, bool).at[2].set(True))
+    parted = quiet._replace(partition=jnp.zeros(8, jnp.int32))
+    return fn, [
+        ("n=8 quiet tick", (state, quiet)),
+        ("n=8 churn tick, same structure (expect cache hit)", (state, churn)),
+        ("n=8 partition plane present (expect recompile)", (state, parted)),
+    ]
+
+
+DEFAULT_PROBES: List[Probe] = [
+    Probe("farmhash-scan", _probe_farmhash_scan),
+    Probe("fused-checksum-xla", _probe_fused_checksum_xla),
+    Probe("ring-device-lookup", _probe_ring_lookup),
+    Probe("engine-tick", _probe_engine_tick),
+    Probe("engine-scalable-tick", _probe_engine_scalable_tick),
+]

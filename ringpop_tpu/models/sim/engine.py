@@ -69,6 +69,7 @@ Cited reference behavior preserved exactly:
 
 from __future__ import annotations
 
+import math
 from typing import NamedTuple, Optional
 
 import jax
@@ -331,6 +332,12 @@ def _pack_key(inc, status):
     return inc.astype(jnp.int32) * 4 + status.astype(jnp.int32)
 
 
+def _self_view(mat: jax.Array) -> jax.Array:
+    """Diagonal of an [N, N] per-observer matrix: each node's view of
+    ITSELF (the self-incarnation reads scattered through the tick)."""
+    return jnp.diagonal(mat)
+
+
 def _max_piggyback(server_count: jax.Array, factor: int) -> jax.Array:
     """15 * ceil(log10(n + 1)) via integer digit count (dissemination.js:41)."""
     count = jnp.zeros(server_count.shape, jnp.int32)
@@ -342,7 +349,7 @@ def _max_piggyback(server_count: jax.Array, factor: int) -> jax.Array:
 _COPRIME_CACHE: dict = {}
 
 
-def _coprimes_of(n: int, k: int = 128):
+def _coprimes_of(n: int, k: int = 128):  # jaxgate: host
     """(coprimes, modular inverses): up to ``k`` integers coprime to ``n``,
     spread evenly over [1, n), plus their inverses mod n.
 
@@ -353,8 +360,6 @@ def _coprimes_of(n: int, k: int = 128):
     got = _COPRIME_CACHE.get((n, k))
     if got is None:
         assert n < 46341, "affine reshuffle index math needs n*n < 2^31"
-        import math
-
         cops = [a for a in range(1, n) if math.gcd(a, n) == 1]
         step = max(1, -(-len(cops) // k))  # ceil: even spread over [1, n)
         chosen = cops[::step][:k]
@@ -377,7 +382,7 @@ def _fold(rng: jax.Array, salt: int) -> jax.Array:
 def _uniform(rng: jax.Array, shape, salt: int) -> jax.Array:
     """[N, ...] uniforms in [0, 1) derived per node (row i from rng[i])."""
     n = rng.shape[0]
-    cols = int(np.prod(shape)) // n
+    cols = math.prod(shape) // n
     base = rng[:, 0].astype(jnp.uint32)
     j = jnp.arange(cols, dtype=jnp.uint32)
     x = base[:, None] + j[None, :] * np.uint32(0x01000193) + np.uint32(salt)
@@ -1141,7 +1146,7 @@ def tick(
         (jk, js, ji), _ = jax.lax.scan(
             merge_joins,
             (state.known, state.status, state.inc),
-            jnp.arange(params.join_size),
+            jnp.arange(params.join_size, dtype=jnp.int32),
         )
         joined = joiner & jnp.any(jvalid, axis=1)
         # don't let merged views downgrade the joiner's own liveness
@@ -1162,7 +1167,7 @@ def tick(
             ch_inc=jnp.where(learned, merged_inc, state.ch_inc),
             ch_source=jnp.where(learned, node, state.ch_source),
             ch_source_inc=jnp.where(
-                learned, merged_inc[jnp.arange(n), jnp.arange(n)][:, None], state.ch_source_inc
+                learned, _self_view(merged_inc)[:, None], state.ch_source_inc
             ),
             ch_pb=jnp.where(learned, 0, state.ch_pb),
         )
@@ -1173,11 +1178,11 @@ def tick(
         def scatter_join_alive(k, m):
             tgt = jorder[:, k]
             ok = jvalid[:, k] & joined
-            upd = jnp.zeros((n, n), bool).at[tgt, jnp.arange(n)].set(ok, mode="drop")
+            upd = jnp.zeros((n, n), bool).at[tgt, jnp.arange(n, dtype=jnp.int32)].set(ok, mode="drop")
             return m | upd
 
         ja_mask = jax.lax.fori_loop(0, params.join_size, scatter_join_alive, ja_mask)
-        self_inc = state.inc[jnp.arange(n), jnp.arange(n)]
+        self_inc = _self_view(state.inc)
         state, ja_applied, _, _, _ = _apply_updates(
             state,
             now,
@@ -1231,7 +1236,7 @@ def tick(
     # change's sourceIncarnationNumber against THIS value, not the
     # post-receive one — a sender that refutes a defamation mid-tick bumps
     # its self-incarnation AFTER its ping body was already built
-    sent_self_inc = state.inc[jnp.arange(n), jnp.arange(n)]
+    sent_self_inc = _self_view(state.inc)
 
     # ---- phase 2: target selection (round-robin iterator) -------------
     participating = state.proc_alive & state.ready & state.gossip_on
@@ -1487,7 +1492,7 @@ def tick(
 
     # ---- phase 6: responses (issueAsReceiver + full-sync) -------------
     tgt = jnp.clip(target, 0, n - 1)
-    cur_self_inc = state.inc[jnp.arange(n), jnp.arange(n)]
+    cur_self_inc = _self_view(state.inc)
     # a response can only exist where the target holds respondable changes
     # or its checksum disagrees with the ping body's — cond-gate the row
     # gathers + apply off the converged quiet tick
@@ -1624,7 +1629,7 @@ def tick(
         # the ping-req body's sourceIncarnationNumber is read at BUILD
         # time — after this period's ping/response exchanges (phases 5-6)
         # may have refuted and bumped the sender's self-incarnation
-        pr_self_inc = state.inc[jnp.arange(n), jnp.arange(n)]
+        pr_self_inc = _self_view(state.inc)
 
         # -- leg 1: sender piggyback (issueAsSender per selected slot) --
         # slot k's body holds the changes still active at that call with
@@ -1803,9 +1808,9 @@ def tick(
         # -- suspect verdict, on post-response state (the reference
         # makes the suspect AFTER every ping-req callback applied its
         # changes: ping-req-sender.js:249-262) --
-        sus_mask = jnp.zeros((n, n), bool).at[jnp.arange(n), tgt].set(mark_suspect)
-        sus_inc = state.inc[jnp.arange(n), tgt]  # member's current inc
-        cur_self = state.inc[jnp.arange(n), jnp.arange(n)]
+        sus_mask = jnp.zeros((n, n), bool).at[jnp.arange(n, dtype=jnp.int32), tgt].set(mark_suspect)
+        sus_inc = state.inc[jnp.arange(n, dtype=jnp.int32), tgt]  # member's current inc
+        cur_self = _self_view(state.inc)
         state, applied_sus, started_s, _, _ = _apply_updates(
             state,
             now,

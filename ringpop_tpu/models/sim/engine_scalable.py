@@ -83,6 +83,7 @@ oracle including partitions in tests/parity/).
 
 from __future__ import annotations
 
+import math
 from typing import NamedTuple, Optional
 
 import jax
@@ -213,7 +214,7 @@ class ChurnInputs(NamedTuple):
 
 
 def _rand_u32(key: jax.Array, shape, salt: int) -> jax.Array:
-    size = int(np.prod(shape))
+    size = math.prod(shape)
     i = jnp.arange(size, dtype=jnp.uint32)
     x = key[0] + i * jnp.uint32(0x01000193) + jnp.uint32(salt)
     x ^= key[1] >> 7
@@ -445,7 +446,8 @@ def _bit_delta_sum(
     [C, W, 32] elementwise expansion.  Shared by the full recompute
     (compute_checksums) and the in-tick incremental paths (exchange-diff
     add, retirement adjustment), which feed it different bit masks."""
-    assert u <= 65536, "limb dot exactness needs U*255 < 2^24"
+    # static capacity bound (params.u), checked at trace time
+    assert u <= 65536, "limb dot exactness needs U*255 < 2^24"  # jaxgate: ignore[assert-on-traced]
     limbs = jnp.stack(
         [(r_delta >> (8 * k)) & jnp.uint32(0xFF) for k in range(4)],
         axis=1,

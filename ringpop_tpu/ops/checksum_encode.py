@@ -123,7 +123,7 @@ def _digit_bytes(x: jax.Array, dlen: jax.Array, max_digits: int) -> jax.Array:
     """[..., max_digits] ASCII digits of x, most significant first, left-
     aligned within dlen (positions >= dlen are garbage, masked by caller)."""
     x = x.astype(jnp.int64)
-    k = jnp.arange(max_digits)
+    k = jnp.arange(max_digits, dtype=jnp.int32)
     exp = jnp.clip(dlen[..., None] - 1 - k, 0, MAX_DIGITS - 1)
     pow10 = jnp.asarray(_POW10)[exp]
     digit = (x[..., None] // pow10) % 10
@@ -218,20 +218,20 @@ def membership_rows(
         drop = jnp.int32(width)
 
         # address part: [N, A]
-        ka = jnp.arange(A)
+        ka = jnp.arange(A, dtype=jnp.int32)
         pos_a = offset[:, None] + ka[None, :]
         ok_a = pres[:, None] & (ka[None, :] < addr_len[:, None])
         pos_a = jnp.where(ok_a, pos_a, drop)
 
         # status part: [N, 7]
-        ks = jnp.arange(_STATUS_W)
+        ks = jnp.arange(_STATUS_W, dtype=jnp.int32)
         pos_s = offset[:, None] + addr_len[:, None] + ks[None, :]
         ok_s = pres[:, None] & (ks[None, :] < slen[:, None])
         pos_s = jnp.where(ok_s, pos_s, drop)
         val_s = status_bytes[stat]
 
         # digits part: [N, D]
-        kd = jnp.arange(max_digits)
+        kd = jnp.arange(max_digits, dtype=jnp.int32)
         pos_d = offset[:, None] + addr_len[:, None] + slen[:, None] + kd[None, :]
         ok_d = pres[:, None] & (kd[None, :] < dlen[:, None])
         pos_d = jnp.where(ok_d, pos_d, drop)
@@ -408,7 +408,7 @@ def ring_rows(
         ).astype(jnp.int32)
         drop = jnp.int32(width)
 
-        ka = jnp.arange(A)
+        ka = jnp.arange(A, dtype=jnp.int32)
         pos_a = offset[:, None] + ka[None, :]
         ok_a = pres[:, None] & (ka[None, :] < addr_len[:, None])
         pos_a = jnp.where(ok_a, pos_a, drop)

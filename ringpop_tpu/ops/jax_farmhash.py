@@ -89,7 +89,7 @@ def _mur(a: jax.Array, h: jax.Array) -> jax.Array:
 def _fetch32(mat: jax.Array, off: jax.Array) -> jax.Array:
     """Per-row little-endian 4-byte fetch at (possibly unaligned) offsets."""
     off = jnp.clip(off, 0, mat.shape[1] - 4)
-    idx = off[:, None] + jnp.arange(4)[None, :]
+    idx = off[:, None] + jnp.arange(4, dtype=jnp.int32)[None, :]
     b = jnp.take_along_axis(mat, idx, axis=1).astype(jnp.uint32)
     return b[:, 0] | (b[:, 1] << 8) | (b[:, 2] << 16) | (b[:, 3] << 24)
 

@@ -229,18 +229,64 @@ def pin_cpu_platform(n_devices=None) -> None:
     virtual CPU devices, and the bench falls back to CPU when the TPU
     tunnel stays unavailable through its retries.  Raises if the pin does
     not take (e.g. a live backend blocked the config update).
+
+    Caveat (jax < 0.5): XLA parses XLA_FLAGS once per process at the
+    first client creation, so an in-process re-pin cannot SHRINK an
+    already-created virtual CPU mesh — the ``n_devices=None`` branch
+    still scrubs the env so child processes start at the real default.
     """
     import os
 
     os.environ["JAX_PLATFORMS"] = "cpu"
+    # jax < 0.5 has no jax_num_cpu_devices option; the virtual CPU device
+    # count comes from XLA_FLAGS, read at backend init — which
+    # clear_jax_backends() below forces to happen again.  The dedicated
+    # marker records that a previous pin forced the count, so a later bare
+    # pin scrubs OUR env (and only ours — an ambient count, whether the
+    # test harness's XLA_FLAGS mesh or a user-set JAX_NUM_CPU_DEVICES, is
+    # the caller's business) instead of leaking it to children.
+    marker = "RINGPOP_PINNED_CPU_DEVICES"
+    stash_flag = "RINGPOP_AMBIENT_CPU_DEVICES"  # ambient XLA_FLAGS count
+    stash_env = "RINGPOP_AMBIENT_JAX_NUM_CPU_DEVICES"  # ambient env count
+    prefix = "--xla_force_host_platform_device_count"
+    flags = os.environ.get("XLA_FLAGS", "").split()
+    ambient = next((f for f in flags if f.startswith(prefix)), None)
+    kept = [f for f in flags if not f.startswith(prefix)]
+    update_count = n_devices
     if n_devices is not None:
+        if marker not in os.environ:
+            # first pin in this process: remember the caller's counts so
+            # a later bare pin hands them back instead of dropping them
+            if ambient is not None:
+                os.environ[stash_flag] = ambient.split("=", 1)[1]
+            if "JAX_NUM_CPU_DEVICES" in os.environ:
+                os.environ[stash_env] = os.environ["JAX_NUM_CPU_DEVICES"]
+        os.environ[marker] = str(n_devices)
         os.environ["JAX_NUM_CPU_DEVICES"] = str(n_devices)
+        kept.append(f"{prefix}={n_devices}")
+        os.environ["XLA_FLAGS"] = " ".join(kept)
+    elif os.environ.pop(marker, None) is not None:
+        restored_flag = os.environ.pop(stash_flag, None)
+        restored_env = os.environ.pop(stash_env, None)
+        if restored_flag is not None:
+            kept.append(f"{prefix}={restored_flag}")
+        if restored_env is not None:
+            os.environ["JAX_NUM_CPU_DEVICES"] = restored_env
+        else:
+            os.environ.pop("JAX_NUM_CPU_DEVICES", None)
+        restored = restored_flag or restored_env
+        # -1 is the option's "unset" default on jax >= 0.5
+        update_count = int(restored) if restored else -1
+        os.environ["XLA_FLAGS"] = " ".join(kept)
     clear_jax_backends()
     import jax
 
     jax.config.update("jax_platforms", "cpu")
-    if n_devices is not None:
-        jax.config.update("jax_num_cpu_devices", n_devices)
+    if update_count is not None:
+        try:
+            jax.config.update("jax_num_cpu_devices", update_count)
+        except AttributeError:
+            pass  # jax < 0.5: the XLA_FLAGS path above already took effect
     devs = jax.devices()
     assert devs[0].platform == "cpu", devs
     if n_devices is not None:

@@ -1,0 +1,63 @@
+#!/usr/bin/env python
+"""Diff jit compile counts against the committed retrace manifest.
+
+Runs the jaxgate retrace-budget probes (fresh jitted entry points driven
+through a fixed same-shape / different-value / different-shape call
+sequence — see ringpop_tpu/analysis/retrace.py) and compares the
+observed ``_cache_size()`` sequences to ANALYSIS_BUDGET.json.
+
+Usage::
+
+    python scripts/check_retrace_budget.py          # diff, exit 1 on drift
+    python scripts/check_retrace_budget.py --write  # regenerate manifest
+
+The manifest is backend-portable: it records compile COUNTS, not
+artifacts, so the next chip session can run this unchanged on the TPU
+tunnel and see whether the device build retraces where the CPU build did
+not (and vice versa).
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parents[1]))
+
+from ringpop_tpu.analysis import retrace  # noqa: E402
+from ringpop_tpu.analysis.findings import render_text  # noqa: E402
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument(
+        "--write",
+        action="store_true",
+        help="run the probes and (re)write ANALYSIS_BUDGET.json",
+    )
+    parser.add_argument(
+        "--budget",
+        default=None,
+        help="manifest path (default: ANALYSIS_BUDGET.json at repo root)",
+    )
+    args = parser.parse_args(argv)
+    path = Path(args.budget) if args.budget else None
+
+    if args.write:
+        actual = retrace.run_probes()
+        out = retrace.write_manifest(actual, path)
+        total = sum(steps[-1]["cache_size"] for steps in actual.values())
+        print(
+            f"wrote {out} ({len(actual)} probes, "
+            f"{total} budgeted compiles)"
+        )
+        return 0
+
+    findings = retrace.check_against_manifest(path=path)
+    print(render_text(findings))
+    return 1 if findings else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,10 @@
+"""BAD: asserting over traced values inside a jitted function."""
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def step(x):
+    total = jnp.sum(x)
+    assert total > 0  # finding: assert-on-traced
+    return total

@@ -1,0 +1,7 @@
+"""BAD: device sync in library code."""
+
+
+def run(fn, x):
+    out = fn(x)
+    out.block_until_ready()  # finding: block-until-ready
+    return out

@@ -1,0 +1,5 @@
+"""GOOD: no sync; callers (bench/obs) decide when to block."""
+
+
+def run(fn, x):
+    return fn(x)

@@ -1,0 +1,14 @@
+"""BAD: host callbacks in a device module."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def step(x):
+    jax.debug.print("x = {}", x)  # finding: callback-in-device
+    y = jax.pure_callback(  # finding: callback-in-device
+        lambda v: np.asarray(v) + 1,
+        jax.ShapeDtypeStruct(x.shape, x.dtype),
+        x,
+    )
+    return jnp.sum(y)

@@ -1,0 +1,12 @@
+"""BAD: host coercions on traced values inside a jitted function."""
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def step(x):
+    scale = int(x[0])  # finding: host-coerce
+    val = float(jnp.sum(x))  # finding: host-coerce
+    flag = bool(x.any())  # finding: host-coerce
+    first = x[0].item()  # finding: host-coerce
+    return scale + val + flag + first

@@ -1,0 +1,15 @@
+"""GOOD: static host math + device-side dtype ops only."""
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def step(x):
+    rows = int(x.shape[0])  # static metadata — not a traced value
+    total = jnp.sum(x).astype(jnp.int32)
+    return total + rows
+
+
+def host_side(values):
+    # not a jit context: coercion is fine
+    return int(values[0])

@@ -1,0 +1,10 @@
+"""BAD: flag-dependent default dtypes in a device module."""
+import jax.numpy as jnp
+
+
+def build(n):
+    idx = jnp.arange(n)  # finding: implicit-dtype
+    acc = jnp.zeros(n)  # finding: implicit-dtype
+    pad = jnp.full((n, 2), 9)  # finding: implicit-dtype
+    tbl = jnp.array([1, 2, 3])  # finding: implicit-dtype
+    return idx, acc, pad, tbl

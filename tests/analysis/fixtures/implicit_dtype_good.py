@@ -1,0 +1,11 @@
+"""GOOD: every constructor pins its dtype (kwarg or positional)."""
+import jax.numpy as jnp
+import numpy as np
+
+
+def build(n, buf):
+    idx = jnp.arange(n, dtype=jnp.int32)
+    acc = jnp.zeros(n, jnp.uint32)  # positional dtype
+    pad = jnp.full((n, 2), 9, jnp.uint8)
+    dev = jnp.asarray(np.asarray(buf, np.uint8))  # asarray preserves dtype
+    return idx, acc, pad, dev

@@ -1,0 +1,16 @@
+"""BAD: mutable / array-valued defaults shared across calls."""
+import jax.numpy as jnp
+import numpy as np
+
+
+def gather(indices, out=[]):  # finding: mutable-default
+    out.append(indices)
+    return out
+
+
+def scale(x, table=np.zeros(4), opts={}):  # findings: mutable-default x2
+    return x * table, opts
+
+
+def mask(x, keep=jnp.ones(8, bool)):  # finding: mutable-default
+    return x[keep]

@@ -1,0 +1,19 @@
+"""GOOD: None defaults, constructed per call."""
+import jax.numpy as jnp
+import numpy as np
+
+
+def gather(indices, out=None):
+    out = [] if out is None else out
+    out.append(indices)
+    return out
+
+
+def scale(x, table=None):
+    table = np.zeros(4) if table is None else table
+    return x * table
+
+
+def mask(x, keep=None, width=8):
+    keep = jnp.ones(width, bool) if keep is None else keep
+    return x[keep]

@@ -1,0 +1,10 @@
+"""BAD: numpy reductions on traced values inside a jitted function."""
+import jax
+import numpy as np
+
+
+@jax.jit
+def step(x, shape):
+    size = int(np.prod(shape))  # findings: np-on-traced + host-coerce
+    host = np.asarray(x)  # finding: np-on-traced
+    return x.reshape((size,)) + host.sum()

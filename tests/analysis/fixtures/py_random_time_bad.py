@@ -1,0 +1,17 @@
+"""BAD: trace-time nondeterminism baked into a jitted function, in all
+the common spellings."""
+import datetime
+import random
+import time
+from time import time as now_s
+
+import jax
+
+
+@jax.jit
+def step(x):
+    jitter = random.random()  # finding: py-random-time
+    stamp = time.time()  # finding: py-random-time
+    wall = datetime.datetime.now()  # finding: py-random-time
+    bare = now_s()  # finding: py-random-time (from-import alias)
+    return x * jitter + stamp + wall.microsecond + bare

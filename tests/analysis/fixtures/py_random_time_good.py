@@ -1,0 +1,15 @@
+"""GOOD: randomness and time are threaded in as data."""
+import random
+import time
+
+import jax
+
+
+@jax.jit
+def step(x, rng_bits, now_ms):
+    return x * rng_bits + now_ms
+
+
+def make_inputs():
+    # host side may draw freely
+    return random.getrandbits(32), int(time.time() * 1000)

@@ -1,0 +1,28 @@
+"""Suppression handling: same violations as the bad fixtures, silenced
+per line — except one deliberately mis-named suppression that must NOT
+silence its finding."""
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def step(x):
+    scale = int(x[0])  # jaxgate: ignore[host-coerce]
+    flag = bool(x.any())  # jaxgate: ignore
+    total = float(jnp.sum(x))  # jaxgate: ignore[implicit-dtype]
+    wrapped = int(
+        x[1]
+    )  # jaxgate: ignore[host-coerce] — comment on the statement's LAST line
+    return scale + flag + total + wrapped
+
+
+def trace_time_table(n):  # jaxgate: host
+    # host helper: called with static args during tracing; exempt from
+    # jit-context rules even though step() calls it
+    return [int(v) for v in range(n)]
+
+
+@jax.jit
+def uses_table(x):
+    tbl = trace_time_table(x.shape[0])
+    return x + jnp.asarray(tbl, jnp.int32)

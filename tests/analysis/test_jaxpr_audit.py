@@ -1,0 +1,221 @@
+"""Injection tests: the jaxpr auditor must catch the two failure classes
+the parity claim is most exposed to — a host callback smuggled into the
+scanned tick body, and a lost uint32 dtype on the hash dataflow."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from ringpop_tpu.analysis import jaxpr_audit as ja
+
+
+def test_pure_callback_in_scanned_tick_body_is_flagged():
+    # take the REAL engine tick and inject one pure_callback into the
+    # scanned body — the auditor must flag it and exit non-zero
+    engine, params, universe, state = ja._sim_setup(8)
+    n, t = 8, 2
+    inputs = engine.TickInputs(
+        kill=jnp.zeros((t, n), bool),
+        revive=jnp.zeros((t, n), bool),
+        join=jnp.zeros((t, n), bool),
+        partition=jnp.full((t, n), -1, jnp.int32),
+    )
+
+    def body(st, inp):
+        st, m = engine.tick(st, inp, params, universe)
+        leaked = jax.pure_callback(
+            lambda v: np.asarray(v),
+            jax.ShapeDtypeStruct((), jnp.int32),
+            m.pings_sent,
+        )
+        return st, m._replace(pings_sent=leaked)
+
+    def scanned(state, inputs):
+        return jax.lax.scan(body, state, inputs)
+
+    findings = ja.audit_fn("injected-tick", scanned, (state, inputs))
+    cb = [f for f in findings if f.rule == "callback-primitive"]
+    assert cb, findings
+    assert any("scanned/while body" in f.message for f in cb)
+    # clean twin: the same scan without the callback audits clean
+    def clean(state, inputs):
+        return jax.lax.scan(
+            lambda st, inp: engine.tick(st, inp, params, universe),
+            state,
+            inputs,
+        )
+
+    assert ja.audit_fn("clean-tick", clean, (state, inputs)) == []
+
+
+def test_float_on_hash_path_is_flagged():
+    # the canonical missing-dtype failure: an accumulator created without
+    # an explicit dtype joins the farmhash dataflow as float32
+    C1 = np.uint32(0xCC9E2D51)
+
+    def bad_mix(x):  # x: [B] uint32
+        acc = jnp.zeros(x.shape)  # implicit float32
+        return acc + x * C1
+
+    x = jnp.arange(8, dtype=jnp.uint32)
+    findings = ja.audit_fn("bad-mix", bad_mix, (x,))
+    wide = [f for f in findings if f.rule == "wide-dtype-on-hash-path"]
+    assert wide, findings
+
+    def good_mix(x):
+        acc = jnp.zeros(x.shape, jnp.uint32)
+        return acc + x * C1
+
+    assert ja.audit_fn("good-mix", good_mix, (x,)) == []
+
+
+def test_int64_promotion_on_hash_path_is_flagged():
+    # the 64-bit arm must be reachable: under x64 an explicit (or
+    # implicit) widening of a hash value to int64 is a parity break,
+    # and it lowers to convert_element_type like any promotion
+    from jax.experimental import enable_x64
+
+    C1 = np.uint32(0xCC9E2D51)
+
+    def bad_widen(x):  # x: [B] uint32
+        h = x * C1
+        return h.astype(jnp.int64) + 1
+
+    with enable_x64():
+        findings = ja.audit_fn(
+            "bad-widen", bad_widen, (jnp.arange(8, dtype=jnp.uint32),)
+        )
+    assert any(
+        f.rule == "wide-dtype-on-hash-path" and "64-bit" in f.message
+        for f in findings
+    ), findings
+
+
+def test_taint_entering_unmapped_boundary_is_flagged():
+    # taint flowing INTO a while loop (an unmapped sub-jaxpr) must
+    # follow the loop's outputs to a downstream widening
+    def taint_through_loop(x):
+        h = x * np.uint32(0xCC9E2D51)
+        h = jax.lax.while_loop(
+            lambda c: c < jnp.uint32(9),
+            lambda c: c + jnp.uint32(1),
+            h,
+        )
+        return h.astype(jnp.float32)
+
+    findings = ja.audit_fn(
+        "taint-through-loop", taint_through_loop, (jnp.uint32(3),)
+    )
+    assert any(
+        f.rule == "wide-dtype-on-hash-path" for f in findings
+    ), findings
+
+
+def test_int32_hop_does_not_launder_taint():
+    # int32 is a bit-preserving hop for mod-2^32 values; a float
+    # widening one eqn later must still be flagged
+    def launder(x):
+        h = x * np.uint32(0xCC9E2D51)
+        return h.astype(jnp.int32).astype(jnp.float32)
+
+    findings = ja.audit_fn("launder", launder, (jnp.uint32(3),))
+    assert any(
+        f.rule == "wide-dtype-on-hash-path" for f in findings
+    ), findings
+
+
+def test_taint_survives_unmapped_sub_jaxpr_boundary():
+    # hash-constant taint born INSIDE a while body (an unmapped
+    # sub-jaxpr, like a pallas_call kernel) must follow the loop's
+    # outputs: widening the result downstream is a finding
+    from jax.experimental import enable_x64
+
+    def bad_loop(x):  # x: scalar uint32
+        h = jax.lax.while_loop(
+            lambda c: c < jnp.uint32(1 << 30),
+            lambda c: c * np.uint32(0x85EBCA6B) + jnp.uint32(1),
+            x,
+        )
+        return h.astype(jnp.int64) + 1
+
+    with enable_x64():
+        findings = ja.audit_fn(
+            "bad-loop", bad_loop, (jnp.uint32(3),)
+        )
+    assert any(
+        f.rule == "wide-dtype-on-hash-path" for f in findings
+    ), findings
+
+
+def test_removing_uint32_dtype_in_jax_farmhash_is_caught():
+    # ISSUE 3 acceptance, demonstrated literally: strip an explicit uint32
+    # dtype from ops/jax_farmhash.py, re-exec the module source, and audit
+    # its hash32_rows — the tool must go non-zero (the float accumulator
+    # either taints the hash dataflow or kills the trace at a bitwise op)
+    import ringpop_tpu.ops.jax_farmhash as jfh
+
+    src_path = jfh.__file__
+    src = open(src_path).read()
+    broken = src.replace(
+        "b = jnp.zeros(B, jnp.uint32)", "b = jnp.zeros(B)"
+    )
+    assert broken != src, "expected explicit-uint32 site moved — update test"
+    ns = {"__name__": "jax_farmhash_broken", "__file__": src_path}
+    exec(compile(broken, src_path, "exec"), ns)
+
+    mat, lens = ja._farmhash_args()
+    findings = ja.audit_fn(
+        "farmhash-broken",
+        lambda m, l: ns["hash32_rows"](m, l, impl="scan"),
+        (mat, lens),
+    )
+    assert findings, "auditor missed the dropped uint32 dtype"
+    assert {f.rule for f in findings} <= {
+        "wide-dtype-on-hash-path",
+        "trace-failure",
+    }
+
+
+def test_audit_recurses_into_control_flow():
+    # a callback hidden under cond-inside-scan is still found
+    def leaky(xs):
+        def body(c, x):
+            c = jax.lax.cond(
+                x > 0,
+                lambda v: jax.pure_callback(
+                    lambda a: np.asarray(a),
+                    jax.ShapeDtypeStruct((), jnp.int32),
+                    v,
+                ),
+                lambda v: v,
+                c,
+            )
+            return c, c
+
+        return jax.lax.scan(body, jnp.int32(0), xs)
+
+    findings = ja.audit_fn(
+        "nested", leaky, (jnp.arange(4, dtype=jnp.int32),)
+    )
+    assert any(f.rule == "callback-primitive" for f in findings)
+
+
+def test_cli_exit_codes_mirror_findings(monkeypatch, capsys):
+    # exit 0 on the clean registry, non-zero when any entry yields findings
+    from ringpop_tpu.analysis.__main__ import main
+
+    fake_bad = [
+        ja.EntryPoint(
+            "bad",
+            lambda: (
+                lambda x: jnp.zeros(x.shape)
+                + x * np.uint32(0xCC9E2D51),
+                (jnp.arange(4, dtype=jnp.uint32),),
+            ),
+        )
+    ]
+    monkeypatch.setattr(ja, "DEFAULT_ENTRIES", fake_bad)
+    assert main(["--prong", "jaxpr"]) == 1
+    assert "wide-dtype-on-hash-path" in capsys.readouterr().out

@@ -1,0 +1,58 @@
+"""CI gate: the repo itself is jaxgate-clean.
+
+Both prongs run over the live tree — the AST lint across ``ringpop_tpu/``
+and the jaxpr audit of every registered entry point (toy n=8 shapes,
+tracing only).  Any unsuppressed finding fails tier-1, so a stray host
+callback in the scanned tick or an implicit dtype in the hash dataflow is
+caught in the PR that introduces it, not on the next chip session.
+"""
+
+from pathlib import Path
+
+from ringpop_tpu.analysis import astlint, jaxpr_audit
+from ringpop_tpu.analysis.findings import render_text
+
+PKG_ROOT = Path(astlint.__file__).resolve().parents[1]
+
+
+def test_ast_prong_repo_clean():
+    findings = astlint.lint_paths(PKG_ROOT)
+    assert findings == [], "\n" + render_text(findings)
+
+
+def test_jaxpr_prong_entry_points_clean():
+    findings = jaxpr_audit.audit_entries()
+    assert findings == [], "\n" + render_text(findings)
+
+
+def test_jaxpr_prong_covers_required_entry_points():
+    names = {ep.name for ep in jaxpr_audit.DEFAULT_ENTRIES}
+    # ISSUE 3 acceptance: both sim engines, fused checksum, the
+    # Pallas/XLA twins, and the ring device lookup
+    assert {
+        "engine-tick-scan",
+        "engine-scalable-tick",
+        "fused-checksum-xla",
+        "fused-checksum-pallas",
+        "farmhash-scan",
+        "farmhash-pallas-nogrid",
+        "ring-device-lookup",
+    } <= names
+    assert len(names) >= 5
+
+
+def test_changed_only_mode_lints_the_diff_subset(monkeypatch):
+    # --changed-only lints exactly the files git names — pin the "diff"
+    # to known-clean package files so a developer's unrelated WIP edits
+    # can't fail this gate
+    from ringpop_tpu.analysis import __main__ as cli
+
+    clean = [
+        PKG_ROOT / "analysis" / "findings.py",
+        PKG_ROOT / "analysis" / "retrace.py",
+    ]
+    monkeypatch.setattr(cli, "_changed_files", lambda: clean)
+    assert cli.main(["--changed-only", "--prong", "ast"]) == 0
+    # and an empty diff is a no-op exit 0 (the fast pre-commit path)
+    monkeypatch.setattr(cli, "_changed_files", lambda: [])
+    assert cli.main(["--changed-only", "--prong", "ast"]) == 0

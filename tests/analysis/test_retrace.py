@@ -1,0 +1,116 @@
+"""Retrace-budget prong: the committed manifest matches reality, and
+drift in either direction is a finding."""
+
+import copy
+import json
+from pathlib import Path
+
+import pytest
+
+from ringpop_tpu.analysis import retrace
+
+REPO_ROOT = Path(__file__).resolve().parents[2]
+
+# probes cheap enough for tier-1 (the engine-tick probes compile the full
+# tick twice — ~25 s on a contended CPU — and run under the slow marker /
+# scripts/check_retrace_budget.py instead; the 870 s tier-1 cap is real)
+CHEAP_PROBES = ("farmhash-scan", "fused-checksum-xla", "ring-device-lookup")
+
+
+def test_manifest_is_committed_and_well_formed():
+    doc = retrace.load_manifest(REPO_ROOT / retrace.MANIFEST_NAME)
+    assert doc["version"] == 1
+    probes = doc["probes"]
+    assert set(probes) == {p.name for p in retrace.DEFAULT_PROBES}
+    for steps in probes.values():
+        # canonical probe shape: compile, cache hit, budgeted recompile
+        assert [s["cache_size"] for s in steps] == [1, 1, 2]
+
+
+def test_cheap_probes_match_committed_manifest():
+    # the tier-1 acceptance gate: live compile counts == ANALYSIS_BUDGET.json
+    # for the kernel-level probes
+    manifest = retrace.load_manifest(REPO_ROOT / retrace.MANIFEST_NAME)
+    probes = [p for p in retrace.DEFAULT_PROBES if p.name in CHEAP_PROBES]
+    assert len(probes) == len(CHEAP_PROBES)
+    actual = retrace.run_probes(probes)
+    subset = {
+        "probes": {k: manifest["probes"][k] for k in CHEAP_PROBES}
+    }
+    findings = retrace.compare_to_manifest(actual, subset)
+    assert findings == [], [f.message for f in findings]
+
+
+@pytest.mark.slow
+def test_all_probes_match_committed_manifest():
+    # full manifest diff including both engine-tick probes (what
+    # scripts/check_retrace_budget.py runs on the chip session)
+    findings = retrace.check_against_manifest(
+        path=REPO_ROOT / retrace.MANIFEST_NAME
+    )
+    assert findings == [], [f.message for f in findings]
+
+
+def test_drift_detection_both_directions():
+    manifest = retrace.load_manifest(REPO_ROOT / retrace.MANIFEST_NAME)
+    actual = copy.deepcopy(manifest["probes"])
+
+    # silent retrace: probe compiled more than budgeted
+    bumped = copy.deepcopy(actual)
+    bumped["farmhash-scan"][1]["cache_size"] = 2
+    findings = retrace.compare_to_manifest(bumped, manifest)
+    assert any("silent retrace" in f.message for f in findings)
+
+    # stale manifest: fewer compiles than committed
+    dropped = copy.deepcopy(actual)
+    dropped["farmhash-scan"][2]["cache_size"] = 1
+    findings = retrace.compare_to_manifest(dropped, manifest)
+    assert any("stale manifest" in f.message for f in findings)
+
+    # probe set drift both ways
+    missing = {k: v for k, v in actual.items() if k != "engine-tick"}
+    findings = retrace.compare_to_manifest(missing, manifest)
+    assert any("not run" in f.message for f in findings)
+    extra = copy.deepcopy(actual)
+    extra["brand-new-probe"] = [{"desc": "x", "cache_size": 1}]
+    findings = retrace.compare_to_manifest(extra, manifest)
+    assert any("no manifest entry" in f.message for f in findings)
+
+
+def test_broken_probe_is_a_finding_not_a_crash(tmp_path):
+    def boom():
+        raise RuntimeError("entry point renamed")
+
+    probes = [retrace.Probe("broken", boom)]
+    actual = retrace.run_probes(probes)
+    assert "error" in actual["broken"][0]
+    findings = retrace.compare_to_manifest(
+        actual, {"probes": {"broken": [{"desc": "a", "cache_size": 1}]}}
+    )
+    assert any(f.rule == "probe-failure" for f in findings)
+    # same for a NEW probe with no manifest entry yet: surface the error,
+    # not the (dead-end) regenerate-with---write advice
+    findings = retrace.compare_to_manifest(actual, {"probes": {}})
+    assert any(
+        f.rule == "probe-failure" and "entry point renamed" in f.message
+        for f in findings
+    )
+    # --write must refuse to commit a manifest with failed probes
+    with pytest.raises(ValueError, match="failed probes"):
+        retrace.write_manifest(actual, tmp_path / "m.json")
+
+
+def test_missing_manifest_is_a_finding(tmp_path):
+    findings = retrace.check_against_manifest(
+        probes=[], path=tmp_path / "nope.json"
+    )
+    assert len(findings) == 1
+    assert "manifest missing" in findings[0].message
+
+
+def test_write_manifest_roundtrip(tmp_path):
+    actual = {"p": [{"desc": "a", "cache_size": 1}]}
+    out = retrace.write_manifest(actual, tmp_path / "b.json")
+    doc = json.loads(out.read_text())
+    assert doc["probes"] == actual
+    assert retrace.compare_to_manifest(actual, doc) == []
