@@ -73,14 +73,17 @@ def _runlog_recorder(config: dict):
     return RunRecorder(d + os.sep, config=dict(config, tool="bench.py"))
 
 
-def _profile_ctx(phase: str):
+def _profile_ctx(phase: str, recorder=None):
     """Flag-gated jax.profiler capture (BENCH_PROFILE=1) around a bench
     phase; traces land next to the run logs so a tick-cost regression
     (e.g. the 23% between-session tunnel swing in RESULTS.md) can be
-    diagnosed from the artifact instead of by re-running with prints."""
-    if os.environ.get("BENCH_PROFILE") != "1":
-        import contextlib
+    diagnosed from the artifact instead of by re-running with prints.
+    On exit the device memory profile is dumped alongside, and the
+    artifact paths are stamped into the run log (phase + event rows) so
+    every runlog points at its profiler captures."""
+    import contextlib
 
+    if os.environ.get("BENCH_PROFILE") != "1":
         return contextlib.nullcontext()
     import jax
 
@@ -88,7 +91,35 @@ def _profile_ctx(phase: str):
         os.environ.get("BENCH_RUNLOG_DIR") or ".",
         "profile-%s" % phase,
     )
-    return jax.profiler.trace(d)
+
+    @contextlib.contextmanager
+    def _ctx():
+        t0 = time.perf_counter()
+        with jax.profiler.trace(d):
+            yield
+        mem_path = None
+        try:
+            mem_path = os.path.join(d, "device_memory.prof")
+            with open(mem_path, "wb") as fh:
+                fh.write(jax.profiler.device_memory_profile())
+        except Exception as exc:  # profile capture must not sink the run
+            print(
+                "bench: device_memory_profile failed: %s" % exc,
+                file=sys.stderr,
+            )
+            mem_path = None
+        if recorder is not None:
+            recorder.record_phase(
+                "profile[%s]" % phase, time.perf_counter() - t0
+            )
+            recorder.record_event(
+                "profiler_artifacts",
+                profile_phase=phase,
+                trace_dir=d,
+                memory_profile=mem_path,
+            )
+
+    return _ctx()
 
 
 def _mode_rate(
@@ -142,7 +173,7 @@ def _mode_rate(
 
     warm_replays = sim.parity_replays
     t0 = time.perf_counter()
-    with _profile_ctx(mode):
+    with _profile_ctx(mode, recorder=recorder):
         metrics = sim.run(sched)
         jax.block_until_ready(sim.state)
     elapsed = time.perf_counter() - t0

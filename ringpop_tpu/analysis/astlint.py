@@ -50,6 +50,7 @@ from ringpop_tpu.analysis.findings import Finding
 # module suffix -> function names to treat as jit roots.
 TRACED_ENTRIES: Dict[str, Set[str]] = {
     "models/sim/engine.py": {"tick", "compute_checksums"},
+    "models/sim/flight.py": {"append_events", "record_tick_events"},
     "models/sim/engine_scalable.py": {
         "tick",
         "compute_checksums",

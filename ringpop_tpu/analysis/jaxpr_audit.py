@@ -316,23 +316,32 @@ def _toy_universe(n: int = 8):
     )
 
 
-def _sim_setup(n: int = 8):
+def _sim_setup(n: int = 8, flight_recorder: bool = False):
     import jax
 
     from ringpop_tpu.models.sim import engine
 
     universe = _toy_universe(n)
-    params = engine.SimParams(n=n, hash_impl="scan")
+    params = engine.SimParams(
+        n=n,
+        hash_impl="scan",
+        flight_recorder=flight_recorder,
+        event_capacity=256 if flight_recorder else 65536,
+    )
     params = engine.resolve_auto_parity(params, jax.default_backend())
     state = engine.init_state(params, seed=0, universe=universe)
     return engine, params, universe, state
 
 
-def _entry_engine_tick_scan() -> Tuple[Callable, Tuple]:
+def _entry_engine_tick_scan(
+    flight_recorder: bool = False,
+) -> Tuple[Callable, Tuple]:
     import jax
     import jax.numpy as jnp
 
-    engine, params, universe, state = _sim_setup(8)
+    engine, params, universe, state = _sim_setup(
+        8, flight_recorder=flight_recorder
+    )
     n, t = 8, 2
     inputs = engine.TickInputs(
         kill=jnp.zeros((t, n), bool),
@@ -350,10 +359,12 @@ def _entry_engine_tick_scan() -> Tuple[Callable, Tuple]:
     return scanned, (state, inputs)
 
 
-def _entry_engine_scalable_tick() -> Tuple[Callable, Tuple]:
+def _entry_engine_scalable_tick(
+    wavefront: bool = False,
+) -> Tuple[Callable, Tuple]:
     from ringpop_tpu.models.sim import engine_scalable as es
 
-    params = es.ScalableParams(n=8, u=128)
+    params = es.ScalableParams(n=8, u=128, wavefront=wavefront)
     state = es.init_state(params, seed=0)
     inputs = es.ChurnInputs.quiet(8)
 
@@ -451,7 +462,18 @@ def _entry_ring_device() -> Tuple[Callable, Tuple]:
 
 DEFAULT_ENTRIES: List[EntryPoint] = [
     EntryPoint("engine-tick-scan", _entry_engine_tick_scan),
+    # the flight-recorder-enabled scanned tick MUST stay callback-free:
+    # the whole point of the device-side recorder is event telemetry
+    # without host round-trips in the scan (ISSUE 4 acceptance)
+    EntryPoint(
+        "engine-tick-scan-flight-recorder",
+        lambda: _entry_engine_tick_scan(flight_recorder=True),
+    ),
     EntryPoint("engine-scalable-tick", _entry_engine_scalable_tick),
+    EntryPoint(
+        "engine-scalable-tick-wavefront",
+        lambda: _entry_engine_scalable_tick(wavefront=True),
+    ),
     EntryPoint("fused-checksum-xla", lambda: _entry_fused_checksum("xla")),
     EntryPoint(
         "fused-checksum-pallas", lambda: _entry_fused_checksum("pallas")

@@ -138,3 +138,39 @@ class BatchedSimClusters:
     def checksums(self) -> np.ndarray:
         """[B, N] per-cluster membership checksums."""
         return np.asarray(self.state.checksum)
+
+    # -- flight recorder (SimParams.flight_recorder) ----------------------
+
+    def drain_events(self, reset: bool = True):
+        """Per-cluster flight-recorder drain: returns a list of B
+        decoded event streams (the vmapped buffers carry a [B] leading
+        axis).  Feeds the attached RunRecorder one ``flight_drain``
+        event row with per-cluster counts."""
+        if self.state.ev_buf is None:
+            raise ValueError(
+                "flight recorder is off — construct with "
+                "SimParams(flight_recorder=True)"
+            )
+        from ringpop_tpu.obs import events as obs_events
+
+        bufs = np.asarray(self.state.ev_buf)
+        heads = np.asarray(self.state.ev_head)
+        drops = np.asarray(self.state.ev_drops)
+        streams = [
+            obs_events.decode_events(bufs[b], heads[b], drops[b])
+            for b in range(self.b)
+        ]
+        if self.recorder is not None:
+            self.recorder.record_event(
+                "flight_drain",
+                events=[len(s) for s in streams],
+                drops=drops.tolist(),
+            )
+        # reset LAST: a raising recorder sink leaves the window on
+        # device for a retry instead of silently losing it
+        if reset:
+            self.state = self.state._replace(
+                ev_head=jnp.zeros(self.b, jnp.int32),
+                ev_drops=jnp.zeros(self.b, jnp.int32),
+            )
+        return streams

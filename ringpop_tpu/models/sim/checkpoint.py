@@ -35,6 +35,13 @@ _TRAJECTORY_NEUTRAL_PARAMS = frozenset(
         # the record cache is rebuilt from (known, status, inc) on load
         "fused_checksum",
         "cell_batch",
+        # flight recorder / wavefront tracing: write-only telemetry
+        # planes, trajectory-neutral by construction (nothing in the
+        # protocol reads them) — a resume may toggle or resize freely;
+        # the drivers rebuild/drop the buffers on load
+        "flight_recorder",
+        "event_capacity",
+        "wavefront",
     }
 )
 # v2: incarnation fields are int32 tick stamps (engine.stamp_to_ms), not

@@ -191,6 +191,15 @@ class SimCluster:
         # optional telemetry sink (obs.RunRecorder via attach_recorder):
         # every step()/run() folds its metrics into the run log
         self.recorder = None
+        # optional trace tap (obs.SimTracerHost via attach_tracer):
+        # drain_events() re-publishes decoded flight events through it
+        self.tracer = None
+
+    def attach_tracer(self, tracer_host) -> None:
+        """Attach an obs.SimTracerHost; every drain_events() re-publishes
+        the decoded flight-recorder stream through its ``flightEvents``
+        emitter (the ``sim.flight.events`` trace event)."""
+        self.tracer = tracer_host
 
     def attach_recorder(self, recorder) -> None:
         """Attach an obs.RunRecorder; subsequent step()/run() metrics are
@@ -335,6 +344,71 @@ class SimCluster:
             inputs._replace(partition=jnp.asarray(np.asarray(groups, np.int32)))
         )
 
+    # -- flight recorder (SimParams.flight_recorder) ----------------------
+
+    def drain_events(self, reset: bool = True):
+        """Decode the device-side flight-recorder buffer into host event
+        dicts (obs.events) and, by default, clear it for the next
+        window.  Feeds the attached SimTracerHost (``flightEvents``) and
+        logs a ``flight_drain`` event row on the attached RunRecorder.
+        The reset touches ONLY the write head/drop counter — protocol
+        state is untouched, so draining mid-run is trajectory-neutral."""
+        if self.state.ev_buf is None:
+            raise ValueError(
+                "flight recorder is off — construct with "
+                "SimParams(flight_recorder=True)"
+            )
+        from ringpop_tpu.obs import events as obs_events
+
+        drops = int(np.asarray(self.state.ev_drops))
+        decoded = obs_events.decode_events(
+            self.state.ev_buf, self.state.ev_head, drops
+        )
+        if self.tracer is not None:
+            self.tracer.publish_flight_events(decoded, drops=drops)
+        if self.recorder is not None:
+            self.recorder.record_event(
+                "flight_drain", events=len(decoded), drops=drops
+            )
+        # reset LAST: a raising tracer/recorder sink leaves the window
+        # on device for a retry instead of silently losing it
+        if reset:
+            self.state = self.state._replace(
+                ev_head=jnp.int32(0), ev_drops=jnp.int32(0)
+            )
+        return decoded
+
+    def event_drops(self) -> int:
+        """Overflow honesty: events dropped since the last drain."""
+        if self.state.ev_drops is None:
+            return 0
+        return int(np.asarray(self.state.ev_drops))
+
+    def first_heard(self) -> np.ndarray:
+        """The device-resident wavefront matrix: tick at which observer
+        i first adopted j's current rumor (-1 = born-with view only)."""
+        if self.state.first_heard is None:
+            raise ValueError(
+                "flight recorder is off — construct with "
+                "SimParams(flight_recorder=True)"
+            )
+        return np.asarray(self.state.first_heard)
+
+    def export_flight_trace(self, events=None, include_pings: bool = False):
+        """Chrome-trace/Perfetto JSON dict of a decoded event stream
+        (drains the buffer when ``events`` is omitted)."""
+        from ringpop_tpu.obs.chrome_trace import export_chrome_trace
+
+        if events is None:
+            events = self.drain_events()
+        return export_chrome_trace(
+            events,
+            n=self.params.n,
+            period_ms=self.params.period_ms,
+            addresses=list(self.universe.addresses),
+            include_pings=include_pings,
+        )
+
     # -- inspection -------------------------------------------------------
 
     def checksums(self) -> np.ndarray:
@@ -417,3 +491,30 @@ class SimCluster:
             # unfused resume of a fused checkpoint: drop the cache so
             # this run never saves forward bytes it does not maintain
             self.state = self.state._replace(rec_bytes=None, rec_len=None)
+        # flight-recorder plane: telemetry, not trajectory — a resume may
+        # toggle it freely.  Recorder-on resumes start a fresh (empty)
+        # buffer when the checkpoint has none or its capacity differs;
+        # recorder-off resumes drop the saved buffer so this run never
+        # carries forward events it will not append to.
+        if self.params.flight_recorder:
+            buf = self.state.ev_buf
+            if buf is None or buf.shape[0] != self.params.event_capacity:
+                from ringpop_tpu.models.sim import flight
+
+                ev_buf, ev_head, ev_drops, first_heard = (
+                    flight.init_recorder_fields(
+                        self.params.n, self.params.event_capacity
+                    )
+                )
+                if self.state.first_heard is not None:
+                    first_heard = self.state.first_heard  # keep wavefront
+                self.state = self.state._replace(
+                    ev_buf=ev_buf,
+                    ev_head=ev_head,
+                    ev_drops=ev_drops,
+                    first_heard=first_heard,
+                )
+        elif self.state.ev_buf is not None:
+            self.state = self.state._replace(
+                ev_buf=None, ev_head=None, ev_drops=None, first_heard=None
+            )

@@ -172,6 +172,20 @@ class SimParams(NamedTuple):
     # boundaries block fusion and serialize the program, and vmapped
     # multi-cluster batching turns conds into run-both selects anyway.
     gate_phases: bool = True
+    # Device-side protocol flight recorder (models/sim/flight.py +
+    # obs/events.py): when True the tick appends structured int32 event
+    # records — pings, view changes, suspect/faulty verdicts, full
+    # syncs, refutes, joins — into a fixed-capacity buffer carried
+    # through the scan (SimState.ev_buf/ev_head/ev_drops) and maintains
+    # the first-heard wavefront matrix (SimState.first_heard).  Written
+    # with masked scatters under the same masks that drive the
+    # trajectory: trajectory-neutral (gate-equivalence-tested) and
+    # callback-free (jaxpr-audited).  Off by default: zero cost.
+    flight_recorder: bool = False
+    # event buffer capacity in records; overflow DROPS new events and
+    # counts them (SimState.ev_drops) instead of overwriting — a
+    # truncated stream is an honest prefix.  65536 records = 2 MB.
+    event_capacity: int = 65536
 
 
 class SimState(NamedTuple):
@@ -215,6 +229,16 @@ class SimState(NamedTuple):
     # the cache rebuilds it (SimCluster.load).
     rec_bytes: Optional[jax.Array] = None  # [N, N, R] uint8
     rec_len: Optional[jax.Array] = None  # [N, N] int32
+    # flight-recorder plane (SimParams.flight_recorder only, else None):
+    # write-only within the tick — nothing in the protocol reads these,
+    # which is what makes the recorder trajectory-neutral by
+    # construction.  Layout: obs/events.py.
+    ev_buf: Optional[jax.Array] = None  # [event_capacity, 8] int32
+    ev_head: Optional[jax.Array] = None  # scalar int32 — valid records
+    ev_drops: Optional[jax.Array] = None  # scalar int32 — overflow count
+    # first-heard wavefront matrix: tick at which observer i first
+    # adopted j's current rumor (-1 = only the born-with view)
+    first_heard: Optional[jax.Array] = None  # [N, N] int32
 
 
 class TickInputs(NamedTuple):
@@ -453,6 +477,18 @@ def init_state(
             params.max_digits,
         )
         state = state._replace(rec_bytes=rec_b, rec_len=rec_l)
+    if params.flight_recorder:
+        from ringpop_tpu.models.sim import flight
+
+        ev_buf, ev_head, ev_drops, first_heard = (
+            flight.init_recorder_fields(n, params.event_capacity)
+        )
+        state = state._replace(
+            ev_buf=ev_buf,
+            ev_head=ev_head,
+            ev_drops=ev_drops,
+            first_heard=first_heard,
+        )
     # Fast mode never touches the universe in compute_checksums, so the
     # cache can (and must) be seeded even without one — a fast-mode caller
     # omitting universe would otherwise see stale zero checksums for rows
@@ -1004,6 +1040,9 @@ def tick(
 ) -> tuple[SimState, TickMetrics]:
     n = params.n
     gate = params.gate_phases  # static: picks cond vs straight-line phases
+    # tick-start views: the flight recorder's old_status baseline (and
+    # nothing else — the protocol phases read live state as before)
+    prev_known, prev_status = state.known, state.status
     # this tick's incarnation stamp: epoch_ms + tick_next*period_ms
     now = state.tick_index + 2
     node = jnp.arange(n, dtype=jnp.int32)[:, None]
@@ -1046,6 +1085,7 @@ def tick(
     # the node marks itself leave at its CURRENT incarnation (makeLeave,
     # membership/index.js:192), records the change, and stops gossiping;
     # the change disseminates via its ping responses
+    lv = jnp.zeros(n, bool)  # flight recorder: leave self-writes this tick
     if inputs.leave is not None:
         diag = jnp.arange(n, dtype=jnp.int32)
         self_status = state.status[diag, diag]
@@ -1432,13 +1472,17 @@ def tick(
                 started, tick_next + params.suspicion_ticks, state.susp_deadline
             )
         )
-        return state, applied_ping, jnp.sum(refuted, dtype=jnp.int32)
+        # refute cells live on the diagonal only (is_self), so the [N]
+        # diagonal carries the full mask — the flight recorder's
+        # per-refuter view; metrics sum it (identical to the old matrix
+        # sum)
+        return state, applied_ping, _self_view(refuted)
 
-    state, applied_ping, refutes_recv = _phase(
+    state, applied_ping, refute_recv = _phase(
         gate,
         jnp.any(msg_content),
         _receive_phase,
-        lambda s: (s, jnp.zeros((n, n), bool), jnp.int32(0)),
+        lambda s: (s, jnp.zeros((n, n), bool), jnp.zeros(n, bool)),
         state,
     )
     dirty = dirty | jnp.any(applied_ping, axis=1)
@@ -1546,11 +1590,14 @@ def tick(
             state,
             applied_resp,
             full_sync,
-            jnp.sum(refuted_r, dtype=jnp.int32),
-            jnp.sum(fs_mask, dtype=jnp.int32),
+            _self_view(refuted_r),
+            # per-sender record counts (rows of the full-sync payloads);
+            # the scalar metric is their sum, the flight recorder wants
+            # them per event
+            jnp.sum(fs_mask, axis=1, dtype=jnp.int32),
         )
 
-    state, applied_resp, full_sync, refutes_resp, fs_records = _phase(
+    state, applied_resp, full_sync, refute_resp, fs_rec_rows = _phase(
         gate,
         jnp.any(resp_possible),
         _response_phase,
@@ -1558,11 +1605,12 @@ def tick(
             s,
             jnp.zeros((n, n), bool),
             jnp.zeros(n, bool),
-            jnp.int32(0),
-            jnp.int32(0),
+            jnp.zeros(n, bool),
+            jnp.zeros(n, jnp.int32),
         ),
         state,
     )
+    fs_records = jnp.sum(fs_rec_rows, dtype=jnp.int32)
 
     # ---- phase 7: ping-req (indirect probe) ---------------------------
     # only nodes whose DIRECT ping failed probe indirectly; on a healthy
@@ -1738,8 +1786,11 @@ def tick(
         best_key = jnp.full((n, n), -1, jnp.int32)
         best_src = jnp.full((n, n), -1, jnp.int32)
         best_srcinc = jnp.zeros((n, n), jnp.int32)
-        pr_fs_count = jnp.int32(0)
-        pr_fs_records = jnp.int32(0)
+        # per-slot full-sync masks + record counts, stacked [N, K] below:
+        # the scalar metrics are their sums (bit-identical to the old
+        # running scalars), the flight recorder emits them per event
+        pr_fs_list = []
+        pr_fs_rec_list = []
         for k in range(K_pr):
             mk = pr_sel[:, k]
             ex_k = responder[:, k]
@@ -1757,10 +1808,10 @@ def tick(
             fs_k = ex_k & ~jnp.any(resp_k, axis=1) & (
                 mid_checksum[mk] != mid_checksum
             )
-            pr_fs_count = pr_fs_count + jnp.sum(fs_k, dtype=jnp.int32)
+            pr_fs_list.append(fs_k)
             fs_mask_k = fs_k[:, None] & _rows(state.known, mk, n)
-            pr_fs_records = pr_fs_records + jnp.sum(
-                fs_mask_k, dtype=jnp.int32
+            pr_fs_rec_list.append(
+                jnp.sum(fs_mask_k, axis=1, dtype=jnp.int32)
             )
             mask_k = resp_k | fs_mask_k
             st_k = jnp.where(
@@ -1826,19 +1877,18 @@ def tick(
             )
         )
         applied_pr = applied_prm | applied_prr | applied_sus
-        refutes_pr = jnp.sum(refuted_m, dtype=jnp.int32) + jnp.sum(
-            refuted_rr, dtype=jnp.int32
-        )
         return (
             state,
             applied_sus,
             applied_pr,
             ping_req_count,
-            pr_fs_count,
-            pr_fs_records,
             pr_inconclusive,
             pb_drops_pr,
-            refutes_pr,
+            _self_view(refuted_m),
+            _self_view(refuted_rr),
+            jnp.stack(pr_fs_list, axis=1),
+            jnp.stack(pr_fs_rec_list, axis=1),
+            pr_sel,
         )
 
     (
@@ -1846,11 +1896,13 @@ def tick(
         applied_sus,
         applied_pr,
         ping_req_count,
-        pr_fs_count,
-        pr_fs_records,
         pr_inconclusive,
         pb_drops_pr,
-        refutes_pr,
+        refute_prm,
+        refute_prr,
+        pr_fs_mask,
+        pr_fs_recs,
+        pr_sel,
     ) = _phase(
         gate,
         jnp.any(need_pr),
@@ -1862,12 +1914,16 @@ def tick(
             jnp.int32(0),
             jnp.int32(0),
             jnp.int32(0),
-            jnp.int32(0),
-            jnp.int32(0),
-            jnp.int32(0),
+            jnp.zeros(n, bool),
+            jnp.zeros(n, bool),
+            jnp.zeros((n, K_pr), bool),
+            jnp.zeros((n, K_pr), jnp.int32),
+            jnp.zeros((n, K_pr), jnp.int32),
         ),
         state,
     )
+    pr_fs_count = jnp.sum(pr_fs_mask, dtype=jnp.int32)
+    pr_fs_records = jnp.sum(pr_fs_recs, dtype=jnp.int32)
 
     # ---- phase 8: suspicion expiry ------------------------------------
     # active suspicion deadlines exist only while suspects are in flight;
@@ -1953,7 +2009,10 @@ def tick(
         distinct_checksums=distinct,
         converged=distinct <= 1,
         parity_overflow=mid_overflow + late_overflow,
-        refutes=refutes_recv + refutes_resp + refutes_pr,
+        refutes=jnp.sum(refute_recv, dtype=jnp.int32)
+        + jnp.sum(refute_resp, dtype=jnp.int32)
+        + jnp.sum(refute_prm, dtype=jnp.int32)
+        + jnp.sum(refute_prr, dtype=jnp.int32),
         piggyback_drops=pb_drops_send + pb_drops_recv + pb_drops_pr,
         full_sync_records=fs_records + pr_fs_records,
         ping_req_inconclusive=pr_inconclusive,
@@ -1961,6 +2020,43 @@ def tick(
         dirty_rows=jnp.sum(dirty, dtype=jnp.int32)
         + jnp.sum(dirty_late, dtype=jnp.int32),
     )
+
+    # ---- flight recorder (opt-in, trajectory-neutral) -----------------
+    # appended AFTER every protocol phase from the same masks that drove
+    # them; nothing below writes protocol state (models/sim/flight.py)
+    if params.flight_recorder:
+        from ringpop_tpu.models.sim import flight
+
+        state = flight.record_tick_events(
+            state,
+            tick_next,
+            prev_known,
+            prev_status,
+            flight.TickEventMasks(
+                valid_send=valid_send,
+                target=target,
+                delivered=delivered,
+                applied_ping=applied_ping,
+                applied_resp=applied_resp,
+                applied_pr=applied_pr,
+                ja_applied=ja_applied,
+                applied_sus=applied_sus,
+                applied_faulty=applied_faulty,
+                joined=joined,
+                full_sync=full_sync,
+                fs_rec_rows=fs_rec_rows,
+                pr_fs_mask=pr_fs_mask,
+                pr_fs_recs=pr_fs_recs,
+                pr_sel=pr_sel,
+                refute_recv=refute_recv,
+                refute_resp=refute_resp,
+                refute_prm=refute_prm,
+                refute_prr=refute_prr,
+                revived=rv,
+                left=lv,
+                rejoined=rejoin,
+            ),
+        )
 
     state = state._replace(rng=_fold(state.rng, 0x5EED))
     return state, metrics

@@ -128,6 +128,16 @@ class ScalableParams(NamedTuple):
     # sync cost per boundary.  Bitwise-identical trajectories either way
     # (each gated branch is a masked no-op on empty inputs).
     gate_phases: bool = True
+    # Rumor wavefront tracing: when True the state carries a first-heard
+    # tick matrix ``first_heard[i, r]`` — the tick node i's heard bit
+    # for rumor slot r turned on (-1 = never; reset when the slot is
+    # recycled).  With ``r_birth`` this yields per-rumor dissemination
+    # latencies and convergence curves (obs.events.
+    # scalable_wavefront_summary) without any host callback in the
+    # scan.  Trajectory-neutral (nothing reads it) and opt-in: the
+    # [N, U] int32 matrix and the per-tick bit expansion are real
+    # memory/bandwidth at 1M nodes.
+    wavefront: bool = False
 
 
 class ScalableState(NamedTuple):
@@ -169,6 +179,10 @@ class ScalableState(NamedTuple):
     base_sum: jax.Array  # scalar uint32
     rng: jax.Array  # [2] uint32
     checksum: jax.Array  # [N] uint32
+    # wavefront tracing (ScalableParams.wavefront only, else None):
+    # first-heard tick per (node, rumor slot); -1 = never heard.
+    # Write-only within the tick — trajectory-neutral by construction.
+    first_heard: Optional[jax.Array] = None  # [N, U] int32
 
 
 class ScalableMetrics(NamedTuple):
@@ -300,7 +314,11 @@ def init_state(params: ScalableParams, seed: int = 0) -> ScalableState:
     inc0 = jnp.ones(n, jnp.int32)  # stamp 1 == params.epoch
     subj = jnp.arange(n, dtype=jnp.int32)
     base = record_mix(subj, jnp.zeros(n, jnp.int32), inc0)
+    first_heard = (
+        jnp.full((n, u), -1, jnp.int32) if params.wavefront else None
+    )
     return ScalableState(
+        first_heard=first_heard,
         tick_index=jnp.int32(0),
         proc_alive=jnp.ones(n, bool),
         gossip_on=jnp.ones(n, bool),
@@ -348,11 +366,19 @@ def _publish_batch(
     )
     any_ev = jnp.any(subj_mask)
     hears = hearer_mask & any_ev
+    # wavefront: publishers are the rumor's first hearers — stamp their
+    # first-heard tick at publish time (the slot was recycled this tick,
+    # so the column is already reset).  No-op when the batch is empty,
+    # so cond-skipped and straight-line publishes stay bit-identical.
+    fh = state.first_heard
+    if fh is not None:
+        fh = fh.at[:, slot].set(jnp.where(hears, tick, fh[:, slot]))
     # empty batch: leave the (inactive) slot's delta/birth untouched so a
     # straight-line publish is bit-identical to a cond-skipped one — the
     # fields are dead while r_active is False, but the gate-equivalence
     # tests compare raw state
     return state._replace(
+        first_heard=fh,
         r_active=state.r_active.at[slot].set(any_ev),
         r_delta=state.r_delta.at[slot].set(
             jnp.where(any_ev, delta, state.r_delta[slot])
@@ -580,6 +606,12 @@ def tick(
         partition=partition,
         tick_index=t,
         heard=jnp.where(revived[:, None], 0, state.heard),
+        # a restarted process heard nothing yet (wavefront plane)
+        first_heard=(
+            None
+            if state.first_heard is None
+            else jnp.where(revived[:, None], -1, state.first_heard)
+        ),
         susp_subject=jnp.where(revived, -1, state.susp_subject),
         susp_since=jnp.where(revived, -1, state.susp_since),
         defame_slot=jnp.where(revived, -1, state.defame_slot),
@@ -645,12 +677,20 @@ def tick(
     csum = _phase(
         gate, jnp.any(missing != 0), _retire_adjust, lambda c: c, csum
     )
-    # recycled slots' stale heard bits must vanish before reuse
+    # recycled slots' stale heard bits must vanish before reuse; the
+    # wavefront column resets with them (drain the snapshot BEFORE a
+    # rumor's slot recycles — max_rumor_age ticks after birth — or its
+    # first-heard history is gone with the bits)
     clear_words = _pack_mask(recycled)
     state = state._replace(
         r_active=state.r_active & ~retired,
         base_sum=base_sum,
         heard=state.heard & ~clear_words[None, :],
+        first_heard=(
+            None
+            if state.first_heard is None
+            else jnp.where(recycled[None, :], -1, state.first_heard)
+        ),
     )
 
     # ---- gossip exchange: push-pull over K random pairings -------------
@@ -767,7 +807,17 @@ def tick(
         return c + _bit_delta_sum(diff, state.r_delta, u)
 
     csum = _phase(gate, jnp.any(diff != 0), _diff_add, lambda c: c, csum)
-    state = state._replace(heard=new_heard)
+    # wavefront: every newly-set heard bit stamps its first-heard tick.
+    # Straight-line (not gated): the stamp is a masked no-op when no
+    # bits turned on, so gatings stay bit-identical.
+    fh = state.first_heard
+    if fh is not None:
+        bit_ids = jnp.arange(WORD, dtype=jnp.uint32)[None, None, :]
+        new_bits = (
+            ((diff[:, :, None] >> bit_ids) & jnp.uint32(1)) != 0
+        ).reshape(n, u)
+        fh = jnp.where(new_bits, t, fh)
+    state = state._replace(heard=new_heard, first_heard=fh)
 
     # ---- failure detection: suspect batch ------------------------------
     # cancel suspicion clocks whose subject is no longer suspect in truth —

@@ -1,0 +1,291 @@
+"""Device-side protocol flight recorder — the in-tick event emitter.
+
+The scanned SWIM tick (models/sim/engine.py) is a pure function; nothing
+host-side can observe WHICH node learned WHICH rumor WHEN without either
+a host callback in the scan (forbidden — the jaxgate purity contract) or
+per-tick state dumps (O(N^2) transfers per tick).  This module is the
+third way: a fixed-capacity structured event buffer carried through the
+scan as ordinary ``SimState`` fields, appended to with masked scatters
+under the *same masks that drive the trajectory* — so the recorder is
+trajectory-neutral by construction (pinned by the gate-equivalence test
+in tests/models/test_flight_recorder.py), compiles to pure scatter ops
+(audited callback-free by the jaxpr prong's recorder-enabled entry), and
+drains to the host once per ``run()``/``step()`` instead of per tick.
+
+Buffer contract: a LINEAR buffer of ``event_capacity`` fixed-width int32
+records (layout: obs/events.py) plus a write head and a drop counter.
+On overflow, NEW events are dropped and counted — never silently
+overwritten — so a truncated stream is an honest prefix
+(``SimState.ev_drops`` nonzero flags the truncation).
+
+Write mechanics: each emission flattens a trajectory mask, enumerates
+the selected lanes with a cumulative sum (``rank = cumsum(mask) - 1``),
+scatters the records at ``head + rank`` with out-of-capacity lanes
+routed to a dropped scatter slot (``mode="drop"``), and advances the
+head — static shapes throughout, no ``nonzero``, scan-safe.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+
+from ringpop_tpu.obs import events as ev
+
+ALIVE, SUSPECT, FAULTY = 0, 1, 2
+
+
+def init_recorder_fields(n: int, capacity: int):
+    """(ev_buf, ev_head, ev_drops, first_heard) initial values.
+
+    ``first_heard[i, j]`` is the tick at which observer i first adopted
+    j's CURRENT rumor (-1 = holds only what it was born with; the self
+    view is born at tick 0) — the device-resident wavefront matrix that
+    survives even when the event buffer overflows."""
+    import numpy as np
+
+    eye = np.eye(n, dtype=bool)
+    return (
+        jnp.zeros((capacity, ev.RECORD_WIDTH), jnp.int32),
+        jnp.int32(0),
+        jnp.int32(0),
+        jnp.asarray(np.where(eye, 0, -1).astype(np.int32)),
+    )
+
+
+def append_events(
+    buf: jax.Array,  # [cap, RECORD_WIDTH] int32
+    head: jax.Array,  # scalar int32
+    drops: jax.Array,  # scalar int32
+    mask: jax.Array,  # [M] bool — which candidate lanes are real events
+    tick,  # scalar int32
+    kind: int,  # static kind code
+    observer,  # [M] int32 (or scalar, broadcast)
+    subject,  # [M] int32 (or scalar)
+    old_status,  # [M] int32 (or scalar)
+    new_status,  # [M] int32 (or scalar)
+    inc,  # [M] int32 (or scalar)
+    aux,  # [M] int32 (or scalar)
+):
+    """Masked append of up to M candidate events.  Returns the updated
+    (buf, head, drops).  Event order within one append follows lane
+    order (flattened row-major for [N, N] masks) — deterministic."""
+    cap = buf.shape[0]
+    m = mask.shape[0]
+    mask_i = mask.astype(jnp.int32)
+    # dtype pinned: under x64, sum/cumsum of int32 promote to int64 —
+    # which would widen the scan carry (ev_head) and break carry-type
+    # equality between tick input and output
+    total = jnp.sum(mask_i, dtype=jnp.int32)
+    rank = jnp.cumsum(mask_i, dtype=jnp.int32) - 1  # selected: 0..total-1
+    pos = head + rank
+    tgt = jnp.where(mask & (pos < cap), pos, cap)  # cap drops
+
+    def lane(v):
+        arr = jnp.asarray(v, dtype=jnp.int32)
+        return jnp.broadcast_to(arr, (m,))
+
+    rec = jnp.stack(
+        [
+            lane(tick),
+            lane(jnp.int32(kind)),
+            lane(observer),
+            lane(subject),
+            lane(old_status),
+            lane(new_status),
+            lane(inc),
+            lane(aux),
+        ],
+        axis=1,
+    )
+    buf = buf.at[tgt].set(rec, mode="drop")
+    head_new = jnp.minimum(head + total, cap)
+    drops = drops + jnp.maximum(head + total - cap, 0)
+    return buf, head_new, drops
+
+
+class TickEventMasks(NamedTuple):
+    """Everything the end-of-tick emission needs, gathered from the
+    phase outputs (all derived from the masks that drove the
+    trajectory; the emission itself reads — never writes — protocol
+    state)."""
+
+    valid_send: jax.Array  # [N] bool
+    target: jax.Array  # [N] int32
+    delivered: jax.Array  # [N] bool
+    applied_ping: jax.Array  # [N, N] bool
+    applied_resp: jax.Array  # [N, N] bool
+    applied_pr: jax.Array  # [N, N] bool
+    ja_applied: jax.Array  # [N, N] bool
+    applied_sus: jax.Array  # [N, N] bool
+    applied_faulty: jax.Array  # [N, N] bool
+    joined: jax.Array  # [N] bool
+    full_sync: jax.Array  # [N] bool (ping path, indexed by sender)
+    fs_rec_rows: jax.Array  # [N] int32 — records per ping-path full sync
+    pr_fs_mask: jax.Array  # [N, K] bool — ping-req full syncs
+    pr_fs_recs: jax.Array  # [N, K] int32 — records per ping-req full sync
+    pr_sel: jax.Array  # [N, K] int32 — selected intermediaries
+    refute_recv: jax.Array  # [N] bool — self-refutes in the receive phase
+    refute_resp: jax.Array  # [N] bool — ... in the response phase
+    refute_prm: jax.Array  # [N] bool — ... at ping-req intermediaries leg
+    refute_prr: jax.Array  # [N] bool — ... at ping-req responses leg
+    revived: jax.Array  # [N] bool — process restarted (views reset)
+    left: jax.Array  # [N] bool — graceful-leave self-write this tick
+    rejoined: jax.Array  # [N] bool — rejoin-of-left self-write this tick
+
+
+def record_tick_events(
+    state,  # engine.SimState AFTER the tick's phases ran
+    tick,  # scalar int32 — this tick's index (state.tick_index)
+    prev_known: jax.Array,  # [N, N] bool — views at tick START
+    prev_status: jax.Array,  # [N, N] int32
+    masks: TickEventMasks,
+):
+    """Append this tick's events; returns state with updated ev_* and
+    first_heard fields.  Emission order is fixed (pings, status changes,
+    verdicts, full syncs, refutes, joins) so decoded streams are
+    deterministic and stable across gate_phases settings."""
+    n = prev_known.shape[0]
+    ids = jnp.arange(n, dtype=jnp.int32)
+    row = jnp.broadcast_to(ids[:, None], (n, n)).reshape(-1)
+    col = jnp.broadcast_to(ids[None, :], (n, n)).reshape(-1)
+    is_self = ids[:, None] == ids[None, :]
+
+    buf, head, drops = state.ev_buf, state.ev_head, state.ev_drops
+    zero = jnp.int32(0)
+    none = jnp.int32(-1)
+
+    # 1. pings: one event per initiated direct probe
+    buf, head, drops = append_events(
+        buf, head, drops,
+        masks.valid_send,
+        tick, ev.EV_PING,
+        observer=ids,
+        subject=jnp.clip(masks.target, 0, n - 1),
+        old_status=none, new_status=none, inc=zero,
+        aux=masks.delivered.astype(jnp.int32),
+    )
+
+    # 2. view changes: the union of every apply mask, with a phase
+    # bitmask in aux.  old view is the tick-start view (-1 = unknown),
+    # new view is the END-of-tick view — a cell touched by several
+    # phases emits ONE event carrying its final value for the tick.
+    join_learned = masks.joined[:, None] & state.known & ~is_self
+    # operator-plane leave/rejoin write the origin's OWN view outside
+    # the gossip apply masks — fold their diagonal cells in so the
+    # rumor's birth event exists (chrome-trace self-status spans, and
+    # rumor_wavefronts hop-0 attribution, both key off it)
+    admin_self = (masks.left | masks.rejoined)[:, None] & is_self
+    phase_bits = (
+        masks.applied_ping.astype(jnp.int32) * ev.PHASE_PING_RECV
+        + masks.applied_resp.astype(jnp.int32) * ev.PHASE_RESPONSE
+        + masks.applied_pr.astype(jnp.int32) * ev.PHASE_PING_REQ
+        + (masks.ja_applied | join_learned).astype(jnp.int32) * ev.PHASE_JOIN
+        + masks.applied_faulty.astype(jnp.int32) * ev.PHASE_EXPIRY
+        + admin_self.astype(jnp.int32) * ev.PHASE_ADMIN
+    )
+    changed = phase_bits > 0
+    old_st = jnp.where(prev_known, prev_status, -1)
+    buf, head, drops = append_events(
+        buf, head, drops,
+        changed.reshape(-1),
+        tick, ev.EV_STATUS,
+        observer=row, subject=col,
+        old_status=old_st.reshape(-1),
+        new_status=state.status.reshape(-1),
+        inc=state.inc.reshape(-1),
+        aux=phase_bits.reshape(-1),
+    )
+
+    # 3/4. detection verdicts (subsets of the status events above, kept
+    # as distinct kinds so the failure-detection plane reconciles
+    # one-to-one with suspects_marked / faulties_marked)
+    buf, head, drops = append_events(
+        buf, head, drops,
+        masks.applied_sus.reshape(-1),
+        tick, ev.EV_SUSPECT,
+        observer=row, subject=col,
+        old_status=old_st.reshape(-1),
+        new_status=jnp.int32(SUSPECT),
+        inc=state.inc.reshape(-1),
+        aux=zero,
+    )
+    buf, head, drops = append_events(
+        buf, head, drops,
+        masks.applied_faulty.reshape(-1),
+        tick, ev.EV_FAULTY,
+        observer=row, subject=col,
+        old_status=old_st.reshape(-1),
+        new_status=jnp.int32(FAULTY),
+        inc=state.inc.reshape(-1),
+        aux=zero,
+    )
+
+    # 5. full syncs: ping path (sender <- target), then ping-req path
+    # (sender <- intermediary), aux = member records carried
+    buf, head, drops = append_events(
+        buf, head, drops,
+        masks.full_sync,
+        tick, ev.EV_FULL_SYNC,
+        observer=ids,
+        subject=jnp.clip(masks.target, 0, n - 1),
+        old_status=none, new_status=none, inc=zero,
+        aux=masks.fs_rec_rows,
+    )
+    k = masks.pr_fs_mask.shape[1]
+    obs_k = jnp.broadcast_to(ids[:, None], (n, k)).reshape(-1)
+    buf, head, drops = append_events(
+        buf, head, drops,
+        masks.pr_fs_mask.reshape(-1),
+        tick, ev.EV_FULL_SYNC,
+        observer=obs_k,
+        subject=jnp.clip(masks.pr_sel, 0, n - 1).reshape(-1),
+        old_status=none, new_status=none, inc=zero,
+        aux=masks.pr_fs_recs.reshape(-1),
+    )
+
+    # 6. refutes: one event per phase a node re-asserted itself in, so
+    # the count reconciles exactly with TickMetrics.refutes (which sums
+    # per-phase refute cells)
+    self_inc = jnp.diagonal(state.inc)
+    for phase_bit, mask in (
+        (ev.PHASE_PING_RECV, masks.refute_recv),
+        (ev.PHASE_RESPONSE, masks.refute_resp),
+        (ev.PHASE_PING_REQ, masks.refute_prm),
+        (ev.PHASE_PING_REQ, masks.refute_prr),
+    ):
+        buf, head, drops = append_events(
+            buf, head, drops,
+            mask,
+            tick, ev.EV_REFUTE,
+            observer=ids, subject=ids,
+            old_status=none,
+            new_status=jnp.int32(ALIVE),
+            inc=self_inc,
+            aux=jnp.int32(phase_bit),
+        )
+
+    # 7. joins: aux = members learned in the merge
+    buf, head, drops = append_events(
+        buf, head, drops,
+        masks.joined,
+        tick, ev.EV_JOIN,
+        observer=ids, subject=none,
+        old_status=none, new_status=none, inc=zero,
+        aux=jnp.sum(join_learned, axis=1, dtype=jnp.int32),
+    )
+
+    # device-resident wavefront matrix: first-heard tick of the CURRENT
+    # rumor per (observer, subject) — every adoption this tick stamps
+    # it.  A revived process lost its views, so its row resets (the
+    # reborn self view is born this tick)
+    rv2 = masks.revived[:, None]
+    first_heard = jnp.where(
+        rv2, jnp.where(is_self, tick, -1), state.first_heard
+    )
+    first_heard = jnp.where(changed, tick, first_heard)
+    return state._replace(
+        ev_buf=buf, ev_head=head, ev_drops=drops, first_heard=first_heard
+    )

@@ -218,6 +218,40 @@ class ScalableCluster:
         """Rebuild the ring from current truth, return its digest."""
         return int(self._ring_checksum(self.state.truth_status, self.state.proc_alive))
 
+    # -- rumor wavefront tracing (ScalableParams.wavefront) ---------------
+
+    def wavefront_snapshot(self) -> dict:
+        """Host snapshot of the rumor wavefront plane: first-heard tick
+        matrix + rumor birth ticks/active flags — everything
+        ``obs.events.scalable_wavefront_summary`` needs.  Snapshot
+        BEFORE rumors age out (max_rumor_age ticks after birth): a
+        recycled slot's history resets with its heard bits."""
+        if self.state.first_heard is None:
+            raise ValueError(
+                "wavefront tracing is off — construct with "
+                "ScalableParams(wavefront=True)"
+            )
+        return {
+            "tick": int(np.asarray(self.state.tick_index)),
+            "first_heard": np.asarray(self.state.first_heard),
+            "r_birth": np.asarray(self.state.r_birth),
+            "r_active": np.asarray(self.state.r_active),
+            "live": np.asarray(self.state.proc_alive),
+        }
+
+    def wavefront_summary(self) -> dict:
+        """Per-rumor dissemination latencies + convergence curves from
+        the current wavefront snapshot (obs.events)."""
+        from ringpop_tpu.obs import events as obs_events
+
+        snap = self.wavefront_snapshot()
+        return obs_events.scalable_wavefront_summary(
+            snap["first_heard"],
+            snap["r_birth"],
+            snap["r_active"],
+            snap["live"],
+        )
+
     # -- checkpoint/resume (SURVEY §5.4) ---------------------------------
 
     def save(self, path: str) -> None:
@@ -229,3 +263,15 @@ class ScalableCluster:
         from ringpop_tpu.models.sim.checkpoint import load_state
 
         self.state = load_state(path, es.ScalableState, self.params)
+        # wavefront plane: telemetry, not trajectory — align with this
+        # cluster's params regardless of what the checkpoint carried
+        if self.params.wavefront and self.state.first_heard is None:
+            self.state = self.state._replace(
+                first_heard=jnp.full(
+                    (self.params.n, self.params.u), -1, jnp.int32
+                )
+            )
+        elif not self.params.wavefront and (
+            self.state.first_heard is not None
+        ):
+            self.state = self.state._replace(first_heard=None)

@@ -20,8 +20,14 @@ become durable and queryable:
 - :mod:`ringpop_tpu.obs.prometheus` — Prometheus text exposition for
   live nodes (the ``/admin/metrics`` endpoint) and for recorded runs.
 - :mod:`ringpop_tpu.obs.sim_tap` — adapter letting ``TracerStore`` /
-  ``Tracer`` attach to simulation drivers (the ``sim.tick.metrics``
-  trace event).
+  ``Tracer`` attach to simulation drivers (the ``sim.tick.metrics`` and
+  ``sim.flight.events`` trace events).
+- :mod:`ringpop_tpu.obs.events` — flight-recorder event registry,
+  decoder, TickMetrics reconciliation, rumor-wavefront derivations
+  (device half: models/sim/flight.py).
+- :mod:`ringpop_tpu.obs.chrome_trace` — Chrome-trace/Perfetto JSON
+  export of decoded flight-recorder streams (per-node tracks,
+  status-transition spans, rumor flow arrows) + schema validation.
 """
 
 from ringpop_tpu.obs.recorder import (  # noqa: F401
@@ -35,3 +41,16 @@ from ringpop_tpu.obs.prometheus import (  # noqa: F401
     render_tick_series,
 )
 from ringpop_tpu.obs.sim_tap import SimTracerHost  # noqa: F401
+from ringpop_tpu.obs.events import (  # noqa: F401
+    decode_events,
+    dissemination_summary,
+    reconcile,
+    rumor_wavefronts,
+    scalable_wavefront_summary,
+    validate_event_stream,
+)
+from ringpop_tpu.obs.chrome_trace import (  # noqa: F401
+    export_chrome_trace,
+    validate_chrome_trace,
+    write_chrome_trace,
+)

@@ -23,6 +23,14 @@ def _escape_label(v: Any) -> str:
     )
 
 
+def _escape_help(v: Any) -> str:
+    """HELP-line escaping per the exposition format 0.0.4: backslash and
+    newline only (double quotes are NOT escaped outside label values).
+    Unescaped, a newline in help text would split the line and corrupt
+    every sample after it."""
+    return str(v).replace("\\", "\\\\").replace("\n", "\\n")
+
+
 def _fmt_value(v: Any) -> str:
     if isinstance(v, bool):
         return "1" if v else "0"
@@ -77,7 +85,9 @@ class PromWriter:
         for name in self._order:
             fam = self._families[name]
             if fam["help"]:
-                lines.append("# HELP %s %s" % (name, fam["help"]))
+                lines.append(
+                    "# HELP %s %s" % (name, _escape_help(fam["help"]))
+                )
             lines.append("# TYPE %s %s" % (name, fam["type"]))
             lines.extend(fam["samples"])
         return "\n".join(lines) + ("\n" if lines else "")

@@ -69,7 +69,12 @@ def iter_tick_rows(metrics: Any):
     """Yield per-tick row dicts from a metrics pytree — a NamedTuple or
     dict whose leaves are scalars (one row), [T]-arrays, or [T, B]-arrays
     (vmapped drivers; rows then hold [B]-vectors).  The ONE unstacking
-    loop shared by the recorder, the statsd bridge and the sim trace tap."""
+    loop shared by the recorder, the statsd bridge and the sim trace tap.
+
+    Every leaf must agree on the leading (time) dimension: a ragged
+    pytree — some leaves scalar, some [T], or [T]s of different T —
+    would silently mis-slice (leaf ``v[t]`` reads a different tick's
+    value, or IndexErrors mid-stream), so it raises up front instead."""
     import numpy as np
 
     if hasattr(metrics, "_asdict"):
@@ -77,12 +82,54 @@ def iter_tick_rows(metrics: Any):
     arrs = {k: np.asarray(v) for k, v in metrics.items()}
     if not arrs:
         return
+    lead_dims = {k: (v.shape[0] if v.ndim else None) for k, v in arrs.items()}
+    distinct = set(lead_dims.values())
+    if len(distinct) > 1:
+        raise ValueError(
+            "ragged metrics pytree: leaves disagree on the leading "
+            "(time) dimension — %s"
+            % ", ".join(
+                "%s: %s" % (k, "scalar" if d is None else "[%d]" % d)
+                for k, d in sorted(lead_dims.items())
+            )
+        )
     lead = next(iter(arrs.values()))
     if lead.ndim == 0:
         yield arrs
         return
     for t in range(lead.shape[0]):
         yield {k: v[t] for k, v in arrs.items()}
+
+
+def _backends_initialized() -> bool:
+    """True when a jax backend already exists, WITHOUT initializing one.
+
+    Reaches into jax internals, so it is deliberately version-tolerant:
+    jax 0.4.x keeps a ``jax._src.xla_bridge._backends`` dict, newer
+    releases have renamed/moved the registry more than once.  Any probe
+    that fails falls through to the next; when every probe fails the
+    answer is False — provenance then simply omits platform fields
+    rather than risking a backend grab on a host-only run."""
+    try:
+        from jax._src import xla_bridge
+
+        backends = getattr(xla_bridge, "_backends", None)
+        if isinstance(backends, dict):
+            return bool(backends)
+        probe = getattr(xla_bridge, "backends_are_initialized", None)
+        if callable(probe):
+            return bool(probe())
+    except Exception:
+        pass
+    try:  # newer layouts keep the registry on jax._src.backends
+        from jax._src import backends as _jb  # type: ignore
+
+        backends = getattr(_jb, "_backends", None)
+        if isinstance(backends, dict):
+            return bool(backends)
+    except Exception:
+        pass
+    return False
 
 
 def backend_provenance() -> Dict[str, Any]:
@@ -96,9 +143,7 @@ def backend_provenance() -> Dict[str, Any]:
         prov["jax_version"] = jax.__version__
         # only read devices if a backend already exists — jax.devices()
         # would otherwise initialize one as a side effect
-        from jax._src import xla_bridge
-
-        if xla_bridge._backends:  # noqa: SLF001 — read-only peek
+        if _backends_initialized():
             prov["platform"] = jax.default_backend()
             prov["device_count"] = jax.device_count()
     except Exception:  # pragma: no cover — provenance is best-effort
@@ -273,6 +318,30 @@ class RunRecorder:
         row = {"kind": "event", "name": name}
         row.update(_jsonable(extra))
         self._write(row)
+
+    def record_trace_sidecar(
+        self, trace: Dict[str, Any], name: str = "flight"
+    ) -> str:
+        """Write a Chrome-trace JSON sidecar next to this run log and
+        link it with a ``trace_sidecar`` event row (relative path, so
+        the pair stays valid when the runlog directory moves).  The
+        sidecar is schema-validated before writing (obs.chrome_trace);
+        the CI gate (scripts/check_metrics_schema.py) re-validates both
+        the link and the file."""
+        from ringpop_tpu.obs.chrome_trace import write_chrome_trace
+
+        base = self.path
+        suffix = ".runlog.jsonl"
+        if base.endswith(suffix):
+            base = base[: -len(suffix)]
+        sidecar = "%s.%s.trace.json" % (base, name)
+        write_chrome_trace(trace, sidecar)
+        self.record_event(
+            "trace_sidecar",
+            sidecar=name,
+            path=os.path.basename(sidecar),
+        )
+        return sidecar
 
     # -- teardown ---------------------------------------------------------
 

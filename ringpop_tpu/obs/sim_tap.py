@@ -53,5 +53,25 @@ class SimTracerHost:
             published += 1
         return published
 
+    def publish_flight_events(
+        self, events: Any, drops: int = 0, batch: int = 256
+    ) -> int:
+        """Re-publish a decoded flight-recorder stream (obs/events.py)
+        as ``flightEvents`` emissions (the ``sim.flight.events`` trace
+        event), batched so a long drain does not flood forwarding sinks
+        one datagram per protocol event.  Returns events published."""
+        events = list(events)
+        for lo in range(0, len(events), batch):
+            self.sim_events.emit(
+                "flightEvents",
+                {
+                    "events": events[lo : lo + batch],
+                    "dropped": int(drops),
+                    "offset": lo,
+                    "total": len(events),
+                },
+            )
+        return len(events)
+
     def destroy(self) -> None:
         self.tracers.destroy()

@@ -43,6 +43,13 @@ TRACE_EVENTS: Dict[str, Dict[str, str]] = {
         "emitter": "sim_events",
         "event": "tickMetrics",
     },
+    # decoded flight-recorder event batches re-published by a
+    # SimTracerHost drain (obs/sim_tap.py publish_flight_events; event
+    # layout in obs/events.py)
+    "sim.flight.events": {
+        "emitter": "sim_events",
+        "event": "flightEvents",
+    },
 }
 
 

@@ -1,11 +1,14 @@
 #!/usr/bin/env python
-"""Validate every JSONL run log in the repo against the recorder schema.
+"""Validate every JSONL run log AND trace sidecar in the repo.
 
 The telemetry layer's CI gate: any ``*.runlog.jsonl`` under the repo
 root (committed artifacts in runlogs/, stray logs from local runs) must
 parse against ``obs.recorder``'s schema — one JSON object per line, a
 leading header row with the current schema version, monotonically
-increasing tick indices.  Runs standalone::
+increasing tick indices.  Any ``*.trace.json`` flight-recorder sidecar
+(obs.chrome_trace) must parse against the Trace Event Format schema,
+and every ``trace_sidecar`` event row inside a runlog must point at a
+file that exists next to it.  Runs standalone::
 
     python scripts/check_metrics_schema.py [paths...]
 
@@ -16,6 +19,7 @@ calls the same entry point.
 from __future__ import annotations
 
 import glob
+import json
 import os
 import sys
 
@@ -28,14 +32,67 @@ def find_run_logs(root: str = REPO_ROOT) -> list:
     )
 
 
+def find_trace_sidecars(root: str = REPO_ROOT) -> list:
+    return sorted(
+        glob.glob(os.path.join(root, "**", "*.trace.json"), recursive=True)
+    )
+
+
+def _check_sidecar_links(path: str) -> list:
+    """Every trace_sidecar event row in a runlog must reference a file
+    that exists next to the log (the pair ships together)."""
+    problems = []
+    logdir = os.path.dirname(os.path.abspath(path))
+    with open(path, encoding="utf-8") as fh:
+        for ln, line in enumerate(fh, 1):
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                row = json.loads(line)
+            except ValueError:
+                continue  # validate_run_log already reports this
+            if (
+                isinstance(row, dict)
+                and row.get("kind") == "event"
+                and row.get("name") == "trace_sidecar"
+            ):
+                ref = row.get("path")
+                if not isinstance(ref, str):
+                    problems.append(
+                        "%s:%d: trace_sidecar row missing path" % (path, ln)
+                    )
+                elif not os.path.exists(os.path.join(logdir, ref)):
+                    problems.append(
+                        "%s:%d: trace_sidecar points at missing file %r"
+                        % (path, ln, ref)
+                    )
+    return problems
+
+
 def check(paths=None, verbose: bool = True) -> list:
-    """Returns the list of problems across all logs (empty == all valid)."""
+    """Returns the list of problems across all logs and sidecars (empty
+    == all valid)."""
+    from ringpop_tpu.obs.chrome_trace import validate_chrome_trace
     from ringpop_tpu.obs.recorder import validate_run_log
 
-    paths = list(paths) if paths else find_run_logs()
+    if paths:
+        paths = list(paths)
+    else:
+        paths = find_run_logs() + find_trace_sidecars()
     problems = []
     for path in paths:
-        found = validate_run_log(path)
+        if path.endswith(".trace.json"):
+            try:
+                with open(path, encoding="utf-8") as fh:
+                    trace = json.load(fh)
+            except ValueError as e:
+                found = ["%s: not JSON (%s)" % (path, e)]
+            else:
+                found = ["%s: %s" % (path, p) for p in validate_chrome_trace(trace)]
+        else:
+            found = validate_run_log(path)
+            found.extend(_check_sidecar_links(path))
         problems.extend(found)
         if verbose:
             status = "OK" if not found else "%d problem(s)" % len(found)
@@ -46,8 +103,11 @@ def check(paths=None, verbose: bool = True) -> list:
 def main(argv) -> int:
     sys.path.insert(0, REPO_ROOT)
     paths = argv[1:] or None
-    if paths is None and not find_run_logs():
-        print("no *.runlog.jsonl files found under %s" % REPO_ROOT)
+    if paths is None and not (find_run_logs() or find_trace_sidecars()):
+        print(
+            "no *.runlog.jsonl or *.trace.json files found under %s"
+            % REPO_ROOT
+        )
         return 0
     problems = check(paths)
     for p in problems:

@@ -37,6 +37,10 @@ def test_jaxpr_prong_covers_required_entry_points():
         "farmhash-scan",
         "farmhash-pallas-nogrid",
         "ring-device-lookup",
+        # ISSUE 4 acceptance: the flight-recorder-enabled scanned tick
+        # and the wavefront-enabled scalable tick stay callback-free
+        "engine-tick-scan-flight-recorder",
+        "engine-scalable-tick-wavefront",
     } <= names
     assert len(names) >= 5
 

@@ -20,9 +20,22 @@ if "xla_force_host_platform_device_count" not in flags:
     ).strip()
 os.environ["JAX_PLATFORMS"] = "cpu"  # for any subprocesses tests may spawn
 
+# Persistent XLA compilation cache: the tier-1 suite is dominated by
+# compiles of large scanned programs (the 870 s budget bites), and the
+# cache survives across pytest runs, cutting warm reruns to a fraction.
+# Kept INSIDE the repo (gitignored) — nothing outside /root/repo is
+# touched.  The env var (not just jax.config) so spawned subprocesses
+# (dryrun_multichip) share it; config.update below covers THIS process,
+# whose jax was already imported by sitecustomize without the var.
+_CACHE_DIR = os.path.join(os.path.dirname(__file__), "..", ".jax_cache")
+os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", _CACHE_DIR)
+
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
+jax.config.update(
+    "jax_compilation_cache_dir", os.environ["JAX_COMPILATION_CACHE_DIR"]
+)
 
 
 def pytest_configure(config):

@@ -1,0 +1,255 @@
+"""Device-side flight recorder: gate-equivalence + reconciliation.
+
+ISSUE 4 acceptance pins:
+
+- with the recorder *enabled*, membership trajectory and checksums are
+  bit-identical to recorder-off runs (n=64 tier-1, n=1k slow),
+- the decoded event stream reconciles with ``TickMetrics`` counters for
+  the same window (pings, suspects_marked, faulties_marked, full_syncs),
+- the drop counter is zero at tier-1 sizes, and overflow degrades
+  gracefully (honest prefix + counted drops) when it is not.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from ringpop_tpu.models.sim import engine
+from ringpop_tpu.models.sim.cluster import EventSchedule, SimCluster
+from ringpop_tpu.obs import events as obs_events
+
+N = 64
+TICKS = 40
+
+
+def _schedule(n: int, ticks: int) -> EventSchedule:
+    """Churn inside the window: a kill (suspect -> faulty escalation),
+    a revive (rejoin + dissemination wave), and an operator-plane
+    graceful leave + rejoin (admin self-writes) — the event-rich
+    shape."""
+    sched = EventSchedule(ticks=ticks, n=n)
+    sched.kill[3, 5] = True
+    sched.revive[ticks // 2, 5] = True
+    sched.leave = np.zeros((ticks, n), bool)
+    sched.leave[5, 9] = True
+    sched.join[3 * ticks // 4, 9] = True  # rejoin of the left node
+    return sched
+
+
+def _run(n: int, ticks: int, flight: bool, **params):
+    p = engine.SimParams(
+        n=n,
+        checksum_mode="fast",
+        suspicion_ticks=6,
+        flight_recorder=flight,
+        **params,
+    )
+    sim = SimCluster(n=n, params=p, seed=1)
+    sim.bootstrap()
+    if flight:
+        sim.drain_events()  # align the event window with the run window
+    metrics = sim.run(_schedule(n, ticks))
+    return sim, metrics
+
+
+@pytest.fixture(scope="module")
+def recorder_pair():
+    sim_on, m_on = _run(N, TICKS, flight=True, event_capacity=65536)
+    sim_off, m_off = _run(N, TICKS, flight=False)
+    return sim_on, m_on, sim_off, m_off
+
+
+def test_recorder_is_trajectory_neutral(recorder_pair):
+    sim_on, m_on, sim_off, m_off = recorder_pair
+    for f in engine.SimState._fields:
+        v_off = getattr(sim_off.state, f)
+        if v_off is None:
+            continue  # recorder-only planes have no off-side twin
+        assert np.array_equal(
+            np.asarray(getattr(sim_on.state, f)), np.asarray(v_off)
+        ), "state field %r diverged with the flight recorder on" % f
+    for f in engine.TickMetrics._fields:
+        assert np.array_equal(
+            np.asarray(getattr(m_on, f)), np.asarray(getattr(m_off, f))
+        ), "metric %r diverged with the flight recorder on" % f
+    assert np.array_equal(sim_on.checksums(), sim_off.checksums())
+
+
+def test_event_stream_reconciles_with_tick_metrics(recorder_pair):
+    sim_on, m_on, _, _ = recorder_pair
+    assert sim_on.event_drops() == 0  # tier-1 sizes must not truncate
+    events = sim_on.drain_events(reset=False)
+    rec = obs_events.reconcile(events, m_on)
+    # the ISSUE 4 acceptance counters, plus every other counter with a
+    # defined event equivalent
+    for field in (
+        "pings_sent",
+        "suspects_marked",
+        "faulties_marked",
+        "full_syncs",
+    ):
+        assert field in rec, field
+    mismatches = {k: v for k, v in rec.items() if not v["match"]}
+    assert mismatches == {}, mismatches
+    # the window actually exercised the detection plane
+    assert rec["suspects_marked"]["events"] >= 1
+    assert rec["faulties_marked"]["events"] >= 1
+
+
+def test_wavefront_matrix_and_derivations(recorder_pair):
+    sim_on, m_on, _, _ = recorder_pair
+    fh = sim_on.first_heard()
+    n = sim_on.params.n
+    # every off-diagonal known cell was learned at some recorded tick
+    known = np.asarray(sim_on.state.known)
+    off_diag = ~np.eye(n, dtype=bool)
+    assert (fh[known & off_diag] >= 1).all()
+    assert (np.diagonal(fh) >= 0).all()
+    # per-rumor wavefronts: curves are monotone, latencies non-negative
+    events = sim_on.drain_events(reset=False)
+    wavefronts = obs_events.rumor_wavefronts(events)
+    assert wavefronts, "churn window must produce disseminating rumors"
+    summary = obs_events.dissemination_summary(wavefronts)
+    assert summary["rumors"], summary
+    for r in summary["rumors"]:
+        curve = r["convergence_curve"]
+        assert all(
+            curve[i][0] < curve[i + 1][0] and curve[i][1] < curve[i + 1][1]
+            for i in range(len(curve) - 1)
+        )
+        assert r["convergence_latency"] >= 0
+    assert summary["latency_histogram_ticks"]
+
+
+def test_leave_and_rejoin_emit_admin_self_events(recorder_pair):
+    """The operator-plane self-writes (graceful leave, rejoin-of-left)
+    bypass the gossip apply masks — the recorder must still emit the
+    rumor's BIRTH event (observer == subject, PHASE_ADMIN aux), or
+    chrome-trace self-status spans and wavefront hop-0 attribution
+    misassign the rumor to its first OTHER hearer."""
+    sim_on, _, _, _ = recorder_pair
+    events = sim_on.drain_events(reset=False)
+    admin = [
+        e
+        for e in events
+        if e["kind"] == obs_events.EV_STATUS
+        and e["aux"] & obs_events.PHASE_ADMIN
+    ]
+    assert {(e["observer"], e["subject"]) for e in admin} == {(9, 9)}
+    statuses = [e["new_status"] for e in sorted(admin, key=lambda e: e["tick"])]
+    assert statuses == [3, 0]  # LEAVE self-write, then ALIVE rejoin
+    # the leave rumor's wavefront is born AT the origin (hop 0)
+    wavefronts = obs_events.rumor_wavefronts(events)
+    leave_rumors = [
+        w for rid, w in wavefronts.items() if rid[0] == 9 and rid[1] == 3
+    ]
+    assert leave_rumors, wavefronts.keys()
+    assert any(
+        w["hops"].get(9) == 0 and w["latency"].get(9) == 0
+        for w in leave_rumors
+    )
+
+
+def test_drain_resets_the_window(recorder_pair):
+    sim_on, _, _, _ = recorder_pair
+    before = len(sim_on.drain_events())  # resets
+    assert int(np.asarray(sim_on.state.ev_head)) == 0
+    # steps, not run(): reuses the tick executable compiled at bootstrap
+    # instead of tracing a fresh 3-tick scan (tier-1 budget)
+    rows = [sim_on.step() for _ in range(3)]
+    m = {
+        f: np.stack([np.asarray(getattr(r, f)) for r in rows])
+        for f in engine.TickMetrics._fields
+    }
+    events = sim_on.drain_events(reset=False)
+    assert 0 < len(events) < max(before, 1) + N * 3
+    rec = obs_events.reconcile(events, m)
+    assert all(v["match"] for v in rec.values()), rec
+
+
+def test_overflow_drops_and_counts_instead_of_lying():
+    # capacity far below the bootstrap wave's event volume: the buffer
+    # must fill, drop the excess, count it — and leave the trajectory
+    # untouched (same engine, only the buffer differs)
+    n, cap = 16, 64
+    p = engine.SimParams(
+        n=n,
+        checksum_mode="fast",
+        suspicion_ticks=6,
+        flight_recorder=True,
+        event_capacity=cap,
+    )
+    sim = SimCluster(n=n, params=p, seed=1)
+    sim.bootstrap()
+    sim.run(EventSchedule(ticks=6, n=n))
+    assert int(np.asarray(sim.state.ev_head)) == cap
+    drops = sim.event_drops()
+    assert drops > 0
+    events = sim.drain_events(reset=False)
+    assert len(events) == cap
+    # truncation is surfaced on every decoded event
+    assert all(ev.get("truncated_stream") for ev in events)
+    # the honest prefix is still schema-valid and tick-ordered
+    assert obs_events.validate_event_stream(events) == []
+
+
+def test_checkpoint_roundtrip_and_toggle(tmp_path, recorder_pair):
+    sim_on, _, _, _ = recorder_pair
+    path = str(tmp_path / "flight.ckpt")
+    sim_on.save(path)
+    # recorder-on resume: trajectory fields identical, buffer usable
+    re_on = SimCluster(n=N, params=sim_on.params, seed=1)
+    re_on.load(path)
+    assert np.array_equal(
+        np.asarray(re_on.state.known), np.asarray(sim_on.state.known)
+    )
+    assert re_on.state.ev_buf is not None
+    # recorder-off resume drops the telemetry plane, keeps trajectory
+    p_off = sim_on.params._replace(flight_recorder=False)
+    re_off = SimCluster(n=N, params=p_off, seed=1)
+    re_off.load(path)
+    assert re_off.state.ev_buf is None
+    assert np.array_equal(
+        np.asarray(re_off.state.status), np.asarray(sim_on.state.status)
+    )
+
+
+@pytest.mark.slow
+def test_recorder_gate_equivalence_farmhash_1k():
+    """The acceptance's n=1k twin: farmhash parity mode, recorder on vs
+    off, bit-identical trajectory and checksums.  The run window rides
+    the post-bootstrap dissemination wave (~n^2 view adoptions), so the
+    drop-free claim needs a capacity sized to the wave: 2^21 records
+    (64 MiB of int32) holds the ~1M-event window with 2x margin."""
+    n, ticks = 1000, 12
+    sched = EventSchedule(ticks=ticks, n=n)
+    sched.kill[2, 7] = True
+    runs = []
+    for flight in (True, False):
+        p = engine.SimParams(
+            n=n,
+            checksum_mode="farmhash",
+            suspicion_ticks=6,
+            flight_recorder=flight,
+            event_capacity=2**21,
+        )
+        sim = SimCluster(n=n, params=p, seed=3)
+        sim.bootstrap()
+        if flight:
+            # align the event window with the run window: the n=1k
+            # bootstrap wave alone is ~n^2 view-change events, far over
+            # the default capacity — the acceptance drop-free claim is
+            # about the churn window, not the join storm
+            sim.drain_events()
+        sim.run(sched)
+        runs.append(sim)
+    on, off = runs
+    for f in engine.SimState._fields:
+        v_off = getattr(off.state, f)
+        if v_off is None:
+            continue
+        assert np.array_equal(
+            np.asarray(getattr(on.state, f)), np.asarray(v_off)
+        ), f
+    assert on.event_drops() == 0

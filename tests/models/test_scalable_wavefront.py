@@ -1,0 +1,120 @@
+"""Rumor wavefront tracing on the scalable engine.
+
+``ScalableParams(wavefront=True)`` carries a first-heard tick matrix
+through the scan; it must (a) never touch the trajectory, (b) agree
+bit-for-bit with the heard bitmask it mirrors, and (c) yield sane
+dissemination summaries (obs.events.scalable_wavefront_summary)."""
+
+from __future__ import annotations
+
+import numpy as np
+
+import pytest
+
+from ringpop_tpu.models.sim import engine_scalable as es
+from ringpop_tpu.models.sim.storm import ScalableCluster, StormSchedule
+from ringpop_tpu.obs import events as obs_events
+
+N = 32
+# 46 ticks: past max_rumor_age at n=32 (15*2 + 8 = 38), so slot
+# recycling is exercised on the same compiled scan (and the same window
+# shape as tests/obs/test_counter_parity.py keeps tier-1 compile count
+# down)
+TICKS = 46
+
+
+def _run(wavefront: bool, ticks: int = TICKS):
+    sc = ScalableCluster(
+        n=N,
+        params=es.ScalableParams(
+            n=N, u=128, suspicion_ticks=6, wavefront=wavefront
+        ),
+        seed=1,
+    )
+    sched = StormSchedule(ticks=ticks, n=N)
+    sched.kill[3, 5] = True
+    sched.revive[ticks // 2, 5] = True
+    return sc, sc.run(sched)
+
+
+@pytest.fixture(scope="module")
+def wavefront_run():
+    return _run(True)
+
+
+def test_wavefront_is_trajectory_neutral(wavefront_run):
+    sc_on, m_on = wavefront_run
+    sc_off, m_off = _run(False)
+    for f in es.ScalableState._fields:
+        v_off = getattr(sc_off.state, f)
+        if v_off is None:
+            continue
+        assert np.array_equal(
+            np.asarray(getattr(sc_on.state, f)), np.asarray(v_off)
+        ), "state field %r diverged with wavefront tracing on" % f
+    for f in es.ScalableMetrics._fields:
+        assert np.array_equal(
+            np.asarray(getattr(m_on, f)), np.asarray(getattr(m_off, f))
+        ), f
+
+
+def test_first_heard_mirrors_heard_bits(wavefront_run):
+    sc, _ = wavefront_run
+    st = sc.state
+    fh = np.asarray(st.first_heard)
+    heard = np.asarray(st.heard)
+    active = np.asarray(st.r_active)
+    tick = int(np.asarray(st.tick_index))
+    u = fh.shape[1]
+    bits = (
+        (heard[:, :, None] >> np.arange(32, dtype=np.uint32)) & 1
+    ).astype(bool).reshape(fh.shape[0], u)
+    # active rumors: the bit is set iff a first-heard tick is recorded,
+    # and every recorded tick is inside the run
+    assert ((fh >= 0) == bits)[:, active].all()
+    assert (fh[:, active].max() <= tick) if active.any() else True
+    births = np.asarray(st.r_birth)
+    for r in np.nonzero(active)[0]:
+        lat = fh[:, r][fh[:, r] >= 0] - births[r]
+        assert (lat >= 0).all()
+
+
+def test_wavefront_summary_shapes(wavefront_run):
+    sc, _ = wavefront_run
+    summary = sc.wavefront_summary()
+    assert summary["rumors"], "churn window must leave active rumors"
+    for r in summary["rumors"]:
+        curve = r["convergence_curve"]
+        assert all(
+            curve[i][0] < curve[i + 1][0] and curve[i][1] < curve[i + 1][1]
+            for i in range(len(curve) - 1)
+        )
+        assert r["convergence_latency"] >= 0
+        # the kill-era rumor disseminated beyond its publisher
+        assert r["observers"] >= 1
+    assert summary["latency_histogram_ticks"]
+    # derivation helper accepts the raw snapshot too
+    snap = sc.wavefront_snapshot()
+    again = obs_events.scalable_wavefront_summary(
+        snap["first_heard"], snap["r_birth"], snap["r_active"], snap["live"]
+    )
+    assert again == summary
+
+
+def test_recycled_slots_reset_their_history(wavefront_run):
+    # the window runs past max_rumor_age, so the kill-era rumors retire:
+    # recycled slots must come back with a clean (-1) wavefront column
+    sc, m = wavefront_run
+    st = sc.state
+    assert int(np.asarray(m.rumors_retired).sum()) > 0
+    fh = np.asarray(st.first_heard)
+    inactive = ~np.asarray(st.r_active)
+    heard = np.asarray(st.heard)
+    u = fh.shape[1]
+    bits = (
+        (heard[:, :, None] >> np.arange(32, dtype=np.uint32)) & 1
+    ).astype(bool).reshape(fh.shape[0], u)
+    # wherever the heard bit is clear, the wavefront must be unset too
+    # (recycle clears both together)
+    assert (fh[~bits] == -1).all()
+    assert inactive.any()

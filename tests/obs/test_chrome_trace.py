@@ -1,0 +1,121 @@
+"""Chrome-trace exporter + validator unit tests (pure host side)."""
+
+from __future__ import annotations
+
+import json
+
+import numpy as np
+import pytest
+
+from ringpop_tpu.obs import chrome_trace as ct
+from ringpop_tpu.obs import events as ev
+
+
+def _events():
+    rows = [
+        # tick 1: node 0 pings 1 (delivered)
+        [1, ev.EV_PING, 0, 1, -1, -1, 0, 1],
+        # tick 2: node 1 adopts suspicion about node 2 (rumor birth),
+        # node 0 marks the verdict
+        [2, ev.EV_SUSPECT, 1, 2, 0, 1, 3, 0],
+        [2, ev.EV_STATUS, 1, 2, 0, 1, 3, 4],
+        # node 2's own story: it sees itself suspect on tick 3, refutes
+        # on tick 4
+        [3, ev.EV_STATUS, 2, 2, 0, 1, 3, 1],
+        [4, ev.EV_REFUTE, 2, 2, 1, 0, 5, 1],
+        # the rumor spreads to node 0 on tick 4
+        [4, ev.EV_STATUS, 0, 2, 0, 1, 3, 1],
+        # a join and a full sync for instant coverage
+        [5, ev.EV_JOIN, 3, -1, -1, -1, 0, 2],
+        [5, ev.EV_FULL_SYNC, 0, 3, -1, -1, 0, 4],
+    ]
+    buf = np.asarray(rows, np.int32)
+    return ev.decode_events(buf, len(rows))
+
+
+def test_export_parses_and_validates():
+    trace = ct.export_chrome_trace(_events(), n=4, period_ms=200)
+    # round-trips through JSON (the artifact form)
+    blob = json.dumps(trace)
+    assert ct.validate_chrome_trace(blob) == []
+    assert ct.validate_chrome_trace(trace) == []
+    evs = trace["traceEvents"]
+    # one process_name + one thread per node
+    assert sum(1 for e in evs if e["ph"] == "M") == 5
+    # node 2's self story renders alive -> suspect -> alive spans
+    spans = [
+        e["name"] for e in evs if e["ph"] == "X" and e["tid"] == 2
+    ]
+    assert spans == ["alive", "suspect", "alive"]
+    # the suspect rumor about node 2 flows from its origin to observers
+    flows = [e for e in evs if e["ph"] in ("s", "t")]
+    assert any(e["ph"] == "s" for e in flows)
+    assert any(e["ph"] == "t" for e in flows)
+    # instants carry the protocol plane
+    names = {e["name"] for e in evs if e["ph"] == "i"}
+    assert any(x.startswith("suspect") for x in names)
+    assert any(x.startswith("join") for x in names)
+    # pings are opt-in
+    assert not any(x.startswith("ping") for x in names)
+    with_pings = ct.export_chrome_trace(
+        _events(), n=4, period_ms=200, include_pings=True
+    )
+    names2 = {
+        e["name"] for e in with_pings["traceEvents"] if e["ph"] == "i"
+    }
+    assert any(x.startswith("ping") for x in names2)
+
+
+def test_timestamps_scale_with_period():
+    t200 = ct.export_chrome_trace(_events(), n=4, period_ms=200)
+    t500 = ct.export_chrome_trace(_events(), n=4, period_ms=500)
+    x200 = [e for e in t200["traceEvents"] if e["ph"] == "i"][0]
+    x500 = [e for e in t500["traceEvents"] if e["ph"] == "i"][0]
+    assert x500["ts"] * 200 == x200["ts"] * 500
+
+
+def test_addresses_label_tracks():
+    addrs = ["10.0.0.%d:3000" % i for i in range(4)]
+    trace = ct.export_chrome_trace(
+        _events(), n=4, period_ms=200, addresses=addrs
+    )
+    labels = {
+        e["args"]["name"]
+        for e in trace["traceEvents"]
+        if e["ph"] == "M" and e["name"] == "thread_name"
+    }
+    assert labels == set(addrs)
+
+
+def test_validator_catches_broken_traces():
+    assert ct.validate_chrome_trace("{not json") != []
+    assert ct.validate_chrome_trace(42) != []
+    assert ct.validate_chrome_trace({"nope": []}) != []
+    bad_phase = {"traceEvents": [{"ph": "Z", "pid": 1, "tid": 0, "ts": 0}]}
+    assert any("unknown phase" in p for p in ct.validate_chrome_trace(bad_phase))
+    bad_span = {
+        "traceEvents": [
+            {"ph": "X", "pid": 1, "tid": 0, "ts": 0, "name": "x"}
+        ]
+    }
+    assert any("dur" in p for p in ct.validate_chrome_trace(bad_span))
+    orphan_flow = {
+        "traceEvents": [
+            {"ph": "t", "pid": 1, "tid": 0, "ts": 0, "id": 9, "name": "r"}
+        ]
+    }
+    assert any(
+        "no start" in p for p in ct.validate_chrome_trace(orphan_flow)
+    )
+
+
+def test_write_refuses_invalid(tmp_path):
+    with pytest.raises(ValueError):
+        ct.write_chrome_trace(
+            {"traceEvents": [{"ph": "Z", "pid": 0, "tid": 0, "ts": 0}]},
+            str(tmp_path / "bad.trace.json"),
+        )
+    good = ct.export_chrome_trace(_events(), n=4)
+    path = ct.write_chrome_trace(good, str(tmp_path / "ok.trace.json"))
+    with open(path, encoding="utf-8") as fh:
+        assert ct.validate_chrome_trace(json.load(fh)) == []

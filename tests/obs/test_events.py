@@ -1,0 +1,148 @@
+"""Flight-recorder event registry + decoder unit tests (host side only —
+no engine, no jax; the device half is covered by
+tests/models/test_flight_recorder.py)."""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from ringpop_tpu.obs import events as ev
+
+
+def _buf(rows):
+    buf = np.zeros((max(len(rows), 4), ev.RECORD_WIDTH), np.int32)
+    for i, r in enumerate(rows):
+        buf[i] = r
+    return buf
+
+
+def test_registry_is_bijective_and_stable():
+    assert len(ev.EVENT_KINDS) == len(ev.KIND_CODES)
+    for code, name in ev.EVENT_KINDS.items():
+        assert ev.KIND_CODES[name] == code
+    # layout constants must match the record width (device+host contract)
+    assert len(ev.FIELDS) == ev.RECORD_WIDTH
+    assert ev.FIELDS[ev.F_TICK] == "tick"
+    assert ev.FIELDS[ev.F_AUX] == "aux"
+
+
+def test_decode_respects_head_and_flags_truncation():
+    rows = [
+        [1, ev.EV_PING, 0, 3, -1, -1, 0, 1],
+        [1, ev.EV_STATUS, 3, 0, -1, 0, 2, ev.PHASE_PING_RECV],
+        [2, ev.EV_JOIN, 5, -1, -1, -1, 0, 7],
+    ]
+    buf = _buf(rows)
+    assert ev.decode_events(buf, 0) == []
+    two = ev.decode_events(buf, 2)
+    assert len(two) == 2
+    assert two[0]["kind_name"] == "ping"
+    assert two[1]["observer"] == 3 and two[1]["new_status"] == 0
+    assert "truncated_stream" not in two[0]
+    truncated = ev.decode_events(buf, 3, drops=5)
+    assert all(e["truncated_stream"] for e in truncated)
+    # a head beyond capacity clamps instead of exploding
+    assert len(ev.decode_events(buf, 10 ** 6)) == buf.shape[0]
+
+
+def test_decode_rejects_wrong_width():
+    with pytest.raises(ValueError):
+        ev.decode_arrays(np.zeros((4, 3), np.int32), 2)
+
+
+def test_validate_event_stream():
+    good = ev.decode_events(
+        _buf([[1, ev.EV_PING, 0, 1, -1, -1, 0, 1]]), 1
+    )
+    assert ev.validate_event_stream(good) == []
+    bad = [dict(good[0])]
+    bad[0]["kind"] = 99
+    assert any("unknown kind" in p for p in ev.validate_event_stream(bad))
+    decreasing = [dict(good[0], tick=5), dict(good[0], tick=4)]
+    assert any(
+        "decreases" in p for p in ev.validate_event_stream(decreasing)
+    )
+    missing = [{"tick": 1}]
+    assert any(
+        "missing field" in p for p in ev.validate_event_stream(missing)
+    )
+
+
+def test_reconcile_counts_by_kind():
+    rows = [
+        [1, ev.EV_PING, 0, 1, -1, -1, 0, 1],
+        [1, ev.EV_PING, 1, 2, -1, -1, 0, 0],
+        [2, ev.EV_SUSPECT, 0, 2, 0, 1, 3, 0],
+        [2, ev.EV_FULL_SYNC, 1, 0, -1, -1, 0, 4],
+    ]
+    metrics = {
+        "pings_sent": np.asarray([2, 0]),
+        "pings_delivered": np.asarray([1, 0]),
+        "suspects_marked": np.asarray([0, 1]),
+        "full_syncs": np.asarray([0, 1]),
+        "full_sync_records": np.asarray([0, 4]),
+        "faulties_marked": np.asarray([0, 0]),
+        "refutes": np.asarray([0, 0]),
+        "join_merges": np.asarray([0, 0]),
+    }
+    rec = ev.reconcile(ev.decode_events(_buf(rows), 4), metrics)
+    assert all(v["match"] for v in rec.values()), rec
+    bad = dict(metrics, pings_sent=np.asarray([3, 0]))
+    rec2 = ev.reconcile(ev.decode_events(_buf(rows), 4), bad)
+    assert not rec2["pings_sent"]["match"]
+
+
+def test_rumor_wavefronts_and_summary():
+    # rumor (subject=2, status=1, inc=9): born at node 0 on tick 3,
+    # adopted by nodes 1 and 4 on tick 4, node 3 on tick 6
+    rows = [
+        [3, ev.EV_STATUS, 0, 2, 0, 1, 9, 1],
+        [4, ev.EV_STATUS, 1, 2, 0, 1, 9, 1],
+        [4, ev.EV_STATUS, 4, 2, 0, 1, 9, 2],
+        [6, ev.EV_STATUS, 3, 2, 0, 1, 9, 1],
+        # a repeat adoption must not move the first-heard tick
+        [7, ev.EV_STATUS, 1, 2, 0, 1, 9, 4],
+        # an unrelated single-observer rumor
+        [5, ev.EV_STATUS, 0, 7, -1, 0, 11, 1],
+    ]
+    wf = ev.rumor_wavefronts(ev.decode_events(_buf(rows), len(rows)))
+    assert set(wf) == {(2, 1, 9), (7, 0, 11)}
+    big = wf[(2, 1, 9)]
+    assert big["birth"] == 3
+    assert big["first_heard"] == {0: 3, 1: 4, 4: 4, 3: 6}
+    assert big["convergence_curve"] == [(3, 1), (4, 3), (6, 4)]
+    assert big["latency"] == {0: 0, 1: 1, 4: 1, 3: 3}
+    assert big["hops"] == {0: 0, 1: 1, 4: 1, 3: 2}
+    summary = ev.dissemination_summary(wf)
+    assert len(summary["rumors"]) == 1  # min_observers filters the lone one
+    assert summary["latency_histogram_ticks"] == {"0": 1, "1": 2, "3": 1}
+    assert summary["hop_histogram"] == {"0": 1, "1": 2, "2": 1}
+
+
+def test_scalable_wavefront_summary_shape():
+    fh = np.asarray(
+        [
+            [2, -1],
+            [3, -1],
+            [5, -1],
+        ],
+        np.int32,
+    )
+    out = ev.scalable_wavefront_summary(
+        fh,
+        np.asarray([2, 0], np.int32),
+        np.asarray([True, False]),
+    )
+    (r,) = out["rumors"]
+    assert r["slot"] == 0 and r["birth"] == 2
+    assert r["convergence_curve"] == [[2, 1], [3, 2], [5, 3]]
+    assert out["latency_histogram_ticks"] == {"0": 1, "1": 1, "3": 1}
+    # dead nodes are excluded via the live mask
+    out2 = ev.scalable_wavefront_summary(
+        fh,
+        np.asarray([2, 0], np.int32),
+        np.asarray([True, False]),
+        live=np.asarray([True, True, False]),
+    )
+    assert out2["rumors"][0]["observers"] == 2

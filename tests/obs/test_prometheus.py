@@ -109,3 +109,28 @@ def test_render_tick_series_totals_and_gauges():
     # the new counters are all present
     for f in ("refutes", "piggyback_drops", "ping_req_inconclusive"):
         assert "ringpop_sim_%s_total" % f in text
+
+
+def test_help_text_is_escaped_per_exposition_format():
+    """The 0.0.4 text format requires ``\\`` -> ``\\\\`` and newline ->
+    ``\\n`` in HELP lines; unescaped, a newline splits the line and
+    corrupts every sample after it (satellite fix, ISSUE 4)."""
+    from ringpop_tpu.obs.prometheus import PromWriter
+
+    w = PromWriter()
+    w.sample(
+        "x_total",
+        1,
+        help_="line one\nline two \\ backslash",
+        type_="counter",
+    )
+    w.sample("y", 2, help_="plain", labels={"k": 'v"\n\\'})
+    text = w.render()
+    lines = text.splitlines()
+    help_line = next(l for l in lines if l.startswith("# HELP x_total"))
+    assert help_line == "# HELP x_total line one\\nline two \\\\ backslash"
+    # exactly one physical line per logical row: nothing got split
+    assert len([l for l in lines if l.startswith("#")]) == 4
+    assert "x_total 1" in lines
+    # label values keep their own (stricter) escaping, including quotes
+    assert 'y{k="v\\"\\n\\\\"} 2' in lines

@@ -1,0 +1,98 @@
+"""RunRecorder trace sidecars + the extended schema gate
+(scripts/check_metrics_schema.py must validate runlogs AND sidecars AND
+the links between them)."""
+
+from __future__ import annotations
+
+import importlib.util
+import json
+import os
+
+import numpy as np
+
+from ringpop_tpu.obs import chrome_trace as ct
+from ringpop_tpu.obs import events as ev
+from ringpop_tpu.obs.recorder import RunRecorder, validate_run_log
+
+REPO_ROOT = os.path.dirname(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+
+
+def _load_checker():
+    spec = importlib.util.spec_from_file_location(
+        "check_metrics_schema",
+        os.path.join(REPO_ROOT, "scripts", "check_metrics_schema.py"),
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _trace():
+    rows = [
+        [1, ev.EV_PING, 0, 1, -1, -1, 0, 1],
+        [2, ev.EV_STATUS, 1, 2, 0, 1, 3, 1],
+        [3, ev.EV_STATUS, 0, 2, 0, 1, 3, 1],
+    ]
+    events = ev.decode_events(np.asarray(rows, np.int32), len(rows))
+    return ct.export_chrome_trace(events, n=3, period_ms=200)
+
+
+def test_sidecar_written_linked_and_validated(tmp_path):
+    log = str(tmp_path / "run.runlog.jsonl")
+    rec = RunRecorder(log, config={"n": 3})
+    rec.record_tick({"pings_sent": 3})
+    sidecar = rec.record_trace_sidecar(_trace(), name="flight")
+    rec.finish()
+    assert os.path.basename(sidecar) == "run.flight.trace.json"
+    assert validate_run_log(log) == []
+    with open(sidecar, encoding="utf-8") as fh:
+        assert ct.validate_chrome_trace(json.load(fh)) == []
+    # the runlog's trace_sidecar event row points at the file
+    with open(log, encoding="utf-8") as fh:
+        rows = [json.loads(line) for line in fh if line.strip()]
+    links = [
+        r
+        for r in rows
+        if r.get("kind") == "event" and r.get("name") == "trace_sidecar"
+    ]
+    assert len(links) == 1
+    assert links[0]["path"] == os.path.basename(sidecar)
+
+    checker = _load_checker()
+    assert checker.check([log, sidecar], verbose=False) == []
+
+
+def test_checker_catches_broken_sidecar_and_missing_link(tmp_path):
+    checker = _load_checker()
+    bad = tmp_path / "broken.trace.json"
+    bad.write_text('{"traceEvents": [{"ph": "Z"}]}')
+    assert checker.check([str(bad)], verbose=False) != []
+    not_json = tmp_path / "nope.trace.json"
+    not_json.write_text("{")
+    assert any(
+        "not JSON" in p
+        for p in checker.check([str(not_json)], verbose=False)
+    )
+    # a runlog whose sidecar link points at a missing file fails the gate
+    log = str(tmp_path / "orphan.runlog.jsonl")
+    rec = RunRecorder(log, config={})
+    rec.record_event("trace_sidecar", sidecar="flight", path="gone.trace.json")
+    rec.finish()
+    assert any(
+        "missing file" in p for p in checker.check([log], verbose=False)
+    )
+
+
+def test_repo_committed_sidecars_validate():
+    """The tier-1 twin of the standalone gate: every committed sidecar
+    under the repo validates, and the committed flight sample exists so
+    the gate is never vacuous."""
+    checker = _load_checker()
+    sidecars = checker.find_trace_sidecars()
+    assert any(
+        os.path.basename(p).startswith("sample_") for p in sidecars
+    ), "committed sample trace sidecar missing (runlogs/sample_*.trace.json)"
+    problems = checker.check(sidecars, verbose=False)
+    assert problems == [], "\n".join(problems)
