@@ -14,7 +14,10 @@ and the farmhash parity mode (bit-exact reference checksum strings with
 dirty-row caching).  On TPU the bench measures up to four configurations
 (gated fast, straight-line fast, an 8-cluster vmapped batch, farmhash
 parity), roughly quadrupling single-config wall time; on CPU it runs
-gated fast + parity only.
+gated fast + parity only.  A scalable phase (BENCH_SCALABLE=0 opts out)
+additionally measures the O(N·U) storm engine at n=100k: sortless-PRP
+node-ticks/s vs the argsort twin (bitwise-gated A/B) and the fused
+exchange op's achieved GB/s (scalable_* fields).
 
 Baseline: the reference (ringpop-node) runs clusters in real time with a
 200 ms minimum protocol period (lib/gossip/index.js:194-196), i.e. a 1k-node
@@ -129,6 +132,8 @@ def _mode_rate(
     gate: bool = True,
     recorder=None,
     make_schedule=None,
+    fused: "str | None" = None,
+    window: str = "quiet",
 ) -> tuple:
     """One measured window: construct, bootstrap, converge (the round-5
     kernel-fault guard), warm, measure.  ``make_schedule(ticks, n)``
@@ -142,7 +147,17 @@ def _mode_rate(
 
     sim = SimCluster(
         n=n,
-        params=engine.SimParams(n=n, checksum_mode=mode, gate_phases=gate),
+        params=engine.SimParams(
+            n=n,
+            checksum_mode=mode,
+            gate_phases=gate,
+            # None keeps the per-backend auto resolution; an explicit
+            # "on"/"off" pins the fused encode+hash pipeline regardless
+            # of backend (the churn window passes "on": the round-7 CPU
+            # artifact's 0.66x regression was the auto "off" pick routing
+            # churn re-encodes through the ~3 MB/s XLA byte assembly)
+            fused_checksum=fused if fused is not None else "auto",
+        ),
     )
     sim.bootstrap()
     # converge via SINGLE steps before any long scan: a 256-tick scan
@@ -189,6 +204,10 @@ def _mode_rate(
             mode=mode,
             gate_phases=gate,
             converged_in=converged_in,
+            window=window,
+            # pin the RESOLVED fused mode per window: the churn number is
+            # only interpretable against the encode pipeline that ran
+            fused_checksum=sim.params.fused_checksum,
         )
         recorder.record_ticks(metrics)
         recorder.record_phase("measure[%s]" % mode, elapsed)
@@ -227,23 +246,104 @@ def _mode_rate(
     )
 
 
-def _churn_rate(n: int, ticks: int) -> tuple:
+def _churn_rate(n: int, ticks: int, recorder=None) -> tuple:
     """Parity-mode throughput for a window with churn INSIDE it (the
     shared EventSchedule.churn_window shape: kill wave early, revive at
     mid-window).  Same measurement protocol as every other window —
     _mode_rate with a schedule override.  Returns (rate, elapsed,
     replays_in_window, extras); the round-5 catastrophic case was
     overflow replays collapsing this to ~731 node-ticks/s — the fused
-    bounded recompute must hold >= 1x real-time with zero replays."""
+    bounded recompute must hold >= 1x real-time with zero replays.
+
+    fused="on" on EVERY backend (round 10): the auto resolution keeps
+    fused off on CPU — right for quiet windows, where the gated
+    recompute skips encode work entirely, but the round-7 CPU artifact
+    showed the churn window re-encoding dirty rows through the XLA byte
+    assembly at 3.2 MB/s (0.66x real-time) while the fused pipeline's
+    pure-XLA twin encodes at ~522 MB/s on the same image
+    (PROF_PARITY_ROOFLINE.json).  With fused on the committed round-10
+    artifact (BENCH_r10_cpu.json) measures the CPU churn window at
+    8,252 node-ticks/s (1.61x real-time) vs the round-7 3,378 (0.66x),
+    zero replays either way.  The pinned mode lands in the artifact
+    (churn_parity_fused) and the runlog's window event."""
     from ringpop_tpu.models.sim.cluster import EventSchedule
 
     rate, elapsed, _, replays, extras = _mode_rate(
         n,
         ticks,
         "farmhash",
+        recorder=recorder,
         make_schedule=EventSchedule.churn_window,
+        fused="on",
+        window="churn",
     )
     return rate, elapsed, replays, extras
+
+
+def _scalable_rate(
+    n: int, ticks: int, perm_impl: str, recorder=None
+) -> tuple:
+    """Storm node-ticks/s for the O(N·U) scalable engine (round 10's
+    hot-path rewrite): one churn-storm window (10% kill + rejoin —
+    StormSchedule.churn_storm, the north-star 1M shape) through the
+    scanned ScalableCluster driver.  ``perm_impl`` selects the partner
+    permutation ("auto" resolves sortless; "argsort" is the A/B twin —
+    same PRP values, inverse by argsort, bit-identical trajectories), so
+    calling this twice gives the sortless-vs-argsort headline.  Returns
+    (rate, elapsed, cluster) — the cluster so the caller can A/B final
+    states bitwise and reuse the heard mask for the exchange GB/s
+    probe."""
+    import jax
+
+    from ringpop_tpu.models.sim import engine_scalable as es
+    from ringpop_tpu.models.sim.storm import ScalableCluster, StormSchedule
+
+    params = es.ScalableParams(n=n, perm_impl=perm_impl)
+    sc = ScalableCluster(n=n, params=params, seed=0)
+    sched = StormSchedule.churn_storm(
+        ticks, n, fraction=0.10, fail_tick=1, seed=0
+    )
+    sc.run(sched)  # compile + warm (donated state: run overwrites it)
+    jax.block_until_ready(sc.state)
+    t0 = time.perf_counter()
+    with _profile_ctx("scalable-%s" % perm_impl, recorder=recorder):
+        ms = sc.run(sched)
+        jax.block_until_ready(sc.state)
+    elapsed = time.perf_counter() - t0
+    if recorder is not None:
+        # after the clock stops, like every other window
+        recorder.record_event(
+            "window",
+            mode="scalable_storm",
+            window="churn_storm",
+            perm_impl=sc.params.perm_impl,
+            fused_exchange=sc.params.fused_exchange,
+        )
+        recorder.record_ticks(ms)
+        recorder.record_phase(
+            "measure[scalable:%s]" % sc.params.perm_impl, elapsed
+        )
+    return n * ticks / elapsed, elapsed, sc
+
+
+def _exchange_gbps(heard, r_delta) -> tuple:
+    """Achieved bandwidth of the fused exchange op on the storm's own
+    [N, U/32] mask shape — the shared in-scan probe + one-pass traffic
+    model (ops.exchange.measure_bandwidth / step_traffic_bytes; same
+    numbers convention as PROF_EXCHANGE_ROOFLINE.json and the
+    tpu_measure fused_exchange phase).  Returns (gbps, impl)."""
+    import jax
+    import jax.numpy as jnp
+
+    from ringpop_tpu.ops import exchange as exch
+
+    impl = "pallas" if jax.default_backend() == "tpu" else "xla"
+    pulled = jnp.roll(heard, 1, axis=0)
+    pushed = jnp.roll(heard, -1, axis=0)
+    gbps, _sec = exch.measure_bandwidth(
+        heard, pulled, pushed, r_delta, impl=impl
+    )
+    return gbps, impl
 
 
 def _batched_rate(b: int, n: int, ticks: int) -> tuple:
@@ -378,6 +478,65 @@ def _measure_recorded(n: int, ticks: int, platform: str, recorder) -> dict:
                 type(exc).__name__,
                 str(exc)[:300],
             )
+    # scalable phase (BENCH_SCALABLE=0 opts out): the O(N·U) storm
+    # engine at n=100k — the round-10 sortless-PRP + fused-exchange hot
+    # path A/B'd against the argsort twin (bit-identical trajectories:
+    # the final states are compared bitwise right here), plus the fused
+    # exchange op's achieved GB/s on the storm's own mask shape.
+    # Acceptance (round 10): sortless no worse than argsort, exchange
+    # GB/s in the artifact + runlog.
+    if os.environ.get("BENCH_SCALABLE", "1") == "1":
+        try:
+            sn = int(os.environ.get("BENCH_SCALABLE_N", "100000"))
+            sticks = int(os.environ.get("BENCH_SCALABLE_TICKS", "8"))
+            s_rate, _s_el, sc = _retry_helper_500(
+                _scalable_rate, sn, sticks, "auto", recorder=recorder
+            )
+            a_rate, _a_el, sa = _retry_helper_500(
+                _scalable_rate, sn, sticks, "argsort", recorder=recorder
+            )
+            gbps, ex_impl = _exchange_gbps(sc.state.heard, sc.state.r_delta)
+            result["scalable_n"] = sn
+            result["scalable_ticks"] = sticks
+            result["scalable_perm_impl"] = sc.params.perm_impl
+            result["scalable_fused_exchange"] = sc.params.fused_exchange
+            result["scalable_node_ticks_per_sec"] = round(s_rate, 1)
+            result["scalable_argsort_node_ticks_per_sec"] = round(a_rate, 1)
+            result["scalable_vs_argsort"] = round(s_rate / a_rate, 2)
+            # device-level gate: same seed + schedule, so the A/B final
+            # states must match bit-for-bit (perm_impl is trajectory-
+            # neutral by construction — this catches a backend-specific
+            # divergence the CPU test suite can't)
+            result["scalable_bitwise_equal"] = bool(
+                (np.asarray(sc.state.heard) == np.asarray(sa.state.heard))
+                .all()
+                and (
+                    np.asarray(sc.state.checksum)
+                    == np.asarray(sa.state.checksum)
+                ).all()
+                and (
+                    np.asarray(sc.state.truth_status)
+                    == np.asarray(sa.state.truth_status)
+                ).all()
+            )
+            result["scalable_exchange_gbps"] = round(gbps, 2)
+            result["scalable_exchange_impl"] = ex_impl
+            if recorder is not None:
+                recorder.record_event(
+                    "exchange_roofline",
+                    gbps=round(gbps, 2),
+                    impl=ex_impl,
+                    n=sn,
+                    words=int(sc.state.heard.shape[1]),
+                )
+        except Exception as exc:
+            if _is_transient(exc):
+                raise
+            result["scalable_error"] = "%s: %s" % (
+                type(exc).__name__,
+                str(exc)[:300],
+            )
+
     # parity mode: bit-exact reference FarmHash32 string checksums in the
     # same compiled tick — the north-star config.  Not allowed to sink
     # the whole artifact: the tunneled chip's remote compile helper
@@ -428,7 +587,9 @@ def _measure_recorded(n: int, ticks: int, platform: str, recorder) -> dict:
                     churn_el,
                     churn_replays,
                     churn_ex,
-                ) = _retry_helper_500(_churn_rate, n, parity_ticks)
+                ) = _retry_helper_500(
+                    _churn_rate, n, parity_ticks, recorder=recorder
+                )
                 result["churn_parity_node_ticks_per_sec"] = round(
                     churn_rate, 1
                 )
@@ -436,6 +597,7 @@ def _measure_recorded(n: int, ticks: int, platform: str, recorder) -> dict:
                     churn_rate / baseline, 2
                 )
                 result["churn_parity_replays_in_window"] = churn_replays
+                result["churn_parity_fused"] = churn_ex["fused"]
                 result["churn_parity_encode_mbps"] = round(
                     churn_ex["rows_hashed"] * churn_ex["row_string_bytes"]
                     / churn_el

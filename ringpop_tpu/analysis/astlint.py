@@ -57,6 +57,7 @@ TRACED_ENTRIES: Dict[str, Set[str]] = {
         "farmhash_truth_checksum",
     },
     "ops/jax_farmhash.py": {"hash32_rows"},
+    "ops/exchange.py": {"exchange", "exchange_xla"},
     "ops/fused_checksum.py": {"membership_checksums", "fused_hash_rows"},
     "ops/checksum_encode.py": {"membership_rows", "ring_rows"},
     "ops/pallas_farmhash.py": {

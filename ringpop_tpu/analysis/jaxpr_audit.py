@@ -19,9 +19,11 @@ no compile) and walks the resulting jaxprs, recursively through ``pjit`` /
   the reportable boundary.
 
 Entry points covered (``default_entries``): the scanned full-fidelity
-tick, the O(N·U) scalable tick, the fused checksum pipeline (both the
-Pallas streaming kernel and its pure-XLA twin), the farmhash block walk
-(scan and Pallas lowerings), and the ring device lookup.
+tick, the O(N·U) scalable tick (classic and sortless+fused-exchange
+shapes), the fused checksum pipeline (both the Pallas streaming kernel
+and its pure-XLA twin), the fused push-pull exchange op (Pallas kernel
+and XLA twin), the farmhash block walk (scan and Pallas lowerings), and
+the ring device lookup.
 """
 
 from __future__ import annotations
@@ -361,10 +363,18 @@ def _entry_engine_tick_scan(
 
 def _entry_engine_scalable_tick(
     wavefront: bool = False,
+    perm_impl: str = "auto",
+    fused_exchange: str = "auto",
 ) -> Tuple[Callable, Tuple]:
     from ringpop_tpu.models.sim import engine_scalable as es
 
-    params = es.ScalableParams(n=8, u=128, wavefront=wavefront)
+    params = es.ScalableParams(
+        n=8,
+        u=128,
+        wavefront=wavefront,
+        perm_impl=perm_impl,
+        fused_exchange=fused_exchange,
+    )
     state = es.init_state(params, seed=0)
     inputs = es.ChurnInputs.quiet(8)
 
@@ -372,6 +382,33 @@ def _entry_engine_scalable_tick(
         return es.tick(state, inputs, params)
 
     return one, (state, inputs)
+
+
+def _exchange_args(n: int = 8, w: int = 4, seed: int = 3):
+    import jax.numpy as jnp
+    import numpy as np
+
+    rng = np.random.default_rng(seed)
+
+    def u32(shape):
+        return jnp.asarray(
+            rng.integers(0, 2**32, size=shape, dtype=np.uint32)
+        )
+
+    return u32((n, w)), u32((n, w)), u32((n, w)), u32((w * 32,))
+
+
+def _entry_exchange(impl: str) -> Tuple[Callable, Tuple]:
+    """The fused push-pull exchange op (ops.exchange) — both the Pallas
+    megakernel (traced in interpret-free form; tracing never compiles)
+    and its bit-exact pure-XLA twin must stay callback-free with the
+    whole delta path in uint32 lanes."""
+    from ringpop_tpu.ops import exchange as exch
+
+    def fused(heard, pulled, pushed, r_delta):
+        return exch.exchange(heard, pulled, pushed, r_delta, impl=impl)
+
+    return fused, _exchange_args()
 
 
 def _fused_args(n: int = 8, b: int = 4, seed: int = 0):
@@ -474,6 +511,16 @@ DEFAULT_ENTRIES: List[EntryPoint] = [
         "engine-scalable-tick-wavefront",
         lambda: _entry_engine_scalable_tick(wavefront=True),
     ),
+    # the round-10 hot-path rewrite: the sortless-PRP + fused-exchange
+    # tick must hold the same purity/uint32 gates as the classic shape
+    EntryPoint(
+        "engine-scalable-tick-fused",
+        lambda: _entry_engine_scalable_tick(
+            perm_impl="sortless", fused_exchange="xla"
+        ),
+    ),
+    EntryPoint("exchange-xla", lambda: _entry_exchange("xla")),
+    EntryPoint("exchange-pallas", lambda: _entry_exchange("pallas")),
     EntryPoint("fused-checksum-xla", lambda: _entry_fused_checksum("xla")),
     EntryPoint(
         "fused-checksum-pallas", lambda: _entry_fused_checksum("pallas")

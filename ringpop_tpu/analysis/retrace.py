@@ -324,10 +324,58 @@ def _probe_engine_scalable_tick() -> Tuple[Callable, List[Tuple[str, Tuple]]]:
     ]
 
 
+def _probe_exchange_xla() -> Tuple[Callable, List[Tuple[str, Tuple]]]:
+    import functools
+
+    import jax
+
+    from ringpop_tpu.analysis import jaxpr_audit as ja
+    from ringpop_tpu.ops import exchange as exch
+
+    fn = jax.jit(functools.partial(exch.exchange, impl="xla"))
+    return fn, [
+        ("[8,4] values A", ja._exchange_args(8, 4, 0)),
+        ("[8,4] values B (expect cache hit)", ja._exchange_args(8, 4, 1)),
+        ("[16,4] more rows (expect recompile)", ja._exchange_args(16, 4, 2)),
+    ]
+
+
+def _probe_engine_scalable_tick_fused() -> (
+    "Tuple[Callable, List[Tuple[str, Tuple]]]"
+):
+    import functools
+
+    import jax
+    import jax.numpy as jnp
+
+    from ringpop_tpu.models.sim import engine_scalable as es
+
+    # the round-10 hot path: sortless PRP + fused exchange (XLA twin —
+    # backend-portable cache counts; the Pallas lowering shares the same
+    # jit cache discipline, the op is selected at trace time)
+    params = es.ScalableParams(
+        n=8, u=128, perm_impl="sortless", fused_exchange="xla"
+    )
+    fn = jax.jit(functools.partial(es.tick, params=params))
+    state = es.init_state(params, seed=0)
+    quiet = es.ChurnInputs.quiet(8)
+    churn = quiet._replace(kill=jnp.zeros(8, bool).at[2].set(True))
+    parted = quiet._replace(partition=jnp.zeros(8, jnp.int32))
+    return fn, [
+        ("n=8 quiet tick", (state, quiet)),
+        ("n=8 churn tick, same structure (expect cache hit)", (state, churn)),
+        ("n=8 partition plane present (expect recompile)", (state, parted)),
+    ]
+
+
 DEFAULT_PROBES: List[Probe] = [
     Probe("farmhash-scan", _probe_farmhash_scan),
     Probe("fused-checksum-xla", _probe_fused_checksum_xla),
     Probe("ring-device-lookup", _probe_ring_lookup),
     Probe("engine-tick", _probe_engine_tick),
     Probe("engine-scalable-tick", _probe_engine_scalable_tick),
+    Probe("exchange-xla", _probe_exchange_xla),
+    Probe(
+        "engine-scalable-tick-fused", _probe_engine_scalable_tick_fused
+    ),
 ]

@@ -42,6 +42,13 @@ _TRAJECTORY_NEUTRAL_PARAMS = frozenset(
         "flight_recorder",
         "event_capacity",
         "wavefront",
+        # round-10 scalable hot path: both knobs are bit-identical by
+        # the gate-equivalence tests (tests/models/test_scalable_perm.py),
+        # and drivers pin backend-resolved values at construction — a
+        # TPU-saved checkpoint (fused_exchange="pallas") must load on a
+        # CPU resume ("off"), and pre-round-10 checkpoints lack the keys
+        "perm_impl",
+        "fused_exchange",
     }
 )
 # v2: incarnation fields are int32 tick stamps (engine.stamp_to_ms), not

@@ -91,6 +91,10 @@ import jax.numpy as jnp
 import numpy as np
 
 from ringpop_tpu.models.sim.gating import phase as _phase
+# ops never imports models, so no cycle: the exchange megakernel module
+# supplies both the fused op and the ONE shared SWAR popcount
+from ringpop_tpu.ops import exchange as _exchange
+from ringpop_tpu.ops.exchange import popcount_u32 as _popcount
 from ringpop_tpu.ops.record_mix import record_mix
 
 ALIVE, SUSPECT, FAULTY, LEAVE = 0, 1, 2, 3
@@ -128,6 +132,27 @@ class ScalableParams(NamedTuple):
     # sync cost per boundary.  Bitwise-identical trajectories either way
     # (each gated branch is a masked no-op on empty inputs).
     gate_phases: bool = True
+    # Partner-permutation implementation (round 10): "sortless" evaluates
+    # the per-tick base permutation as a keyed Feistel PRP over [0, N)
+    # with an ANALYTIC inverse — no argsort, no inv = argsort(perm) (the
+    # two dominant per-tick sorts at 1M, see the round-4 note at _perm).
+    # "argsort" is the A/B + gate-equivalence twin: the SAME PRP values,
+    # but the inverse materialized by argsort — bit-identical
+    # trajectories (argsort of a bijection over [0, n) IS its inverse),
+    # so the twin doubles as the device-level equivalence gate.  "auto"
+    # resolves to "sortless" everywhere (resolve_perm_impl).
+    perm_impl: str = "auto"
+    # Fused exchange megakernel (round 10): "pallas" routes the direct
+    # push-pull OR + new-bit diff + popcount + checksum delta-sum
+    # through ops.exchange's gridless kernel (one HBM read of the heard
+    # mask instead of one per phase); "xla" routes the same call through
+    # the op's bit-exact pure-XLA twin; "off" keeps the classic inline
+    # phases.  All three are bit-identical (exact mod-2^32 arithmetic
+    # everywhere — the acceptance gate); "auto" resolves per backend
+    # (resolve_fused_exchange): "pallas" on TPU, "off" elsewhere
+    # (interpret-mode Pallas would be a slowdown, and the CPU's limb
+    # matmul is already exact).
+    fused_exchange: str = "auto"
     # Rumor wavefront tracing: when True the state carries a first-heard
     # tick matrix ``first_heard[i, r]`` — the tick node i's heard bit
     # for rumor slot r turned on (-1 = never; reset when the slot is
@@ -251,7 +276,14 @@ def _fold(key: jax.Array, salt: int) -> jax.Array:
 
 
 def _perm(key: jax.Array, n: int, salt: int) -> jax.Array:
-    """Random permutation of [0, n) via sort of per-index random keys."""
+    """Random permutation of [0, n) via sort of per-index random keys.
+
+    LEGACY family (pre-round-10), retained for the perm-cost
+    measurement harness (scripts/prof_r4.py) and as the documented
+    reference point of the deviation-envelope note below; the engine's
+    tick now draws its base permutation from :func:`_prp_perm` (see
+    ScalableParams.perm_impl and the round-10 note below) and nothing
+    in the tick calls this."""
     r = _rand_u32(key, (n,), salt)
     return jnp.argsort(
         r.astype(jnp.uint32) ^ jnp.arange(n, dtype=jnp.uint32)
@@ -277,6 +309,149 @@ def _perm(key: jax.Array, n: int, salt: int) -> jax.Array:
 # intermediary sets of nodes i and i+c coincide shifted — both inside
 # the documented pseudo-randomness envelope (SURVEY.md §7 hard part 4);
 # base is a fresh uniform permutation every tick.
+#
+# NOTE (round-10 measurement): even the ONE remaining argsort (+ the one
+# inverse sort in "argsort" twin mode) is gone by default.  The base
+# permutation is now a keyed 4-round Feistel PRP over the index bits
+# with cycle-walking for non-power-of-two N (_prp_perm): O(N) elementwise
+# uint32 mixing with an ANALYTIC inverse (run the rounds backwards, walk
+# the cycle with the inverse map), replacing the O(N log N) sorts that
+# the round-4 note identified as the dominant per-tick cost at 1M.  The
+# rotation family above is UNCHANGED — it sits on top of whichever base
+# the tick draws, so the K-distinct-partners fidelity property is
+# preserved.  Deviation envelope vs the argsort-of-random-keys family:
+# a 4-round Feistel with per-tick random round keys is a keyed bijection
+# family, not a uniform draw over all n! permutations — its per-position
+# marginals are statistically uniform (chi-square-pinned in
+# tests/models/test_scalable_perm.py) and the key is folded fresh every
+# tick, but permutations within the family carry the Feistel's algebraic
+# structure.  This sits inside the same SURVEY.md §7 hard-part-4
+# pseudo-randomness envelope as the rotation reuse above: the protocol
+# consumes the permutation only as "K distinct pseudo-random partners
+# per node per tick".  The argsort twin (perm_impl="argsort") keeps the
+# SAME PRP values and materializes only the inverse by argsort — since
+# the cycle-walked PRP is a bijection over [0, n), argsort of its value
+# vector IS its inverse, so the two modes are bit-identical end to end
+# (the gate-equivalence tests compare whole trajectories) and the twin
+# doubles as the on-chip A/B baseline.
+
+_PRP_ROUNDS = 4
+
+
+def _prp_f(r: jax.Array, k: jax.Array, mask: jax.Array) -> jax.Array:
+    """Feistel round function: uint32 mixing (lowbias32-style constants —
+    deliberately NOT the FarmHash mixing constants, which seed the jaxpr
+    auditor's hash-dataflow taint) truncated to the half-width."""
+    x = r * jnp.uint32(0x7FEB352D) + k
+    x ^= x >> 15
+    x *= jnp.uint32(0x846CA68B)
+    x ^= x >> 13
+    return x & mask
+
+
+def _prp_half_bits(n: int) -> int:
+    """Half-width of the Feistel domain: smallest hb with 4^hb >= n."""
+    return max(1, (max(n - 1, 1).bit_length() + 1) // 2)
+
+
+def _prp_apply(
+    v: jax.Array, keys: jax.Array, hb: int, inverse: bool = False
+) -> jax.Array:
+    """One full PRP pass over the 2*hb-bit domain (a bijection on
+    [0, 4^hb)); ``inverse`` runs the rounds backwards."""
+    mask = jnp.uint32((1 << hb) - 1)
+    left = v >> hb
+    right = v & mask
+    if not inverse:
+        for r in range(_PRP_ROUNDS):
+            left, right = right, left ^ _prp_f(right, keys[r], mask)
+    else:
+        for r in reversed(range(_PRP_ROUNDS)):
+            left, right = right ^ _prp_f(left, keys[r], mask), left
+    return (left << hb) | right
+
+
+def _prp_perm(
+    key: jax.Array, n: int, salt: int, inverse: bool = False
+) -> jax.Array:
+    """[N] int32 keyed bijection over [0, n): 4-round Feistel on the
+    index bits, cycle-walked back into range for ragged n (the domain is
+    the next power of four, < 4n, so the expected walk is O(1) steps and
+    the while_loop's worst case is the longest out-of-range run of the
+    keyed cycle — O(log n) w.h.p.).  ``inverse=True`` evaluates the
+    analytic inverse: the backwards rounds walked with the inverse map
+    (cycle-walking inverts cycle-walking).  No argsort anywhere."""
+    hb = _prp_half_bits(n)
+    keys = _rand_u32(key, (_PRP_ROUNDS,), salt)
+    nn = jnp.uint32(n)
+    x = _prp_apply(jnp.arange(n, dtype=jnp.uint32), keys, hb, inverse)
+    x = jax.lax.while_loop(
+        lambda v: jnp.any(v >= nn),
+        lambda v: jnp.where(v >= nn, _prp_apply(v, keys, hb, inverse), v),
+        x,
+    )
+    return x.astype(jnp.int32)
+
+
+def resolve_perm_impl(params: "ScalableParams", backend: str) -> str:
+    """Resolve ``perm_impl="auto"`` to a concrete "sortless"/"argsort".
+    Sortless everywhere: the PRP is O(N) elementwise on every backend
+    and the values are identical either way — the argsort twin exists
+    for A/B measurement and the gate-equivalence proof, not as a
+    production choice."""
+    if params.perm_impl != "auto":
+        if params.perm_impl not in ("sortless", "argsort"):
+            raise ValueError(
+                "perm_impl must be auto|sortless|argsort, got %r"
+                % (params.perm_impl,)
+            )
+        return params.perm_impl
+    return "sortless"
+
+
+def resolve_fused_exchange(params: "ScalableParams", backend: str) -> str:
+    """Resolve ``fused_exchange="auto"`` per backend: "pallas" on TPU
+    (the megakernel's one-HBM-pass win), "off" elsewhere — the CPU's
+    inline phases + MXU-limb delta matmul are already exact and
+    interpret-mode Pallas would be a slowdown.  "xla" (the op twin) is
+    never auto-picked: it exists for A/B and the equivalence gates."""
+    if params.fused_exchange != "auto":
+        if params.fused_exchange not in ("pallas", "xla", "off"):
+            raise ValueError(
+                "fused_exchange must be auto|pallas|xla|off, got %r"
+                % (params.fused_exchange,)
+            )
+        return params.fused_exchange
+    return "pallas" if backend == "tpu" else "off"
+
+
+def resolve_scalable_params(
+    params: "ScalableParams", backend: str
+) -> "ScalableParams":
+    """Driver-level pin of the trace-time "auto" knobs (ScalableCluster /
+    ShardedStorm construction), the engine_scalable analog of
+    engine.resolve_auto_parity: the shared executable caches key on
+    params, so drivers pin concrete values up front.  Direct engine
+    users may keep "auto" — tick() resolves at trace time."""
+    return params._replace(
+        perm_impl=resolve_perm_impl(params, backend),
+        fused_exchange=resolve_fused_exchange(params, backend),
+    )
+
+
+def _base_perm_pair(
+    key: jax.Array, n: int, impl: str, salt: int
+) -> tuple[jax.Array, jax.Array]:
+    """The tick's base permutation and its inverse.  "sortless": both
+    analytic (zero sorts).  "argsort": same forward values, inverse via
+    argsort — bit-identical (argsort of a bijection over [0, n) is its
+    inverse; on-chip the round-4 note's measured ~0.03 ms at 1M)."""
+    fwd = _prp_perm(key, n, salt)
+    if impl == "argsort":
+        inv = jnp.argsort(fwd).astype(jnp.int32)
+    else:
+        inv = _prp_perm(key, n, salt, inverse=True)
+    return fwd, inv
 
 
 def _pack_mask(bits: jax.Array) -> jax.Array:
@@ -285,13 +460,6 @@ def _pack_mask(bits: jax.Array) -> jax.Array:
     w = bits.reshape(u // WORD, WORD)
     weights = (jnp.uint32(1) << jnp.arange(WORD, dtype=jnp.uint32))[None, :]
     return jnp.sum(jnp.where(w, weights, 0), axis=1, dtype=jnp.uint32)
-
-
-def _popcount(x: jax.Array) -> jax.Array:
-    x = x - ((x >> 1) & jnp.uint32(0x55555555))
-    x = (x & jnp.uint32(0x33333333)) + ((x >> 2) & jnp.uint32(0x33333333))
-    x = (x + (x >> 4)) & jnp.uint32(0x0F0F0F0F)
-    return (x * jnp.uint32(0x01010101)) >> 24
 
 
 def max_rumor_age(params: ScalableParams) -> int:
@@ -694,21 +862,19 @@ def tick(
     )
 
     # ---- gossip exchange: push-pull over K random pairings -------------
-    # The K per-round pairings are ROTATIONS of one fresh random base
-    # permutation: partner_k[i] = base[(i + c_k) mod n].  One argsort +
-    # one scatter-inverse per tick replaces K argsorts + K argsort-
-    # inverses (the dominant per-tick cost at 1M), and for a fixed node
-    # the direct target and the K-1 intermediaries are always distinct —
-    # the reference samples ping-req members without replacement and
-    # excludes the target (ping-req-sender.js:293-296).  See the
-    # deviation-envelope note at _perm.
+    # The K per-round pairings are ROTATIONS of one fresh base
+    # permutation: partner_k[i] = base[(i + c_k) mod n] — for a fixed
+    # node the direct target and the K-1 intermediaries are always
+    # distinct, matching the reference's sample-without-replacement
+    # ping-req member pick (ping-req-sender.js:293-296).  Since round 10
+    # the base itself is SORTLESS by default: a keyed Feistel PRP with
+    # an analytic inverse replaces the per-tick argsort + argsort-
+    # inverse (the dominant per-tick cost at 1M).  See the deviation-
+    # envelope notes at _perm; perm_impl="argsort" keeps the same values
+    # with an argsort-materialized inverse as the A/B twin.
     k_total = 1 + params.ping_req_size
-    base_perm = _perm(rng, n, salt=0xA11CE)
-    # inverse by argsort, NOT scatter: measured on the v5e chip at 1M,
-    # argsort of a permutation is ~0.03 ms while the equivalent scatter
-    # is ~23 ms (PROF_R4.json inv_argsort_ms / inv_scatter_ms) — XLA's
-    # TPU sort is heavily optimized, scatters are not
-    inv_base = jnp.argsort(base_perm).astype(jnp.int32)
+    perm_impl = resolve_perm_impl(params, jax.default_backend())
+    base_perm, inv_base = _base_perm_pair(rng, n, perm_impl, salt=0xA11CE)
     offs = [(k * (n // k_total)) % n for k in range(k_total)]  # static
 
     # mod-n via range-correcting selects, not `%`: TPU vector units have
@@ -745,13 +911,34 @@ def tick(
     # pull: i ORs partner's heard set; push: partner ORs i's set.  The
     # push scatter i -> partner[i] is a gather by the inverse
     # permutation (partner is a permutation: no write conflicts).
-    pulled = jnp.where(direct_ok[:, None], state.heard[partner0], 0)
-    pushed = jnp.where(
-        direct_ok[inv_base][:, None], state.heard[inv_base], 0
+    fused_ex = resolve_fused_exchange(params, jax.default_backend())
+    pulled = (
+        jnp.where(direct_ok[:, None], state.heard[partner0], 0)
+        & active_words[None, :]
     )
-    new_heard = state.heard | (pulled & active_words[None, :]) | (
-        pushed & active_words[None, :]
+    pushed = (
+        jnp.where(direct_ok[inv_base][:, None], state.heard[inv_base], 0)
+        & active_words[None, :]
     )
+    if fused_ex == "off":
+        new_heard = state.heard | pulled | pushed
+        d_direct = None
+    else:
+        # fused megakernel (ops.exchange): OR + new-bit diff + popcount
+        # + checksum delta-sum in one pass over the mask — the direct
+        # round's [N, U/32] temporaries never reach HBM.  Exact mod-2^32
+        # arithmetic, so csum stays bit-identical to the inline path.
+        # want_counts=False: the tick consumes only the mask + delta —
+        # the per-row popcount and its [N] output drop out of the program
+        new_heard, d_direct, _nb = _exchange.exchange(
+            state.heard,
+            pulled,
+            pushed,
+            state.r_delta,
+            impl=fused_ex,
+            want_counts=False,
+        )
+    heard_after_direct = new_heard
 
     # indirect rounds (the ping-req fanout) + probe evidence: only nodes
     # whose direct ping failed participate, so on the common all-healthy
@@ -800,21 +987,41 @@ def tick(
     # incremental checksum, exchange diff: every newly-set heard bit adds
     # its rumor's delta.  Bits only turn ON in an exchange and only for
     # active rumors, so the XOR is exactly the new-bit mask; converged
-    # ticks (no new bits anywhere) skip the O(N*U) reduction.
-    diff = new_heard ^ state.heard
+    # ticks (no new bits anywhere) skip the O(N*U) reduction.  In fused
+    # mode the direct round's delta already came back from the kernel
+    # (one pass with the OR), so only the rare indirect rounds' new bits
+    # remain — summing the two disjoint bit sets separately is exact mod
+    # 2^32, hence bit-identical to the single-diff inline path.
+    if fused_ex == "off":
+        diff_all = new_heard ^ state.heard
 
-    def _diff_add(c):
-        return c + _bit_delta_sum(diff, state.r_delta, u)
+        def _diff_add(c):
+            return c + _bit_delta_sum(diff_all, state.r_delta, u)
 
-    csum = _phase(gate, jnp.any(diff != 0), _diff_add, lambda c: c, csum)
+        csum = _phase(
+            gate, jnp.any(diff_all != 0), _diff_add, lambda c: c, csum
+        )
+    else:
+        csum = csum + d_direct
+        ind_diff = new_heard ^ heard_after_direct
+
+        def _diff_add(c):
+            return c + _bit_delta_sum(ind_diff, state.r_delta, u)
+
+        csum = _phase(
+            gate, jnp.any(ind_diff != 0), _diff_add, lambda c: c, csum
+        )
+        diff_all = None  # only the wavefront plane needs the full diff
     # wavefront: every newly-set heard bit stamps its first-heard tick.
     # Straight-line (not gated): the stamp is a masked no-op when no
     # bits turned on, so gatings stay bit-identical.
     fh = state.first_heard
     if fh is not None:
+        if diff_all is None:
+            diff_all = new_heard ^ state.heard
         bit_ids = jnp.arange(WORD, dtype=jnp.uint32)[None, None, :]
         new_bits = (
-            ((diff[:, :, None] >> bit_ids) & jnp.uint32(1)) != 0
+            ((diff_all[:, :, None] >> bit_ids) & jnp.uint32(1)) != 0
         ).reshape(n, u)
         fh = jnp.where(new_bits, t, fh)
     state = state._replace(heard=new_heard, first_heard=fh)

@@ -122,12 +122,19 @@ def ring_checksum(ring: jax.Array) -> jax.Array:
 
 @functools.lru_cache(maxsize=None)
 def _tick_fn(params: es.ScalableParams):
-    return jax.jit(functools.partial(es.tick, params=params))
+    # donate the state: the tick's output state reuses the input's
+    # buffers (the [N, U/32] heard mask updates in place instead of
+    # allocating a second copy per tick — at 1M nodes the mask alone is
+    # 64 MB).  Drivers always overwrite self.state with the result, so
+    # the donated input is never re-read.
+    return jax.jit(
+        functools.partial(es.tick, params=params), donate_argnums=(0,)
+    )
 
 
 @functools.lru_cache(maxsize=None)
 def _scanned_fn(params: es.ScalableParams):
-    @jax.jit
+    @functools.partial(jax.jit, donate_argnums=(0,))
     def _scanned(state, inputs):
         def body(st, inp):
             return es.tick(st, inp, params)
@@ -161,6 +168,18 @@ def _ring_checksum_fn(n: int, replica_points: int):
 
 
 class ScalableCluster:
+    """Driver for the scalable engine (construction pins the trace-time
+    knobs; step/run go through shared compiled executables).
+
+    DONATION CAVEAT: the tick/scan executables donate the input state
+    (round 10 — the [N, U/32] heard mask updates in place, 64 MB/copy at
+    1M), so a reference held to ``cluster.state`` from BEFORE a
+    ``step()``/``run()`` call is invalidated by that call ("Array has
+    been deleted" on read).  Snapshot with ``np.asarray(...)`` /
+    ``jax.device_get`` before stepping if you need before/after views —
+    the wavefront/checksum accessors here already read post-step state
+    only."""
+
     def __init__(
         self,
         n: int,
@@ -171,6 +190,14 @@ class ScalableCluster:
         self.params = params or es.ScalableParams(n=n)
         if self.params.n != n:
             self.params = self.params._replace(n=n)
+        # pin the trace-time "auto" knobs (perm_impl, fused_exchange) to
+        # concrete values: the shared executable caches below key on
+        # params, so two clusters built under different default backends
+        # must not alias one cache entry (engine.resolve_auto_parity's
+        # scalable analog)
+        self.params = es.resolve_scalable_params(
+            self.params, jax.default_backend()
+        )
         self.replica_points = replica_points
         self.state = es.init_state(self.params, seed=seed)
         # module-level lru_cache keyed by the (hashable) params: every

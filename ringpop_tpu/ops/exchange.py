@@ -1,0 +1,332 @@
+"""Fused push-pull exchange megakernel for the scalable O(N·U) engine.
+
+The scalable engine's gossip exchange is a handful of elementwise passes
+over the ``[N, U/32]`` heard bitmask: OR the pulled partner rows in, OR
+the pushed rows in, XOR against the pre-exchange mask for the new-bit
+diff, and reduce each row's new bits against the rumor delta table for
+the incremental checksum update.  Under XLA each pass materializes an
+``[N, U/32]`` temporary in HBM — and the delta reduction's bit expansion
+is 32x bigger than the mask itself — so a 1M-node storm tick streams the
+mask several times per tick (engine_scalable.py round-4 notes;
+PROF_PARITY_ROOFLINE.json storm phase).  This module fuses everything
+after the partner-row gathers into ONE pass:
+
+per ``[N_tile, U/32]`` VMEM tile::
+
+    new  = heard | pulled | pushed      # push/pull OR
+    diff = new ^ heard                  # new-bit mask (bits only turn ON)
+    out rows: new, Σ_{set bits of diff} r_delta[bit]  (mod 2^32),
+              popcount(diff)            # per-row new-bit count
+
+so the heard mask is read from HBM once and written once, the diff and
+its 32x bit expansion never exist outside VMEM, and the checksum delta
+comes back as one ``[N]`` uint32 vector.  The delta reduction is exact
+integer arithmetic — uint32 multiplies by {0, 1} bits with wrapping adds
+— so every implementation here agrees bit-for-bit with the engine's limb
+matmul (:func:`ringpop_tpu.models.sim.engine_scalable._bit_delta_sum`):
+all of them compute the same mod-2^32 sum exactly.
+
+Two implementations, selected by ``impl``:
+
+- ``"pallas"`` — a gridless TPU kernel (the only Pallas shape the axon
+  tunnel's compile helper accepts — PALLAS_BISECT.json): rows tiled
+  [8 sublanes x 128 lanes] like ops.pallas_farmhash, the word axis walked
+  by an in-kernel ``fori_loop``, row tiles beyond the VMEM budget mapped
+  through an outer ``lax.scan``.  Interpret mode off-TPU keeps tests
+  hermetic.
+- ``"xla"`` — the bit-exact pure-XLA twin (same role as
+  ``fused_stream_xla``): the same arithmetic as chunked vector ops, the
+  CPU fallback and the reference the interpret tests pin the kernel
+  against.
+
+The partner-row gathers stay OUTSIDE this op: a dynamic cross-row gather
+cannot live inside a row-tiled kernel (row i's partner may sit in any
+other tile), and XLA's gather is already a single optimized read of the
+mask.  What the op removes is every pass AFTER the gathers.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+SUB, LANE = 8, 128
+TILE = SUB * LANE  # rows per kernel tile
+WORD = 32
+
+
+def popcount_u32(x: jax.Array) -> jax.Array:
+    """SWAR popcount of a uint32 array — the ONE shared copy
+    (engine_scalable imports this for its heard-coverage metric)."""
+    x = x - ((x >> 1) & jnp.uint32(0x55555555))
+    x = (x & jnp.uint32(0x33333333)) + ((x >> 2) & jnp.uint32(0x33333333))
+    x = (x + (x >> 4)) & jnp.uint32(0x0F0F0F0F)
+    return (x * jnp.uint32(0x01010101)) >> 24
+
+
+def _exchange_kernel(heard_ref, pull_ref, push_ref, delta_ref,
+                     onew_ref, oacc_ref, ocnt_ref=None):
+    """One gridless call fuses OR + diff + popcount + delta-sum for a
+    [W, S, LANE] row tile (rows flattened onto sublanes x lanes, the
+    word axis walked by ``fori_loop``).  ``delta_ref`` is the rumor
+    delta table pre-broadcast to [W, 32, 1, LANE] so the per-bit
+    accumulate is a plain vector multiply — no scalar loads, keeping
+    the kernel inside the tunnel-validated plain-operand shape.
+    ``ocnt_ref`` is absent when the caller skipped the counts output
+    (the engine's hot path — the popcount and its [N] write drop out of
+    the program entirely)."""
+    w_words = heard_ref.shape[0]
+    rows_shape = heard_ref.shape[1:]
+    want_counts = ocnt_ref is not None
+
+    def body(w, carry):
+        acc, cnt = carry
+        h = heard_ref[w]
+        new = h | pull_ref[w] | push_ref[w]
+        diff = new ^ h
+        onew_ref[w] = new
+        for b in range(WORD):
+            bit = (diff >> jnp.uint32(b)) & jnp.uint32(1)
+            acc = acc + bit * delta_ref[w, b]
+        if want_counts:
+            cnt = cnt + popcount_u32(diff).astype(jnp.int32)
+        return acc, cnt
+
+    acc, cnt = jax.lax.fori_loop(
+        0,
+        w_words,
+        body,
+        (
+            jnp.zeros(rows_shape, jnp.uint32),
+            jnp.zeros(rows_shape, jnp.int32)
+            if want_counts
+            else jnp.int32(0),
+        ),
+    )
+    oacc_ref[:] = acc
+    if want_counts:
+        ocnt_ref[:] = cnt
+
+
+def _exchange_pallas(
+    heard,
+    pulled,
+    pushed,
+    r_delta,
+    *,
+    interpret: bool = False,
+    vmem_budget: int = 8 * 1024 * 1024,
+    want_counts: bool = True,
+):
+    from jax.experimental import pallas as pl
+
+    n, w = heard.shape
+    pad = (-n) % TILE
+    if pad:
+        zeros = ((0, pad), (0, 0))
+        heard = jnp.pad(heard, zeros)
+        pulled = jnp.pad(pulled, zeros)
+        pushed = jnp.pad(pushed, zeros)
+    s = (n + pad) // LANE
+
+    # VMEM lever (same scheme as block_loop_nogrid): shrink the sublane
+    # tile until 4 [W, s_t, LANE] mask planes + the broadcast delta
+    # table + the two [s_t, LANE] accumulators fit the budget
+    def tile_bytes(s_t):
+        return 4 * (
+            4 * w * s_t * LANE + w * WORD * LANE + 2 * s_t * LANE
+        )
+
+    s_t = s
+    while s_t > SUB and tile_bytes(s_t) > vmem_budget:
+        s_t = ((s_t + 1) // 2 + SUB - 1) // SUB * SUB  # halve, aligned
+    if tile_bytes(s_t) > vmem_budget:
+        # the shrink lever bottomed out at one sublane tile: the
+        # lane-broadcast delta table scales with W alone (u > ~8k words
+        # at the default budget) and no row tiling can recover — refuse
+        # loudly instead of issuing a kernel that OOMs VMEM on chip
+        raise ValueError(
+            "exchange: [%d-word] delta table + minimum row tile need "
+            "%d bytes of VMEM > budget %d — use impl='xla' (the "
+            "bit-exact twin) for masks this wide"
+            % (w, tile_bytes(s_t), vmem_budget)
+        )
+    rt = -(-s // s_t)  # row tiles
+    if rt * s_t > s:
+        extra = (rt * s_t - s) * LANE
+        zeros = ((0, extra), (0, 0))
+        heard = jnp.pad(heard, zeros)
+        pulled = jnp.pad(pulled, zeros)
+        pushed = jnp.pad(pushed, zeros)
+        s = rt * s_t
+
+    def tiles(x):  # [s*LANE, W] -> [rt, W, s_t, LANE]
+        return x.reshape(rt, s_t, LANE, w).transpose(0, 3, 1, 2)
+
+    delta_bc = jnp.broadcast_to(
+        r_delta.reshape(w, WORD)[:, :, None, None], (w, WORD, 1, LANE)
+    )
+    out_shape = [
+        jax.ShapeDtypeStruct((w, s_t, LANE), jnp.uint32),  # new mask
+        jax.ShapeDtypeStruct((s_t, LANE), jnp.uint32),  # row delta
+    ]
+    if want_counts:
+        out_shape.append(
+            jax.ShapeDtypeStruct((s_t, LANE), jnp.int32)  # new bits
+        )
+    call = pl.pallas_call(
+        _exchange_kernel,
+        out_shape=out_shape,
+        interpret=interpret,
+    )
+    if rt == 1:
+        outs = call(
+            tiles(heard)[0], tiles(pulled)[0], tiles(pushed)[0], delta_bc
+        )
+        outs = tuple(o[None] for o in outs)
+    else:
+
+        def step(_, x):
+            ht, pt, qt = x
+            return None, tuple(call(ht, pt, qt, delta_bc))
+
+        _, outs = jax.lax.scan(
+            step, None, (tiles(heard), tiles(pulled), tiles(pushed))
+        )
+    nh, acc = outs[0], outs[1]
+    new_heard = nh.transpose(0, 2, 3, 1).reshape(-1, w)[:n]
+    cnt = outs[2].reshape(-1)[:n] if want_counts else None
+    return new_heard, acc.reshape(-1)[:n], cnt
+
+
+def exchange_xla(
+    heard,
+    pulled,
+    pushed,
+    r_delta,
+    _chunk_rows: int = 65536,
+    want_counts: bool = True,
+):
+    """Pure-XLA twin of the fused exchange: identical outputs (exact
+    mod-2^32 integer arithmetic throughout), chunked over rows so the
+    32x bit expansion of the diff never materializes at full [N, U].
+    ``want_counts=False`` drops the per-row popcount reduction from the
+    program (the engine's hot path consumes only the delta)."""
+    n, w = heard.shape
+    new = heard | pulled | pushed
+    diff = new ^ heard
+    tbl = r_delta.reshape(w, WORD)
+    bit_ids = jnp.arange(WORD, dtype=jnp.uint32)[None, None, :]
+
+    def per_chunk(d):  # [C, W] uint32 -> ([C] uint32, [C] int32?)
+        bits = (d[:, :, None] >> bit_ids) & jnp.uint32(1)  # [C, W, 32]
+        acc = jnp.sum(bits * tbl[None], axis=(1, 2), dtype=jnp.uint32)
+        if not want_counts:
+            return acc
+        cnt = jnp.sum(popcount_u32(d), axis=1).astype(jnp.int32)
+        return acc, cnt
+
+    chunk = max(1, min(n, _chunk_rows))
+    pad = (-n) % chunk
+    rows = jnp.pad(diff, ((0, pad), (0, 0))) if pad else diff
+    out = jax.lax.map(per_chunk, rows.reshape(-1, chunk, w))
+    if not want_counts:
+        return new, out.reshape(-1)[:n], None
+    acc, cnt = out
+    return new, acc.reshape(-1)[:n], cnt.reshape(-1)[:n]
+
+
+def step_traffic_bytes(n: int, w: int) -> int:
+    """Modeled HBM bytes per exchange step — the op's one-pass contract:
+    3 mask reads (heard + the two partner-row planes the engine
+    gathers) + 1 mask write + the [N] delta/count outputs; the delta
+    table is negligible.  A LOWER bound (fusion can only reduce traffic
+    below it, so derived GB/s is conservative).  The ONE copy of the
+    model every bandwidth artifact shares — bench.py's scalable phase,
+    benchmarks/tpu_measure.py's fused_exchange phase, and
+    scripts/prof_exchange_roofline.py — so a change to the op's traffic
+    contract lands in all three at once."""
+    return (3 + 1) * n * w * 4 + 2 * n * 4
+
+
+def measure_bandwidth(  # jaxgate: host — wall-clock probe, never traced
+    heard, pulled, pushed, r_delta, *, impl: str, iters: int = 16
+):
+    """In-scan bandwidth probe on the caller's mask shape: one jitted
+    ``lax.scan`` of ``iters`` exchange steps (``h ^ pulled`` re-dirties
+    bits every step so no iteration is a converged no-op), timed warm
+    with a DIFFERENT starting mask than the warm-up call (the tunneled
+    chip memoizes identical (executable, inputs) executions —
+    RESULTS.md round 4).  Returns ``(gbps, seconds_per_step)`` with
+    bytes from :func:`step_traffic_bytes`."""
+    import time
+
+    @jax.jit
+    def run(h0):
+        def body(h, _):
+            nh, acc, _cnt = exchange(
+                h ^ pulled, pulled, pushed, r_delta, impl=impl
+            )
+            return nh, acc[0]
+
+        return jax.lax.scan(body, h0, None, length=iters)
+
+    # jaxgate: ignore[block-until-ready] x2 — this IS the measurement
+    # harness (the one shared copy of the probe the bench/roofline/
+    # tpu_measure artifacts call); never reached from traced code
+    jax.block_until_ready(run(heard))  # jaxgate: ignore[block-until-ready]
+    t0 = time.perf_counter()
+    jax.block_until_ready(run(pushed))  # jaxgate: ignore[block-until-ready]
+    sec_per_step = (time.perf_counter() - t0) / iters
+    n, w = heard.shape
+    return step_traffic_bytes(n, w) / sec_per_step / 1e9, sec_per_step
+
+
+def exchange(
+    heard,
+    pulled,
+    pushed,
+    r_delta,
+    *,
+    impl: "str | None" = None,
+    interpret: "bool | None" = None,
+    vmem_budget: int = 8 * 1024 * 1024,
+    want_counts: bool = True,
+):
+    """Fused push-pull exchange step.
+
+    ``heard``: [N, U/32] uint32 pre-exchange reception bitmask;
+    ``pulled`` / ``pushed``: [N, U/32] uint32 partner-row contributions,
+    already masked by delivery and active-rumor words (bits here may
+    only ADD to ``heard``); ``r_delta``: [U] uint32 rumor delta table.
+
+    Returns ``(new_heard [N, U/32] uint32, row_delta [N] uint32,
+    new_bits [N] int32)`` where ``new_heard = heard | pulled | pushed``,
+    ``row_delta[i] = Σ r_delta[r] (mod 2^32)`` over row i's newly-set
+    bits, and ``new_bits[i]`` their count.  ``impl``: "pallas" (gridless
+    TPU kernel; interpret mode off-TPU) or "xla" (the bit-exact twin);
+    None picks per backend.  ``want_counts=False`` returns ``new_bits``
+    as None and drops the popcount + its [N] output from the program —
+    the engine's hot path consumes only the delta.
+    """
+    u = r_delta.shape[0]
+    assert heard.shape[1] * WORD == u, "delta table must cover the mask"
+    if impl is None:
+        impl = "pallas" if jax.default_backend() == "tpu" else "xla"
+    if impl == "xla":
+        return exchange_xla(
+            heard, pulled, pushed, r_delta, want_counts=want_counts
+        )
+    if impl != "pallas":
+        raise ValueError("unknown exchange impl %r" % (impl,))
+    if interpret is None:
+        interpret = jax.devices()[0].platform != "tpu"
+    return _exchange_pallas(
+        heard,
+        pulled,
+        pushed,
+        r_delta,
+        interpret=interpret,
+        vmem_budget=vmem_budget,
+        want_counts=want_counts,
+    )

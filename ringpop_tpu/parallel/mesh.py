@@ -431,6 +431,24 @@ class ShardedStorm:
         self.params = params or es.ScalableParams(n=n)
         if self.params.n != n:
             self.params = self.params._replace(n=n)
+        # pin trace-time "auto" knobs exactly like ScalableCluster: the
+        # module-level executable caches key on params, and the SPMD
+        # trajectory must stay bitwise equal to the single-device engine
+        # regardless of which backend resolved first.  One mesh-specific
+        # override: an auto-resolved "pallas" exchange drops to the
+        # bit-exact XLA twin — a pallas_call does not partition under
+        # the sharded pjit (GSPMD can't see inside the kernel), while
+        # the twin's vector ops shard by rows like the rest of the tick.
+        # An EXPLICIT "pallas" is honored (replicated kernel: correct,
+        # measurably slower — the A/B knob for the chip session).
+        self.params = es.resolve_scalable_params(
+            self.params, jax.default_backend()
+        )
+        if (
+            (params is None or params.fused_exchange == "auto")
+            and self.params.fused_exchange == "pallas"
+        ):
+            self.params = self.params._replace(fused_exchange="xla")
         if n % self.mesh.devices.size:
             raise ValueError(
                 "n=%d not divisible by mesh size %d"

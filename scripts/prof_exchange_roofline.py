@@ -1,0 +1,171 @@
+#!/usr/bin/env python3
+"""Roofline the fused push-pull exchange op: bytes moved vs bandwidth.
+
+The round-10 companion to scripts/prof_parity_roofline.py, applied to
+ops/exchange.py — the megakernel that fuses the scalable engine's
+push-pull OR, new-bit diff, popcount, and checksum delta-sum into one
+pass over the [N, U/32] heard mask.  For each measured shape the
+artifact records:
+
+1. ms per exchange step (in-scan window — no per-call dispatch in the
+   number) for the Pallas kernel (interpret mode off-TPU, marked) and
+   the pure-XLA twin;
+2. a MODELED bytes-moved lower bound, itemized: the op's contract is
+   3 mask reads (heard + the two partner-row planes the engine gathers)
+   + 1 mask write + the [N] delta/count outputs; the delta table is
+   negligible.  A lower bound because fusion can only reduce traffic
+   below it — achieved GB/s is conservative;
+3. the derived GB/s, and — the comparison the megakernel exists to win —
+   the UNFUSED bytes model: separate OR / diff / popcount / delta
+   passes, each materializing its [N, U/32] temporary (and the delta
+   reduction's 32x bit expansion) through HBM.  ``fusion_traffic_ratio``
+   = unfused bytes / fused bytes: the per-tick traffic multiple the
+   fused op removes at identical arithmetic.
+
+Writes PROF_EXCHANGE_ROOFLINE.json; CPU runs are explicitly marked
+(platform + peak_gbps null, interpret flag on the pallas rows) so nobody
+mistakes them for chip numbers.  PROF_ROOFLINE_FORCE_CPU=1 skips the TPU
+wait on tunnel-less images.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+OUT = os.environ.get("PROF_EXCHANGE_OUT", "PROF_EXCHANGE_ROOFLINE.json")
+# v5e-class chip HBM peak; only attached to TPU measurements
+TPU_PEAK_GBPS = 819.0
+ITERS = int(os.environ.get("PROF_EXCHANGE_ITERS", "16"))
+
+
+def _bytes_models(n: int, w: int) -> dict:
+    """Itemized per-step traffic models, fused vs the XLA phase-by-phase
+    lowering the op replaced (engine_scalable's round-4 notes).  The
+    fused total is the SHARED model (ops.exchange.step_traffic_bytes —
+    the itemization here must sum to it; asserted) so this artifact
+    stays comparable with bench.py and tpu_measure.py."""
+    from ringpop_tpu.ops import exchange as exch
+
+    mask = n * w * 4
+    fused = {
+        "mask_reads_3x": 3 * mask,  # heard + pulled + pushed planes
+        "mask_write_1x": mask,  # new_heard
+        "row_outputs": 2 * n * 4,  # [N] delta + [N] count
+    }
+    assert sum(fused.values()) == exch.step_traffic_bytes(n, w)
+    unfused = {
+        # new = heard | pulled | pushed: 3 reads + 1 write
+        "or_pass": 4 * mask,
+        # diff = new ^ heard: 2 reads + 1 write
+        "diff_pass": 3 * mask,
+        # popcount(diff) -> [N]: 1 read + output
+        "popcount_pass": mask + n * 4,
+        # bits @ limbs delta reduction: the diff's 32x bit expansion
+        # materializes [N, U] through HBM (write + read) + the diff read
+        "delta_bit_expansion": mask + 2 * n * w * 32,
+    }
+    return {
+        "fused": fused,
+        "fused_total": sum(fused.values()),
+        "unfused": unfused,
+        "unfused_total": sum(unfused.values()),
+        "fusion_traffic_ratio": round(
+            sum(unfused.values()) / sum(fused.values()), 2
+        ),
+    }
+
+
+def measure_shape(res: dict, n: int, u: int) -> None:
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from ringpop_tpu.ops import exchange as exch
+
+    w = u // 32
+    rng = np.random.default_rng(11)
+    heard = jnp.asarray(rng.integers(0, 2**32, (n, w), dtype=np.uint32))
+    pulled = jnp.roll(heard, 1, axis=0)
+    pushed = jnp.roll(heard, -1, axis=0)
+    r_delta = jnp.asarray(rng.integers(0, 2**32, (u,), dtype=np.uint32))
+    models = _bytes_models(n, w)
+
+    shape_res: dict = {"n": n, "u": u, "bytes_model": models}
+    on_tpu = jax.default_backend() == "tpu"
+    for impl in ("pallas", "xla"):
+        try:
+            # the SHARED in-scan probe (ops.exchange.measure_bandwidth):
+            # h ^ pulled re-dirties bits every step, warm-then-distinct-
+            # input timing — one protocol across every bandwidth artifact
+            gbps, sec = exch.measure_bandwidth(
+                heard, pulled, pushed, r_delta, impl=impl, iters=ITERS
+            )
+            row = {
+                "ms_per_step": round(sec * 1e3, 3),
+                "achieved_gbps": round(gbps, 3),
+                "protocol": "in-scan x%d" % ITERS,
+            }
+            if impl == "pallas" and not on_tpu:
+                row["interpret"] = True  # NOT a kernel number
+            shape_res[impl] = row
+        except Exception as e:
+            shape_res[impl] = {"error": str(e)[:300]}
+    res["shape_%dx%d" % (n, u)] = shape_res
+
+
+def main() -> int:
+    from ringpop_tpu.utils.util import scrub_repo_pythonpath
+
+    scrub_repo_pythonpath(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    )
+    import ringpop_tpu  # noqa: F401
+
+    if os.environ.get("PROF_ROOFLINE_FORCE_CPU") != "1":
+        try:
+            from ringpop_tpu.utils.util import wait_for_tpu
+
+            wait_for_tpu(__file__, "PROF_EXCHANGE_ATTEMPT", 3, 10.0)
+        except Exception:
+            pass
+    import jax
+
+    plat = jax.default_backend()
+    res = {
+        "platform": plat,
+        "device": str(jax.devices()[0]),
+        "peak_gbps": TPU_PEAK_GBPS if plat == "tpu" else None,
+        "note": (
+            "modeled bytes are a LOWER bound (3 mask reads + 1 write + "
+            "row outputs); achieved GB/s is conservative.  CPU runs "
+            "exist so the artifact regenerates on tunnel-less images — "
+            "interpret-mode pallas rows are flagged and are NOT kernel "
+            "numbers."
+        ),
+    }
+    # the storm's own shapes: 100k everywhere, 1M only where the mask
+    # fits comfortably (a [1M, 16]-word in-scan window on a CPU image is
+    # minutes of interpret-mode pallas — chip-gated)
+    shapes = [(100_000, 512)]
+    if plat == "tpu":
+        shapes.append((1_000_000, 512))
+    for n, u in shapes:
+        measure_shape(res, n, u)
+    for key, sr in res.items():
+        if not key.startswith("shape_") or not res.get("peak_gbps"):
+            continue
+        g = sr.get("pallas", {}).get("achieved_gbps")
+        if g:
+            sr["pct_of_peak"] = round(100.0 * g / res["peak_gbps"], 2)
+    with open(OUT, "w") as f:
+        json.dump(res, f, indent=1)
+    print(json.dumps(res))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -41,6 +41,12 @@ def test_jaxpr_prong_covers_required_entry_points():
         # and the wavefront-enabled scalable tick stay callback-free
         "engine-tick-scan-flight-recorder",
         "engine-scalable-tick-wavefront",
+        # ISSUE 5 acceptance: the sortless+fused-exchange scalable tick
+        # and both lowerings of the exchange megakernel hold the same
+        # purity / uint32 gates
+        "engine-scalable-tick-fused",
+        "exchange-xla",
+        "exchange-pallas",
     } <= names
     assert len(names) >= 5
 

@@ -14,7 +14,12 @@ REPO_ROOT = Path(__file__).resolve().parents[2]
 # probes cheap enough for tier-1 (the engine-tick probes compile the full
 # tick twice — ~25 s on a contended CPU — and run under the slow marker /
 # scripts/check_retrace_budget.py instead; the 870 s tier-1 cap is real)
-CHEAP_PROBES = ("farmhash-scan", "fused-checksum-xla", "ring-device-lookup")
+CHEAP_PROBES = (
+    "farmhash-scan",
+    "fused-checksum-xla",
+    "ring-device-lookup",
+    "exchange-xla",  # [8,4] op jit — seconds, not an engine-tick compile
+)
 
 
 def test_manifest_is_committed_and_well_formed():

@@ -161,3 +161,37 @@ def test_hash_impl_is_trajectory_neutral(tmp_path):
     data[_PARAMS_KEY] = np.array([_json.dumps(saved)])
     np.savez(path, **data)
     load_state(path, engine.SimState, params)
+
+
+def test_scalable_perm_and_exchange_knobs_are_trajectory_neutral(tmp_path):
+    """A checkpoint saved under one (perm_impl, fused_exchange) pair
+    resumes under another — both knobs are bit-identical by the
+    gate-equivalence tests, and drivers pin backend-resolved values at
+    construction (a TPU save carries "pallas", a CPU resume resolves
+    "off") — and a pre-round-10 artifact with neither key loads."""
+    import json as _json
+
+    from ringpop_tpu.models.sim.checkpoint import _PARAMS_KEY
+
+    params = es.ScalableParams(
+        n=8, u=128, perm_impl="argsort", fused_exchange="off"
+    )
+    state = es.init_state(params, seed=0)
+    path = str(tmp_path / "st.npz")
+    save_state(path, state, params)
+
+    # cross-mode resume (the TPU-save -> CPU-resume shape)
+    load_state(
+        path,
+        es.ScalableState,
+        params._replace(perm_impl="sortless", fused_exchange="xla"),
+    )
+
+    # pre-round-10 artifact: strip both keys from the stored params JSON
+    data = dict(np.load(path, allow_pickle=True))
+    saved = _json.loads(str(data[_PARAMS_KEY][0]))
+    del saved["perm_impl"]
+    del saved["fused_exchange"]
+    data[_PARAMS_KEY] = np.array([_json.dumps(saved)])
+    np.savez(path, **data)
+    load_state(path, es.ScalableState, params)

@@ -1,0 +1,175 @@
+"""Fused push-pull exchange op (ops.exchange): bit-exactness gates.
+
+The op's contract is EXACT mod-2^32 arithmetic — the Pallas megakernel,
+the pure-XLA twin, and the engine's inline OR + ``_bit_delta_sum`` path
+must all agree bit-for-bit (that equality is the round-10 acceptance
+gate).  Every test here pins one implementation against another or
+against an independent host-side reference.
+"""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from ringpop_tpu.ops import exchange as ex
+
+
+def _mk(n, w, seed):
+    rng = np.random.default_rng(seed)
+
+    def u32(shape):
+        return rng.integers(0, 2**32, size=shape, dtype=np.uint32)
+
+    return u32((n, w)), u32((n, w)), u32((n, w)), u32((w * 32,))
+
+
+def _ref(heard, pulled, pushed, delta):
+    """Independent host reference: python ints, explicit mod-2^32."""
+    new = heard | pulled | pushed
+    diff = new ^ heard
+    n, w = heard.shape
+    acc = np.zeros(n, np.uint32)
+    cnt = np.zeros(n, np.int64)
+    for i in range(n):
+        for wd in range(w):
+            d = int(diff[i, wd])
+            for b in range(32):
+                if (d >> b) & 1:
+                    acc[i] = np.uint32(
+                        (int(acc[i]) + int(delta[wd * 32 + b]))
+                        & 0xFFFFFFFF
+                    )
+                    cnt[i] += 1
+    return new, acc, cnt
+
+
+@pytest.mark.parametrize(
+    "n,w", [(1, 1), (5, 2), (64, 4), (130, 3)]
+)
+def test_xla_matches_host_reference(n, w):
+    heard, pulled, pushed, delta = _mk(n, w, seed=n * 31 + w)
+    want_new, want_acc, want_cnt = _ref(heard, pulled, pushed, delta)
+    got_new, got_acc, got_cnt = ex.exchange(
+        jnp.asarray(heard),
+        jnp.asarray(pulled),
+        jnp.asarray(pushed),
+        jnp.asarray(delta),
+        impl="xla",
+    )
+    assert (np.asarray(got_new) == want_new).all()
+    assert (np.asarray(got_acc) == want_acc).all()
+    assert (np.asarray(got_cnt) == want_cnt).all()
+
+
+def test_xla_chunking_is_invisible():
+    """Row chunking (incl. the padded ragged tail) must not change any
+    output — padded rows contribute nothing."""
+    heard, pulled, pushed, delta = _mk(67, 4, seed=9)
+    args = tuple(map(jnp.asarray, (heard, pulled, pushed, delta)))
+    base = ex.exchange_xla(*args)
+    for chunk in (1, 8, 64, 67, 1024):
+        out = ex.exchange_xla(*args, _chunk_rows=chunk)
+        for a, b in zip(base, out):
+            assert (np.asarray(a) == np.asarray(b)).all(), chunk
+
+
+@pytest.mark.parametrize("n,w", [(1, 2), (64, 4), (1025, 4)])
+def test_pallas_interpret_matches_xla_twin(n, w):
+    """The gridless kernel (interpret mode off-TPU) must agree with the
+    pure-XLA twin bit-for-bit — including ragged N padded up to the
+    sublane tile."""
+    heard, pulled, pushed, delta = _mk(n, w, seed=n + w)
+    args = tuple(map(jnp.asarray, (heard, pulled, pushed, delta)))
+    want = ex.exchange(*args, impl="xla")
+    got = ex.exchange(*args, impl="pallas", interpret=True)
+    for a, b in zip(got, want):
+        assert (np.asarray(a) == np.asarray(b)).all()
+
+
+def test_pallas_vmem_tiling_path():
+    """A tiny VMEM budget forces the outer lax.scan over row tiles; the
+    multi-tile path must still be bit-exact.  (128 KiB sits above the
+    w=2 single-sublane floor the guard enforces but below the
+    whole-problem tile, so the shrink loop lands on 2 row tiles.)"""
+    n, w = 2100, 2
+    heard, pulled, pushed, delta = _mk(n, w, seed=3)
+    args = tuple(map(jnp.asarray, (heard, pulled, pushed, delta)))
+    want = ex.exchange(*args, impl="xla")
+    got = ex.exchange(
+        *args, impl="pallas", interpret=True, vmem_budget=128 * 1024
+    )
+    for a, b in zip(got, want):
+        assert (np.asarray(a) == np.asarray(b)).all()
+
+
+def test_delta_matches_engine_bit_delta_sum():
+    """The op's row delta must equal the engine's MXU-limb reduction
+    (``_bit_delta_sum``) on the same new-bit mask — the equality the
+    fused tick's checksum correctness rests on (adversarial deltas to
+    force uint32 wrap)."""
+    from ringpop_tpu.models.sim import engine_scalable as es
+
+    n, w = 96, 5
+    heard, pulled, pushed, delta = _mk(n, w, seed=12)
+    delta[:] = np.uint32(0xF0000000) + (delta >> 4)  # force wraps
+    new = heard | pulled | pushed
+    diff = jnp.asarray(new ^ heard)
+    want = np.asarray(
+        es._bit_delta_sum(diff, jnp.asarray(delta), w * 32)
+    )
+    _, got_acc, _ = ex.exchange(
+        jnp.asarray(heard),
+        jnp.asarray(pulled),
+        jnp.asarray(pushed),
+        jnp.asarray(delta),
+        impl="xla",
+    )
+    assert (np.asarray(got_acc) == want).all()
+
+
+@pytest.mark.parametrize("impl", ["xla", "pallas"])
+def test_want_counts_false_drops_only_the_count(impl):
+    """The engine's hot path (want_counts=False) must return the SAME
+    mask and delta with new_bits=None — the popcount just disappears."""
+    heard, pulled, pushed, delta = _mk(70, 3, seed=21)
+    args = tuple(map(jnp.asarray, (heard, pulled, pushed, delta)))
+    kw = {"interpret": True} if impl == "pallas" else {}
+    full = ex.exchange(*args, impl=impl, **kw)
+    lean = ex.exchange(*args, impl=impl, want_counts=False, **kw)
+    assert lean[2] is None
+    for a, b in zip(lean[:2], full[:2]):
+        assert (np.asarray(a) == np.asarray(b)).all()
+
+
+def test_pallas_vmem_floor_raises_toward_xla():
+    """When the lane-broadcast delta table alone exceeds the VMEM budget
+    (wide-U masks), the kernel must refuse loudly and point at the XLA
+    twin — not issue a program that OOMs VMEM on chip."""
+    heard, pulled, pushed, delta = _mk(8, 256, seed=2)  # u=8192
+    with pytest.raises(ValueError, match="use impl='xla'"):
+        ex.exchange(
+            *map(jnp.asarray, (heard, pulled, pushed, delta)),
+            impl="pallas",
+            interpret=True,
+        )
+
+
+def test_shape_mismatch_rejected():
+    heard, pulled, pushed, delta = _mk(8, 4, seed=0)
+    with pytest.raises(AssertionError):
+        ex.exchange(
+            jnp.asarray(heard),
+            jnp.asarray(pulled),
+            jnp.asarray(pushed),
+            jnp.asarray(delta[:96]),  # table shorter than the mask
+            impl="xla",
+        )
+    with pytest.raises(ValueError):
+        ex.exchange(
+            jnp.asarray(heard),
+            jnp.asarray(pulled),
+            jnp.asarray(pushed),
+            jnp.asarray(delta),
+            impl="nope",
+        )
