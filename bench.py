@@ -17,7 +17,12 @@ parity), roughly quadrupling single-config wall time; on CPU it runs
 gated fast + parity only.  A scalable phase (BENCH_SCALABLE=0 opts out)
 additionally measures the O(N·U) storm engine at n=100k: sortless-PRP
 node-ticks/s vs the argsort twin (bitwise-gated A/B) and the fused
-exchange op's achieved GB/s (scalable_* fields).
+exchange op's achieved GB/s (scalable_* fields).  A routing phase
+(BENCH_ROUTE=0 opts out; BENCH_ROUTE_N/_TICKS/_Q/_CHURN knobs) measures
+the round-11 routing plane at n=100k under sparse churn: batched Zipf
+queries/s + lookups/s, misroute / keys-diverged / checksum-reject rates,
+and the incremental-vs-full-sort ring rebuild A/B with bitwise ring +
+counter gates (route_* fields).
 
 Baseline: the reference (ringpop-node) runs clusters in real time with a
 200 ms minimum protocol period (lib/gossip/index.js:194-196), i.e. a 1k-node
@@ -346,6 +351,168 @@ def _exchange_gbps(heard, r_delta) -> tuple:
     return gbps, impl
 
 
+def _sparse_churn_schedule(n: int, ticks: int, churn: int, seed: int = 0):
+    """Sparse per-tick churn: ``churn`` random kills each tick, revived
+    two ticks later — the steady trickle the incremental ring kernel is
+    built for (a handful of dirty buckets per tick, never the caps)."""
+    from ringpop_tpu.models.sim.storm import StormSchedule
+
+    rng = np.random.default_rng(seed)
+    sched = StormSchedule(ticks=ticks, n=n)
+    waves = {}
+    for t in range(1, ticks):
+        waves[t] = rng.choice(n, size=min(churn, n), replace=False)
+        sched.kill[t, waves[t]] = True
+        if t - 2 in waves:
+            sched.revive[t, waves[t - 2]] = True
+    return sched
+
+
+def _route_rate(
+    n: int, ticks: int, q: int, churn: int, ring_impl: str, recorder=None
+) -> tuple:
+    """Routing-plane throughput (round 11): the coupled membership +
+    routing scan under sparse churn.  Each tick routes ``q`` Zipf
+    requests — 2 keys per request, each looked up under the stale AND
+    truth rings, so the program performs ``4*q`` ring lookups per tick.
+    ``ring_impl`` A/Bs the incremental bucketed kernel against the
+    full-``jnp.sort`` twin (bit-identical metrics + materialized ring —
+    the gate the caller asserts).  Returns (queries/s, elapsed, driver,
+    route metric stack)."""
+    import jax
+
+    from ringpop_tpu.models.route.plane import RoutedStorm, RouteParams
+    from ringpop_tpu.models.sim import engine_scalable as es
+
+    params = es.ScalableParams(n=n)
+    route = RouteParams(n=n, queries_per_tick=q, ring_impl=ring_impl)
+    rs = RoutedStorm(n, params=params, route=route, seed=0)
+    sched = _sparse_churn_schedule(n, ticks, churn)
+    rs.run(sched)  # compile + warm (donated state: run overwrites it)
+    jax.block_until_ready(rs.cluster.state)
+    t0 = time.perf_counter()
+    with _profile_ctx("route-%s" % ring_impl, recorder=recorder):
+        em, rm = rs.run(sched)
+        jax.block_until_ready(rs.cluster.state)
+    elapsed = time.perf_counter() - t0
+    if recorder is not None:
+        recorder.record_event(
+            "route_window",
+            ring_impl=rs.route_params.ring_impl,
+            n=n,
+            q=q,
+            ticks=ticks,
+            churn_per_tick=churn,
+            bucket_bits=rs.route_params.bucket_bits,
+        )
+        rows = dict(em._asdict())
+        rows.update(rm._asdict())
+        recorder.record_ticks(rows)
+        recorder.record_phase("measure[route:%s]" % ring_impl, elapsed)
+    return q * ticks / elapsed, elapsed, rs, rm
+
+
+def _ring_rebuild_ab(n: int, r: int, ticks: int, churn: int) -> dict:
+    """Isolated ring-maintenance A/B (the ISSUE 6 perf headline): one
+    scanned program per impl over the SAME sparse-churn mask sequence —
+    incremental dirty-bucket re-merge vs full ``jnp.sort`` rebuild —
+    timed warm, with a bitwise gate on the final materialized ring and
+    on per-tick (n_points, first_owner) probe sums."""
+    import jax
+    import jax.numpy as jnp
+
+    from ringpop_tpu.models.ring import device as ringdev
+    from ringpop_tpu.models.route import ring_kernel as rk
+
+    reps_np = np.asarray(ringdev.device_replica_hashes(n, r))
+    bits = rk.default_bucket_bits(n, r)
+    buckets = rk.build_buckets(reps_np, bits)
+    reps = jnp.asarray(reps_np)
+
+    rng = np.random.default_rng(1)
+    masks = np.ones((ticks, n), bool)
+    mask = np.ones(n, bool)
+    for t in range(ticks):
+        flips = rng.choice(n, size=min(churn, n), replace=False)
+        mask = mask.copy()
+        mask[flips] = ~mask[flips]
+        masks[t] = mask
+    jmasks = jnp.asarray(masks)
+
+    @jax.jit
+    def run_incremental(state0, jmasks):
+        def body(carry, m):
+            st, acc = carry
+            st, _nc, _nd, _ov = rk.update(
+                buckets,
+                st,
+                m,
+                # static caps ARE the incremental work size: size them to
+                # the schedule's churn (flips x replica points), not to
+                # the bucket count — oversizing re-merges clean buckets
+                max_changed=4 * churn,
+                max_dirty=min(1 << bits, 4 * churn * r),
+            )
+            # consume every tick's state so no rebuild is dead code
+            acc = acc + st.n_points.astype(jnp.int64) + st.first_owner
+            return (st, acc), None
+
+        (st, acc), _ = jax.lax.scan(body, (state0, jnp.int64(0)), jmasks)
+        return st, acc
+
+    @jax.jit
+    def run_full_sort(jmasks):
+        # the ring rides the CARRY, not the scan output: stacking every
+        # tick's ring would allocate [ticks, N*R] uint64 (4+ GB at the
+        # 1M chip config) and charge a per-tick full-ring write only to
+        # this side of the A/B
+        def body(carry, m):
+            _prev, acc = carry
+            ring = ringdev.build_ring(reps, m)
+            npts = ringdev.ring_size(m, r)
+            owner0 = jnp.where(
+                npts > 0,
+                (ring[0] & jnp.uint64(0xFFFFFFFF)).astype(jnp.int32),
+                jnp.int32(-1),
+            )
+            acc = acc + npts.astype(jnp.int64) + owner0
+            return (ring, acc), None
+
+        ring0 = jnp.zeros(n * r, jnp.uint64)
+        (ring, acc), _ = jax.lax.scan(
+            body, (ring0, jnp.int64(0)), jmasks
+        )
+        return ring, acc
+
+    state0 = rk.full_rebuild(buckets, jnp.ones(n, bool))
+
+    def timed(fn, *args):
+        out = fn(*args)
+        jax.block_until_ready(out)
+        t0 = time.perf_counter()
+        out = fn(*args)
+        jax.block_until_ready(out)
+        return out, (time.perf_counter() - t0)
+
+    (st_inc, acc_inc), inc_s = timed(run_incremental, state0, jmasks)
+    (ring_full, acc_full), full_s = timed(run_full_sort, jmasks)
+    flat_inc = np.asarray(rk.materialize(st_inc, n * r))
+    return {
+        "n": n,
+        "replica_points": r,
+        "ticks": ticks,
+        "churn_per_tick": churn,
+        "bucket_bits": bits,
+        "incremental_ms": round(inc_s / ticks * 1e3, 3),
+        "full_sort_ms": round(full_s / ticks * 1e3, 3),
+        "speedup": round(full_s / inc_s, 2),
+        "bitwise_equal": bool(
+            (flat_inc == np.asarray(ring_full)).all()
+            and int(acc_inc) == int(acc_full)
+        ),
+    }
+
+
 def _batched_rate(b: int, n: int, ticks: int) -> tuple:
     """Aggregate node-ticks/s for B independent clusters in one program
     (the TPU-utilization configuration; models/sim/batched.py)."""
@@ -533,6 +700,95 @@ def _measure_recorded(n: int, ticks: int, platform: str, recorder) -> dict:
             if _is_transient(exc):
                 raise
             result["scalable_error"] = "%s: %s" % (
+                type(exc).__name__,
+                str(exc)[:300],
+            )
+
+    # routing phase (BENCH_ROUTE=0 opts out): the round-11 device-
+    # resident request-routing plane at n=100k under sparse churn —
+    # batched Zipf lookups/s through the coupled membership+routing
+    # scan, the incremental-vs-full-sort ring rebuild A/B with a
+    # bitwise ring gate, and the RouteMetrics counter rates through the
+    # runlog (schema-validated by scripts/check_metrics_schema.py).
+    if os.environ.get("BENCH_ROUTE", "1") == "1":
+        try:
+            rn = int(os.environ.get("BENCH_ROUTE_N", "100000"))
+            rticks = int(os.environ.get("BENCH_ROUTE_TICKS", "8"))
+            rq = int(os.environ.get("BENCH_ROUTE_Q", "262144"))
+            rchurn = int(os.environ.get("BENCH_ROUTE_CHURN", "8"))
+            i_rate, _i_el, ri, rm_i = _retry_helper_500(
+                _route_rate, rn, rticks, rq, rchurn, "incremental",
+                recorder=recorder,
+            )
+            f_rate, _f_el, rf, rm_f = _retry_helper_500(
+                _route_rate, rn, rticks, rq, rchurn, "full",
+                recorder=recorder,
+            )
+            result["route_n"] = rn
+            result["route_ticks"] = rticks
+            result["route_q"] = rq
+            result["route_churn_per_tick"] = rchurn
+            result["route_bucket_bits"] = ri.route_params.bucket_bits
+            result["route_queries_per_sec"] = round(i_rate, 1)
+            # 2 keys/request x 2 rings (stale + truth) per tick
+            result["route_lookups_per_sec"] = round(4 * i_rate, 1)
+            result["route_queries_per_sec_full_sort"] = round(f_rate, 1)
+            result["route_vs_full_sort"] = round(i_rate / f_rate, 2)
+            # the bitwise gates: same seeds + schedule, so the two ring
+            # impls must produce identical materialized rings AND
+            # identical counter streams
+            result["route_ring_bitwise_equal"] = bool(
+                (
+                    np.asarray(ri.truth_ring())
+                    == np.asarray(rf.truth_ring())
+                ).all()
+            )
+            result["route_metrics_equal"] = all(
+                bool(
+                    (
+                        np.asarray(getattr(rm_i, f))
+                        == np.asarray(getattr(rm_f, f))
+                    ).all()
+                )
+                for f in rm_i._fields
+            )
+            # counter rates over the measured window
+            rqs = float(np.asarray(rm_i.route_queries).sum())
+            for fld in (
+                "route_misroutes",
+                "route_reroute_local",
+                "route_reroute_remote",
+                "route_keys_diverged",
+                "route_checksums_differ",
+                "route_checksum_rejects",
+            ):
+                tot = float(np.asarray(getattr(rm_i, fld)).sum())
+                result[fld + "_per_1k"] = round(
+                    1000.0 * tot / max(rqs, 1.0), 3
+                )
+            # isolated rebuild A/B — the perf headline's clean number
+            ab = _retry_helper_500(
+                _ring_rebuild_ab, rn, 16, max(2 * rticks, 16), rchurn
+            )
+            result["route_rebuild_incremental_ms"] = ab["incremental_ms"]
+            result["route_rebuild_full_sort_ms"] = ab["full_sort_ms"]
+            result["route_rebuild_speedup"] = ab["speedup"]
+            result["route_rebuild_bitwise_equal"] = ab["bitwise_equal"]
+            if recorder is not None:
+                recorder.record_event(
+                    "route_rebuild_ab",
+                    n=ab["n"],
+                    incremental_ms=ab["incremental_ms"],
+                    full_sort_ms=ab["full_sort_ms"],
+                    speedup=ab["speedup"],
+                    bitwise_equal=ab["bitwise_equal"],
+                    churn_per_tick=ab["churn_per_tick"],
+                    bucket_bits=ab["bucket_bits"],
+                )
+        except Exception as exc:
+            if _is_transient(exc):
+                raise
+            result["route_error"] = "%s: %s" % (
                 type(exc).__name__,
                 str(exc)[:300],
             )

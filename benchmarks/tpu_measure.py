@@ -619,6 +619,106 @@ def phase_fused_exchange(results: dict) -> None:
     storm_mod.clear_executable_cache()
 
 
+def phase_route(results: dict) -> None:
+    """Round-11 routing plane on-chip: the coupled membership+routing
+    scan at n=1M under sparse churn — batched Zipf queries/s with the
+    incremental bucketed ring vs the full-jnp.sort twin, a DEVICE-LEVEL
+    bitwise gate on the materialized truth rings + counter streams
+    (same seeds + schedule across impls), and the isolated
+    ring-rebuild A/B (per-tick incremental re-merge vs full sort) —
+    the next chip session's capture of BENCH_r11's CPU numbers."""
+    import sys
+
+    import jax
+    import numpy as np
+
+    sys.path.insert(
+        0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    )
+    import bench as bench_mod
+
+    from ringpop_tpu.models.route import plane as route_plane
+
+    n, ticks, q, churn = 1_000_000, 16, 1 << 20, 32
+    runs: dict = {}
+    for impl in ("incremental", "full"):
+        key = "route_1m_%s" % impl
+        if not _todo(results, key):
+            continue
+        try:
+            rate, elapsed, driver, rm = bench_mod._route_rate(
+                n, ticks, q, churn, impl
+            )
+            runs[impl] = (driver, rm)
+            results[key] = {
+                "n": n,
+                "ticks": ticks,
+                "q": q,
+                "churn_per_tick": churn,
+                "ring_impl": impl,
+                "bucket_bits": driver.route_params.bucket_bits,
+                "queries_per_sec": round(rate, 1),
+                "lookups_per_sec": round(4 * rate, 1),
+                "misroutes": int(np.asarray(rm.route_misroutes).sum()),
+                "keys_diverged": int(
+                    np.asarray(rm.route_keys_diverged).sum()
+                ),
+                "checksum_rejects": int(
+                    np.asarray(rm.route_checksum_rejects).sum()
+                ),
+            }
+        except Exception as e:
+            results[key] = {"error": str(e)[:300]}
+        print(json.dumps({key: results.get(key)}), flush=True)
+
+    if _todo(results, "route_1m_bitwise_equal"):
+        if len(runs) == 2:
+            ri, rm_i = runs["incremental"]
+            rf, rm_f = runs["full"]
+            ring_eq = bool(
+                (
+                    np.asarray(ri.truth_ring())
+                    == np.asarray(rf.truth_ring())
+                ).all()
+            )
+            metric_eq = all(
+                bool(
+                    (
+                        np.asarray(getattr(rm_i, f))
+                        == np.asarray(getattr(rm_f, f))
+                    ).all()
+                )
+                for f in rm_i._fields
+            )
+            results["route_1m_bitwise_equal"] = {
+                "ring_equal": ring_eq,
+                "metrics_equal": metric_eq,
+            }
+        else:
+            results["route_1m_bitwise_equal"] = {
+                "skipped": "cross-impl states unavailable after resume; "
+                "delete the route_1m_* entries and rerun for the gate"
+            }
+        print(
+            json.dumps(
+                {"route_1m_bitwise_equal": results["route_1m_bitwise_equal"]}
+            ),
+            flush=True,
+        )
+
+    if _todo(results, "route_rebuild_ab_1m"):
+        try:
+            results["route_rebuild_ab_1m"] = bench_mod._ring_rebuild_ab(
+                n, 16, 32, churn
+            )
+        except Exception as e:
+            results["route_rebuild_ab_1m"] = {"error": str(e)[:300]}
+        print(
+            json.dumps({"route_rebuild_ab_1m": results["route_rebuild_ab_1m"]}),
+            flush=True,
+        )
+
+
 def phase_epidemic_100k(results: dict) -> None:
     import jax
     import numpy as np
@@ -899,6 +999,7 @@ def main() -> int:
         ("encode_impls", phase_encode_impls),
         ("fused_parity", phase_fused_parity),
         ("fused_exchange", phase_fused_exchange),
+        ("route", phase_route),
         ("epidemic_100k", phase_epidemic_100k),
         ("batched", phase_batched),
         ("convergence", phase_convergence),

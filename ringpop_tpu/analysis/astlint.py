@@ -67,14 +67,36 @@ TRACED_ENTRIES: Dict[str, Set[str]] = {
         "fused_stream_xla",
     },
     "ops/record_mix.py": {"record_mix"},
-    "models/ring/device.py": {"build_ring", "lookup", "lookup_n"},
+    "models/ring/device.py": {
+        "build_ring",
+        "lookup",
+        "lookup_n",
+        "device_replica_hashes",
+        "ring_checksum",
+    },
+    "models/route/ring_kernel.py": {
+        "full_rebuild",
+        "update",
+        "materialize",
+        "lookup",
+        "lookup_n_fixed",
+        "dirty_stats",
+    },
+    "models/route/traffic.py": {"sample_keys", "key_hashes", "zipf_cdf"},
+    "models/route/plane.py": {"route_tick", "init_route_state"},
 }
 
 # Device modules: code on (or feeding) the compiled path.
-DEVICE_PATHS = ("ops/", "models/sim/", "models/ring/", "parallel/")
+DEVICE_PATHS = (
+    "ops/",
+    "models/sim/",
+    "models/ring/",
+    "models/route/",
+    "parallel/",
+)
 # Paths where implicit-dtype applies (ISSUE: constructors feeding the
 # uint32 hash dataflow and the scanned tick state).
-DTYPE_PATHS = ("ops/", "models/sim/")
+DTYPE_PATHS = ("ops/", "models/sim/", "models/route/")
 # block_until_ready is legitimate in observability / bench plumbing.
 SYNC_OK_PATHS = ("obs/",)
 

@@ -497,6 +497,83 @@ def _entry_ring_device() -> Tuple[Callable, Tuple]:
     return _ring_fn(), _ring_args()
 
 
+def _route_fixture(impl: str, n: int = 8, r: int = 4, seed: int = 4):
+    """Small routing-plane fixture shared by the route-tick entries and
+    the retrace probe: buckets/reps/cdf constants + one RouteState."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from ringpop_tpu.models.ring import device as ringdev
+    from ringpop_tpu.models.route import plane, ring_kernel, traffic
+
+    params = plane.RouteParams(
+        n=n,
+        replica_points=r,
+        bucket_bits=2,
+        queries_per_tick=16,
+        key_space=64,
+        ring_impl=impl,
+        max_changed=4,
+        max_dirty=4,
+    )
+    reps_np = np.asarray(ringdev.device_replica_hashes(n, r))
+    buckets = ring_kernel.build_buckets(reps_np, params.bucket_bits)
+    reps = jnp.asarray(reps_np)
+    cdf = traffic.zipf_cdf(params.key_space, params.zipf_s)
+    rng = np.random.default_rng(seed)
+    mask0 = jnp.asarray(rng.random(n) < 0.9)
+    state = plane.init_route_state(params, buckets, reps, mask0, seed=seed)
+    in_ring = jnp.asarray(rng.random(n) < 0.8)
+    proc_alive = jnp.asarray(rng.random(n) < 0.9)
+    checksums = jnp.asarray(
+        rng.integers(0, 2**32, size=n, dtype=np.uint32)
+    )
+    return plane, params, buckets, reps, cdf, state, (
+        in_ring, proc_alive, checksums,
+    )
+
+
+def _entry_route_tick(impl: str) -> Tuple[Callable, Tuple]:
+    """The routing plane's scanned tick (ISSUE 6): Zipf traffic draw,
+    bucketed/sort-twin ring refresh, batched lookups and the misroute/
+    keys-diverged/checksum-reject counters must all stay callback-free
+    with the ring-key dataflow in integer lanes."""
+    plane, params, buckets, reps, cdf, state, dyn = _route_fixture(impl)
+
+    def one(state, in_ring, proc_alive, checksums):
+        return plane.route_tick(
+            state, buckets, reps, cdf, in_ring, proc_alive, checksums,
+            params,
+        )
+
+    return one, (state,) + dyn
+
+
+def _entry_route_ring_incremental() -> Tuple[Callable, Tuple]:
+    """The incremental ring-maintenance kernel in isolation: dirty-
+    bucket re-merge + lookup on the bucketed layout."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    from ringpop_tpu.models.route import ring_kernel as rk
+
+    plane, params, buckets, reps, cdf, state, dyn = _route_fixture(
+        "incremental"
+    )
+    in_ring = dyn[0]
+    rng = np.random.default_rng(6)
+    keys = jnp.asarray(rng.integers(0, 2**32, size=16, dtype=np.uint32))
+
+    def one(rstate, in_ring, keys):
+        st, n_changed, n_dirty, ov = rk.update(
+            buckets, rstate, in_ring, max_changed=4, max_dirty=4
+        )
+        return rk.lookup(st, keys), rk.materialize(st, 8 * 4), n_changed
+
+    return one, (state.ring, in_ring, keys)
+
+
 DEFAULT_ENTRIES: List[EntryPoint] = [
     EntryPoint("engine-tick-scan", _entry_engine_tick_scan),
     # the flight-recorder-enabled scanned tick MUST stay callback-free:
@@ -531,6 +608,17 @@ DEFAULT_ENTRIES: List[EntryPoint] = [
         lambda: _entry_farmhash("pallas_nogrid"),
     ),
     EntryPoint("ring-device-lookup", _entry_ring_device),
+    # the round-11 routing plane: both ring impls of the routing tick
+    # (incremental bucketed + full-sort twin) and the maintenance kernel
+    # alone hold the same purity gates
+    EntryPoint(
+        "route-tick-incremental",
+        lambda: _entry_route_tick("incremental"),
+    ),
+    EntryPoint("route-tick-full", lambda: _entry_route_tick("full")),
+    EntryPoint(
+        "route-ring-incremental", _entry_route_ring_incremental
+    ),
 ]
 
 

@@ -368,6 +368,50 @@ def _probe_engine_scalable_tick_fused() -> (
     ]
 
 
+def _probe_route_tick() -> "Tuple[Callable, List[Tuple[str, Tuple]]]":
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from ringpop_tpu.analysis import jaxpr_audit as ja
+
+    # the round-11 routing plane: bucketed incremental ring + Zipf
+    # traffic + counters in one traced tick.  Static buckets/reps/cdf
+    # ride as closure constants (the driver's calling convention), so
+    # the cache keys on the membership-plane shapes alone.
+    plane, params, buckets, reps, cdf, state, _dyn = ja._route_fixture(
+        "incremental"
+    )
+
+    def call(state, in_ring, proc_alive, checksums):
+        return plane.route_tick(
+            state, buckets, reps, cdf, in_ring, proc_alive, checksums,
+            params,
+        )
+
+    fn = jax.jit(call)
+
+    def nargs(n, seed):
+        r2 = np.random.default_rng(seed)
+        return (
+            jnp.asarray(r2.random(n) < 0.8),
+            jnp.asarray(r2.random(n) < 0.9),
+            jnp.asarray(r2.integers(0, 2**32, size=n, dtype=np.uint32)),
+        )
+
+    # a wider membership plane (same pytree structure, new [N] shapes)
+    # must recompile exactly once; the bucketed ring state keeps its
+    # bucket-shaped arrays, only its mask widens
+    state12 = state._replace(
+        ring=state.ring._replace(mask=jnp.zeros(12, bool))
+    )
+    return fn, [
+        ("n=8 route tick", (state,) + nargs(8, 1)),
+        ("n=8 new values (expect cache hit)", (state,) + nargs(8, 2)),
+        ("n=12 membership plane (expect recompile)", (state12,) + nargs(12, 3)),
+    ]
+
+
 DEFAULT_PROBES: List[Probe] = [
     Probe("farmhash-scan", _probe_farmhash_scan),
     Probe("fused-checksum-xla", _probe_fused_checksum_xla),
@@ -378,4 +422,5 @@ DEFAULT_PROBES: List[Probe] = [
     Probe(
         "engine-scalable-tick-fused", _probe_engine_scalable_tick_fused
     ),
+    Probe("route-tick", _probe_route_tick),
 ]

@@ -314,7 +314,7 @@ class ScalableSimBackend:
       + fresh restart (the reference rebuilds a restarted node via join
       anyway); SIGSTOP-with-state-intact is the full engine's domain,
     - ``lookup`` serves from the device ring over integer ids
-      (storm.build_ring), hashing the key with FarmHash32 like the
+      (models/ring/device.py build_ring), hashing the key with FarmHash32 like the
       reference's ring.
     - per-tick snapshots materialize an N-entry dict for the convergence
       display; fine to ~200k interactively — beyond that, drive the
@@ -386,11 +386,11 @@ class ScalableSimBackend:
         import numpy as np
 
         from ringpop_tpu.models.ring import device as ringdev
-        from ringpop_tpu.models.sim import engine_scalable as es
-        from ringpop_tpu.models.sim.storm import (
+        from ringpop_tpu.models.ring.device import (
             build_ring,
             device_replica_hashes,
         )
+        from ringpop_tpu.models.sim import engine_scalable as es
         from ringpop_tpu.ops import farmhash32 as fh
 
         st = self.cluster.state
@@ -411,7 +411,7 @@ class ScalableSimBackend:
         ring, n_points = cached
         if n_points == 0:
             return None
-        # storm.build_ring shares models/ring/device.py's table layout
+        # build_ring's table layout is the device-ring layout
         # (hash<<32|owner, sentinel-padded, sorted) — one lookup helper
         owner = int(
             ringdev.lookup(ring, n_points, jnp.uint32(fh.hash32(str(key))))

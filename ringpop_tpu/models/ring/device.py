@@ -14,6 +14,20 @@ unique owners (ring/index.js:157-189).
 Everything here is shape-static and jit/vmap/shard_map-friendly; the ring
 rebuild for every node's *own view* of the cluster is just a vmap over the
 member mask axis.
+
+Collision order: where the reference's rbtree breaks replica-point hash
+ties by insertion order, both rings here order collisions by the full
+``(hash, owner)`` key — the host ring's ``(hash, server name)`` lexsort
+and this module's ``(hash << 32) | owner`` uint64 sort coincide because
+the device universe is address-sorted (universe index == sorted-name
+rank).  Deterministic, history-independent, and pinned bit-for-bit by
+the host/device property test (tests/models/test_ring_parity.py).
+
+This module is the ONE home of the ring kernels: the scalable storm
+driver (models/sim/storm.py) and the incremental routing plane
+(models/route/ring_kernel.py) import ``build_ring`` /
+``device_replica_hashes`` / ``ring_checksum`` from here rather than
+keeping copies.
 """
 
 from __future__ import annotations
@@ -23,6 +37,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ringpop_tpu.ops import native
+from ringpop_tpu.ops.record_mix import record_mix
 
 SENTINEL = np.uint64(0xFFFFFFFFFFFFFFFF)  # numpy: import stays device-free
 
@@ -47,6 +62,27 @@ def build_ring(replica_hashes: jax.Array, mask: jax.Array) -> jax.Array:
     keys = (replica_hashes.astype(jnp.uint64) << jnp.uint64(32)) | owners
     keys = jnp.where(mask[:, None], keys, SENTINEL)
     return jnp.sort(keys.reshape(-1))
+
+
+def device_replica_hashes(n: int, replica_points: int) -> jax.Array:
+    """[N, R] uint32 replica-point hashes from integer node ids (in-jit).
+
+    The scale analog of :func:`replica_table`: no address-string universe
+    at 100k-1M nodes, so replica points hash the integer node id instead
+    of ``addr + str(i)`` (models/sim/storm.py's ring)."""
+    ids = jnp.arange(n, dtype=jnp.int32)[:, None]
+    reps = jnp.arange(replica_points, dtype=jnp.int32)[None, :]
+    return record_mix(ids, reps, jnp.int64(0x5EED))
+
+
+def ring_checksum(ring: jax.Array) -> jax.Array:
+    """Order-sensitive uint32 digest of a ring table (the scale analog of
+    hash32 over sorted server names, lib/ring/index.js:96-105)."""
+    x = (ring & jnp.uint64(0xFFFFFFFF)).astype(jnp.uint32)
+    y = (ring >> jnp.uint64(32)).astype(jnp.uint32)
+    pos = jnp.arange(ring.shape[0], dtype=jnp.uint32)
+    mixed = record_mix(pos, x, y.astype(jnp.int64))
+    return jnp.sum(mixed, dtype=jnp.uint32)
 
 
 def ring_size(mask: jax.Array, replica_points: int) -> jax.Array:

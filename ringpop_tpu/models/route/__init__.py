@@ -1,0 +1,32 @@
+"""Batched device-resident request-routing plane (round 11).
+
+- :mod:`ring_kernel` — incremental hash-prefix-bucketed consistent-hash
+  ring (dirty-bucket re-merge, no per-tick sort) + the bit-exact
+  full-sort twin contract and the fixed-width ``lookup_n`` variant.
+- :mod:`traffic` — device-side Zipf traffic generator (threefry).
+- :mod:`plane` — the routing tick (misroute / reroute / keys-diverged /
+  checksum-reject counters) and the :class:`~plane.RoutedStorm` driver
+  coupling it to the scalable churn-storm engine.
+"""
+
+from ringpop_tpu.models.route.plane import (  # noqa: F401
+    RoutedStorm,
+    RouteMetrics,
+    RouteParams,
+    RouteState,
+    init_route_state,
+    resolve_ring_impl,
+    resolve_route_params,
+    route_tick,
+)
+from ringpop_tpu.models.route.ring_kernel import (  # noqa: F401
+    RingBuckets,
+    RingState,
+    build_buckets,
+    default_bucket_bits,
+    full_rebuild,
+    lookup,
+    lookup_n_fixed,
+    materialize,
+    update,
+)

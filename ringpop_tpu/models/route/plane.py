@@ -1,0 +1,465 @@
+"""Batched device-resident request-routing plane.
+
+The reference routes one request at a time on the host: hash the key,
+look up the owner, forward, and on failure retry after re-looking the
+keys up — rerouting when the ring moved, aborting when a multi-key
+request's keys now map to more than one owner, rejecting on a
+membership-checksum mismatch when ``enforceConsistency``
+(lib/request-proxy/send.js:91-208, index.js:168-229; faithfully ported
+host-side in api/request_proxy.py).  This module drives **millions of
+key lookups per tick** against the live churn-storm membership and
+measures those same semantics as device counters:
+
+- a Zipf traffic draw (models/route/traffic.py, threefry on device)
+  produces ``Q`` (sender, key) requests per tick,
+- each request is routed twice: under the **stale view** (the ring as
+  of the previous tick — the sender looked up before this tick's churn
+  disseminated) and under the **truth ring** (post-churn).  A
+  disagreement is a **misroute**: the retry's re-lookup reroutes it,
+  locally when the new owner is the sender itself
+  (send.js:190-198) or remotely otherwise (send.js:181-189),
+- a ``multi_key_frac`` slice of requests carries a second key; the pair
+  rides one envelope only when both keys agreed under the stale view
+  (the reference forwards one request per destination group).  If a
+  retry fires and the truth ring maps the pair to different owners,
+  that is a **keys-diverged abort** (send.js:91-104),
+- the request envelope carries the sender's membership checksum; the
+  (stale-view) destination compares it with its own — a mismatch bumps
+  the **checksums-differ** stat always and **rejects** the request only
+  under ``enforce_consistency`` (index.js:186-193).  Mismatch rejects
+  are retry triggers, like the reference's retryable checksum errors.
+
+Deviation envelope (vs per-request host emulation): requests are
+aggregates, not sessions — one retry round is modeled (stale -> truth),
+the stale view is uniformly one tick old rather than per-sender
+dissemination age, and per-node checksums come from the scalable
+engine's commutative record-mix sums (equal views <=> equal sums), so
+counter *rates* are the observable, not per-request traces.  The exact
+per-request semantics stay pinned by the host proxy's test suite
+(tests/integration/test_proxy.py) whose accounting these counters
+mirror one-to-one (see the statsd key map in obs/statsd_bridge.py).
+
+Ring maintenance is the perf headline: ``ring_impl="incremental"``
+(the ``"auto"`` resolution everywhere) maintains the hash-prefix-
+bucketed ring of models/route/ring_kernel.py — churn re-merges only
+dirty buckets, no per-tick sort.  ``ring_impl="full"`` is the bit-exact
+full-``jnp.sort`` twin (models/ring/device.py build_ring, the layout
+this kernel replaced): same lookups, same metrics, bitwise-identical
+materialized ring — the A/B baseline and the equivalence gate
+(tests/models/test_route_plane.py; bench.py route phase).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ringpop_tpu.models.ring import device as ringdev
+from ringpop_tpu.models.route import ring_kernel as rk
+from ringpop_tpu.models.route import traffic
+from ringpop_tpu.models.sim import engine_scalable as es
+
+
+class RouteParams(NamedTuple):
+    n: int
+    replica_points: int = 16
+    # hash-prefix bucket count = 2^bucket_bits; 0 = auto
+    # (ring_kernel.default_bucket_bits picks ~192 static points/bucket)
+    bucket_bits: int = 0
+    queries_per_tick: int = 4096
+    key_space: int = 1 << 16
+    zipf_s: float = 1.1
+    # fraction of requests carrying a second key (keys-diverged plane)
+    multi_key_frac: float = 0.125
+    enforce_consistency: bool = True
+    # "auto" -> "incremental"; "full" = per-tick jnp.sort twin (bitwise
+    # A/B baseline); see resolve_ring_impl
+    ring_impl: str = "auto"
+    # static caps on per-tick incremental work — these ARE the
+    # incremental path's cost (D dirty rows are gathered/re-merged
+    # whether or not they exist), so they are sized for steady sparse
+    # churn; a storm tick beyond either cap falls back to a (sortless)
+    # full re-compaction under lax.cond, bit-identically
+    max_changed: int = 128
+    max_dirty: int = 512
+    salt: int = 0x520337
+
+
+class RouteState(NamedTuple):
+    # exactly one of (ring, flat_ring) is live, picked by the static
+    # ring_impl: the bucketed state (incremental) or the previous tick's
+    # flat sorted ring (full twin).  ``mask`` (membership the stale ring
+    # reflects) rides inside ``ring`` for the incremental impl and here
+    # for the full twin — never both (the scanned driver donates the
+    # carry, and an aliased buffer cannot be donated twice).
+    ring: Optional[rk.RingState]
+    flat_ring: Optional[jax.Array]  # [N*R] uint64
+    mask: Optional[jax.Array]  # [N] bool (full impl only)
+    rng: jax.Array  # threefry key
+
+
+class RouteMetrics(NamedTuple):
+    """Per-tick routing counters (scalar int32; [T]-stacked under scan).
+    Field names are the runlog schema: every ``route_*`` tick field and
+    the statsd mapping in obs/statsd_bridge.py derive from here."""
+
+    route_queries: jax.Array  # requests with a live sender and a route
+    route_misroutes: jax.Array  # stale owner != truth owner
+    route_reroute_local: jax.Array  # retry landed on the sender itself
+    route_reroute_remote: jax.Array  # retry rerouted to a new remote owner
+    route_keys_diverged: jax.Array  # multi-key retries aborted (>1 owner)
+    route_checksums_differ: jax.Array  # envelope checksum != dest checksum
+    route_checksum_rejects: jax.Array  # ... and enforce_consistency rejected
+    route_ring_changed: jax.Array  # servers whose ring membership flipped
+    route_ring_dirty_buckets: jax.Array  # buckets those flips touched
+    route_ring_full_rebuilds: jax.Array  # 1 = churn overflowed the caps
+    route_ring_points: jax.Array  # active replica points in the truth ring
+
+
+def resolve_ring_impl(params: RouteParams, backend: str) -> str:
+    """Resolve ``ring_impl="auto"`` -> "incremental" on every backend:
+    the bucketed update is O(dirty) elementwise everywhere, and "full"
+    (the per-tick jnp.sort twin) exists for A/B measurement and the
+    bitwise equivalence gate, not as a production choice."""
+    if params.ring_impl != "auto":
+        if params.ring_impl not in ("full", "incremental"):
+            raise ValueError(
+                "ring_impl must be auto|full|incremental, got %r"
+                % (params.ring_impl,)
+            )
+        return params.ring_impl
+    return "incremental"
+
+
+def resolve_route_params(params: RouteParams, backend: str) -> RouteParams:
+    """Driver-level pin of the trace-time knobs (the storm analog of
+    resolve_scalable_params): concrete ring_impl + bucket_bits so the
+    shared executable caches key on fully-resolved params."""
+    bits = params.bucket_bits
+    if bits == 0:
+        bits = rk.default_bucket_bits(params.n, params.replica_points)
+    return params._replace(
+        ring_impl=resolve_ring_impl(params, backend), bucket_bits=bits
+    )
+
+
+def init_route_state(
+    params: RouteParams,
+    buckets: rk.RingBuckets,
+    reps: jax.Array,
+    in_ring: jax.Array,
+    seed: int = 0,
+) -> RouteState:
+    impl = resolve_ring_impl(params, jax.default_backend())
+    rng = jax.random.PRNGKey(np.uint32(seed) ^ np.uint32(params.salt))
+    if impl == "incremental":
+        return RouteState(
+            ring=rk.full_rebuild(buckets, in_ring),
+            flat_ring=None,
+            mask=None,
+            rng=rng,
+        )
+    return RouteState(
+        ring=None,
+        flat_ring=ringdev.build_ring(reps, in_ring),
+        mask=in_ring,
+        rng=rng,
+    )
+
+
+def route_tick(
+    state: RouteState,
+    buckets: rk.RingBuckets,
+    reps: jax.Array,
+    cdf: jax.Array,
+    in_ring: jax.Array,
+    proc_alive: jax.Array,
+    checksums: jax.Array,
+    params: RouteParams,
+) -> Tuple[RouteState, RouteMetrics]:
+    """One routing tick: refresh the truth ring from ``in_ring``
+    (incrementally or via the sort twin), route Q Zipf requests under
+    the stale + truth views, and count the send.js/index.js semantics.
+    Bitwise-identical metrics across ``ring_impl`` settings (the gate)."""
+    impl = resolve_ring_impl(params, jax.default_backend())
+    n = params.n
+    q = params.queries_per_tick
+    r = params.replica_points
+    k_send, k_key1, k_key2, k_multi, rng_next = jax.random.split(
+        state.rng, 5
+    )
+
+    prev_mask = state.ring.mask if impl == "incremental" else state.mask
+    if impl == "incremental":
+        # the update's OWN dirty stats feed the metrics: the reported
+        # route_ring_* numbers are by construction what the kernel did
+        truth_ring, n_changed, n_dirty, full_rebuilds = rk.update(
+            buckets,
+            state.ring,
+            in_ring,
+            max_changed=params.max_changed,
+            max_dirty=params.max_dirty,
+        )
+
+        def lookup_stale(kh):
+            return rk.lookup(state.ring, kh)
+
+        def lookup_truth(kh):
+            return rk.lookup(truth_ring, kh)
+
+        ring_points = truth_ring.n_points
+        new_state = RouteState(
+            ring=truth_ring, flat_ring=None, mask=None, rng=rng_next
+        )
+    else:  # "full": the per-tick jnp.sort twin
+        # same stats the incremental path WOULD report (shared helper,
+        # same caps) so the two impls' RouteMetrics stay bit-identical
+        n_changed, _dirty, n_dirty, overflow = rk.dirty_stats(
+            buckets, in_ring != prev_mask, params.max_changed,
+            params.max_dirty,
+        )
+        full_rebuilds = overflow.astype(jnp.int32)
+        stale_flat = state.flat_ring
+        stale_points = ringdev.ring_size(prev_mask, r)
+        truth_flat = ringdev.build_ring(reps, in_ring)
+        ring_points = ringdev.ring_size(in_ring, r)
+
+        def lookup_stale(kh):
+            return ringdev.lookup(stale_flat, stale_points, kh)
+
+        def lookup_truth(kh):
+            return ringdev.lookup(truth_flat, ring_points, kh)
+
+        new_state = RouteState(
+            ring=None, flat_ring=truth_flat, mask=in_ring, rng=rng_next
+        )
+
+    # -- traffic ---------------------------------------------------------
+    senders = jax.random.randint(k_send, (q,), 0, n, dtype=jnp.int32)
+    kh1 = traffic.key_hashes(traffic.sample_keys(k_key1, cdf, q))
+    own1_stale = lookup_stale(kh1)
+    own1_truth = lookup_truth(kh1)
+    # a request exists when its sender process is up and the stale view
+    # had an owner to send to
+    sendable = proc_alive[senders] & (own1_stale >= 0)
+
+    # -- misroute + retry reroute (send.js:91-208) -----------------------
+    misroute = sendable & (own1_truth != own1_stale)
+    reroute_local = misroute & (own1_truth == senders)
+    reroute_remote = misroute & (own1_truth != senders) & (own1_truth >= 0)
+
+    # -- checksum plane (index.js:168-229) -------------------------------
+    dest = jnp.clip(own1_stale, 0, n - 1)
+    differ = sendable & (checksums[senders] != checksums[dest])
+    rejects = differ if params.enforce_consistency else jnp.zeros(q, bool)
+
+    # -- keys-diverged (send.js:91-104) ----------------------------------
+    kh2 = traffic.key_hashes(traffic.sample_keys(k_key2, cdf, q))
+    own2_stale = lookup_stale(kh2)
+    own2_truth = lookup_truth(kh2)
+    is_multi = (
+        jax.random.uniform(k_multi, (q,), dtype=jnp.float32)
+        < jnp.float32(params.multi_key_frac)
+    )
+    # the pair rode one envelope only if both keys agreed at send time
+    multi_ok = is_multi & sendable & (own2_stale == own1_stale)
+    retried = misroute | rejects
+    diverged = multi_ok & retried & (own1_truth != own2_truth)
+
+    def cnt(mask):
+        return jnp.sum(mask, dtype=jnp.int32)
+
+    return new_state, RouteMetrics(
+        route_queries=cnt(sendable),
+        route_misroutes=cnt(misroute),
+        route_reroute_local=cnt(reroute_local),
+        route_reroute_remote=cnt(reroute_remote),
+        route_keys_diverged=cnt(diverged),
+        route_checksums_differ=cnt(differ),
+        route_checksum_rejects=cnt(rejects),
+        route_ring_changed=n_changed,
+        route_ring_dirty_buckets=n_dirty,
+        route_ring_full_rebuilds=full_rebuilds,
+        route_ring_points=ring_points.astype(jnp.int32),
+    )
+
+
+def in_ring_mask(state: es.ScalableState) -> jax.Array:
+    """Ring membership from scalable-engine truth: alive + suspect
+    servers stay in the ring (on_membership_event.js:106-134)."""
+    return state.proc_alive & (state.truth_status <= es.SUSPECT)
+
+
+@functools.lru_cache(maxsize=None)
+def _routed_fns(es_params: es.ScalableParams, route_params: RouteParams):
+    """Shared compiled executables for the coupled membership+routing
+    tick, keyed by the (fully-resolved) param pair — the storm driver's
+    caching discipline (storm._tick_fn/_scanned_fn)."""
+
+    def _body(carry, inp, buckets, reps, cdf):
+        est, rst = carry
+        est, em = es.tick(est, inp, es_params)
+        rst, rm = route_tick(
+            rst,
+            buckets,
+            reps,
+            cdf,
+            in_ring_mask(est),
+            est.proc_alive,
+            est.checksum,
+            route_params,
+        )
+        return (est, rst), (em, rm)
+
+    @functools.partial(jax.jit, donate_argnums=(0,))
+    def _tick(carry, inputs, buckets, reps, cdf):
+        return _body(carry, inputs, buckets, reps, cdf)
+
+    @functools.partial(jax.jit, donate_argnums=(0,))
+    def _scanned(carry, inputs, buckets, reps, cdf):
+        def body(c, inp):
+            return _body(c, inp, buckets, reps, cdf)
+
+        return jax.lax.scan(body, carry, inputs)
+
+    return _tick, _scanned
+
+
+def clear_executable_cache() -> None:
+    """Drop the shared compiled executables (storm.clear_executable_cache
+    analog for the routed driver)."""
+    _routed_fns.cache_clear()
+
+
+class RoutedStorm:
+    """ScalableCluster + routing plane under one scanned program.
+
+    Wraps a :class:`~ringpop_tpu.models.sim.storm.ScalableCluster` and
+    threads the route state through the same ``lax.scan``: every
+    membership tick is followed by a routing tick against the
+    just-updated truth (current ring) and the pre-tick view (stale
+    ring).  Metrics come back as ``(ScalableMetrics, RouteMetrics)``
+    stacks; an attached obs.RunRecorder receives them as ONE row stream
+    (tick rows carry both the sim and the ``route_*`` fields — the
+    schema scripts/check_metrics_schema.py validates).
+
+    DONATION CAVEAT: like ScalableCluster, step()/run() donate the
+    carried state — snapshot before stepping for before/after views."""
+
+    def __init__(
+        self,
+        n: int,
+        params: Optional[es.ScalableParams] = None,
+        route: Optional[RouteParams] = None,
+        replica_points: int = 16,
+        seed: int = 0,
+    ):
+        from ringpop_tpu.models.sim.storm import ScalableCluster
+
+        self.cluster = ScalableCluster(
+            n=n,
+            params=params,
+            replica_points=replica_points,
+            seed=seed,
+        )
+        if not self.cluster.params.checksum_in_tick:
+            raise ValueError(
+                "the routing plane's checksum counters read the in-tick "
+                "checksums — construct with checksum_in_tick=True"
+            )
+        route = route or RouteParams(n=n, replica_points=replica_points)
+        if route.n != n or route.replica_points != replica_points:
+            route = route._replace(n=n, replica_points=replica_points)
+        self.route_params = resolve_route_params(
+            route, jax.default_backend()
+        )
+        reps_np = np.asarray(
+            ringdev.device_replica_hashes(n, replica_points)
+        )
+        self.buckets = rk.build_buckets(
+            reps_np, self.route_params.bucket_bits
+        )
+        self.reps = jnp.asarray(reps_np)
+        self.cdf = traffic.zipf_cdf(
+            self.route_params.key_space, self.route_params.zipf_s
+        )
+        self.rstate = init_route_state(
+            self.route_params,
+            self.buckets,
+            self.reps,
+            in_ring_mask(self.cluster.state),
+            seed=seed,
+        )
+        self._tick, self._scanned = _routed_fns(
+            self.cluster.params, self.route_params
+        )
+        self.recorder = None
+
+    # -- driving ----------------------------------------------------------
+
+    def step(self, inputs: Optional[es.ChurnInputs] = None):
+        if inputs is None:
+            inputs = es.ChurnInputs.quiet(self.route_params.n)
+        carry, (em, rm) = self._tick(
+            (self.cluster.state, self.rstate),
+            inputs,
+            self.buckets,
+            self.reps,
+            self.cdf,
+        )
+        self.cluster.state, self.rstate = carry
+        em = jax.tree.map(np.asarray, em)
+        rm = jax.tree.map(np.asarray, rm)
+        self._record(em, rm)
+        return em, rm
+
+    def run(self, schedule):
+        carry, (em, rm) = self._scanned(
+            (self.cluster.state, self.rstate),
+            schedule.as_inputs(),
+            self.buckets,
+            self.reps,
+            self.cdf,
+        )
+        self.cluster.state, self.rstate = carry
+        em = jax.tree.map(np.asarray, em)
+        rm = jax.tree.map(np.asarray, rm)
+        self._record(em, rm)
+        return em, rm
+
+    # -- telemetry --------------------------------------------------------
+
+    def attach_recorder(self, recorder) -> None:
+        recorder.describe(
+            "sim.engine_scalable+route",
+            self.route_params.n,
+            self.cluster.params,
+            route_params=self.route_params._asdict(),
+        )
+        self.recorder = recorder
+
+    def _record(self, em, rm) -> None:
+        if self.recorder is None:
+            return
+        rows = dict(em._asdict())
+        rows.update(rm._asdict())
+        self.recorder.record_ticks(rows)
+
+    # -- inspection -------------------------------------------------------
+
+    def truth_ring(self) -> jax.Array:
+        """The flat sorted truth ring (materialized from the bucketed
+        state under "incremental") — the bitwise A/B gate surface."""
+        if self.route_params.ring_impl == "incremental":
+            return rk.materialize(
+                self.rstate.ring,
+                self.route_params.n * self.route_params.replica_points,
+            )
+        return self.rstate.flat_ring
+
+    def ring_checksum(self) -> int:
+        return int(ringdev.ring_checksum(self.truth_ring()))

@@ -1,0 +1,372 @@
+"""Incremental hash-prefix-bucketed consistent-hash ring.
+
+The classic device ring (models/ring/device.py, duplicated until round
+11 in storm.py) rebuilds under churn with a full ``jnp.sort`` of all
+N·R uint64 keys — at 1M nodes x 16 replica points that is a 1.6M-element
+sort *every tick*, even when churn touched three servers.  This module
+replaces the sort with a **bucketed ring**:
+
+- the static ``[N, R]`` replica table is partitioned ONCE at init into
+  ``2^B`` buckets by hash prefix (the top B bits of the replica-point
+  hash).  Within a bucket the static points are pre-sorted by their full
+  ``(hash << 32) | owner`` key — so a bucket's *active* subset, in
+  order, is a mask-compaction of a pre-sorted list: **no sort anywhere**,
+- the dynamic ring state caches, per bucket, the compacted active keys
+  (front-aligned, padded with the bucket's upper-boundary PAD key).
+  Churn touches few servers, so only the buckets holding a changed
+  server's replica points are *dirty*: the per-tick update gathers the
+  ``D`` dirty buckets (``jnp.nonzero(..., size=D)``), re-compacts those
+  rows in O(D·M), and scatters them back — every clean bucket reuses its
+  cached segment untouched.  When a tick's churn exceeds the static caps
+  (``max_changed`` servers / ``max_dirty`` buckets) the update falls
+  back to a full (still sortless, O(N·R)) re-compaction under
+  ``lax.cond``,
+- because a bucket's PAD key ``((b+1) << (64-B)) - 1`` is >= every real
+  key of bucket b and < every real key of bucket b+1, the flattened
+  ``[2^B * M]`` segment table is **globally non-decreasing** with the
+  padding interleaved — one ``jnp.searchsorted`` over the flat table
+  serves batched lookups with no per-query row gather.  A PAD hit (its
+  owner field decodes to -1) means the query ran past its bucket's last
+  active point; the owner is then the first active point of the next
+  non-empty bucket (``next_owner``, an O(2^B) suffix-scan refreshed per
+  update), wrapping to the global minimum exactly like the reference's
+  ``upperBound``-with-wraparound (lib/ring/index.js:145-154).
+
+Equivalence contract (the acceptance gate): :func:`materialize` compacts
+the bucketed state into the flat sorted layout and must equal
+``models/ring/device.build_ring(replica_hashes, mask)`` **bitwise** —
+both are the ascending multiset of active keys padded with the all-ones
+sentinel, and bucket-major/in-bucket order is global order because the
+bucket id is the key's top bits.  Pinned under randomized churn by
+tests/models/test_route_ring.py (n=64 tier-1, n>=64k slow) and by the
+bench/tpu_measure rebuild A/B's bitwise ring gate.
+
+:func:`lookup_n_fixed` is the vmap-friendly W-successor twin of the
+device ring's ``lookup_n``: the reference walk is a data-dependent
+``while_loop`` whose trip count degenerates to the worst case across a
+batch under vmap; the fixed-width variant gathers ``width`` successor
+slots and masks first-occurrence owners.  It returns bit-identical
+owners whenever the window held ``n`` unique owners or covered the whole
+ring (``width >= n_points``) — the documented envelope, proven in the
+same test file.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+SENTINEL = np.uint64(0xFFFFFFFFFFFFFFFF)
+
+
+class RingBuckets(NamedTuple):
+    """Static hash-prefix partition of the [N, R] replica table (built
+    once per universe by :func:`build_buckets`; every array is
+    churn-independent).  ``2^B = keys.shape[0]``, ``M = keys.shape[1]``
+    (max static bucket occupancy)."""
+
+    keys: jax.Array  # [2^B, M] uint64 — static keys, in-bucket sorted; PAD-padded
+    owners: jax.Array  # [2^B, M] int32 — key's owner (-1 on PAD slots)
+    point_bucket: jax.Array  # [N, R] int32 — bucket id of each replica point
+
+
+class RingState(NamedTuple):
+    """Dynamic bucketed ring: per-bucket active keys compacted to the
+    row front, PAD-padded; refreshed incrementally by :func:`update`."""
+
+    seg_keys: jax.Array  # [2^B, M] uint64
+    count: jax.Array  # [2^B] int32 — active points per bucket
+    mask: jax.Array  # [N] bool — the membership this state reflects
+    n_points: jax.Array  # scalar int32 — total active points
+    first_owner: jax.Array  # scalar int32 — owner of the global min key (-1 empty)
+    next_owner: jax.Array  # [2^B] int32 — first active owner strictly after b (wraps)
+
+
+def default_bucket_bits(n: int, replica_points: int, target_load: int = 192) -> int:
+    """B such that the mean bucket holds ~``target_load`` static points
+    (clamped to [1, 16] — past 64k buckets the O(2^B) per-tick suffix
+    scan stops being negligible)."""
+    total = max(1, n * replica_points)
+    return max(1, min(16, int(math.log2(max(2, total // target_load)))))
+
+
+def _pad_rows(n_buckets: int, m: int) -> jax.Array:
+    """[2^B, M] uint64 PAD keys: bucket b's pad is its upper boundary
+    ``((b+1) << (64-B)) - 1`` — >= every real key of b, < every real key
+    of b+1, owner field all-ones (decodes to -1)."""
+    # n_buckets is always a static python int (a .shape[0]); the lint
+    # cannot see through the parameter
+    b_bits = int(math.log2(n_buckets))  # jaxgate: ignore[host-coerce]
+    ids = jnp.arange(n_buckets, dtype=jnp.uint64)
+    pads = ((ids + jnp.uint64(1)) << jnp.uint64(64 - b_bits)) - jnp.uint64(1)
+    return jnp.broadcast_to(pads[:, None], (n_buckets, m))
+
+
+def build_buckets(
+    replica_hashes: np.ndarray, bucket_bits: int
+) -> RingBuckets:  # jaxgate: host — one-time init partition, never traced
+    """Partition the static replica table into 2^B hash-prefix buckets
+    (host-side numpy, once per universe)."""
+    if not (1 <= bucket_bits <= 20):
+        raise ValueError("bucket_bits must be in [1, 20], got %d" % bucket_bits)
+    hashes = np.asarray(replica_hashes, dtype=np.uint32)
+    n, r = hashes.shape
+    nb = 1 << bucket_bits
+    owners = np.broadcast_to(
+        np.arange(n, dtype=np.uint64)[:, None], (n, r)
+    )
+    keys = (hashes.astype(np.uint64) << np.uint64(32)) | owners
+    bucket = (hashes >> np.uint32(32 - bucket_bits)).astype(np.int64)
+    flat_keys = keys.reshape(-1)
+    flat_bucket = bucket.reshape(-1)
+    counts = np.bincount(flat_bucket, minlength=nb)
+    cap = max(1, int(counts.max()))
+    # global ascending key order == (bucket, in-bucket key) order, since
+    # the bucket id is the key's top B bits
+    order = np.argsort(flat_keys, kind="stable")
+    sorted_bucket = flat_bucket[order]
+    starts = np.zeros(nb + 1, dtype=np.int64)
+    np.cumsum(counts, out=starts[1:])
+    within = np.arange(flat_keys.size, dtype=np.int64) - starts[sorted_bucket]
+    pad_vals = ((np.arange(nb, dtype=np.uint64) + 1) << np.uint64(
+        64 - bucket_bits
+    )) - np.uint64(1)
+    skeys = np.broadcast_to(pad_vals[:, None], (nb, cap)).copy()
+    sowners = np.full((nb, cap), -1, dtype=np.int32)
+    skeys[sorted_bucket, within] = flat_keys[order]
+    sowners[sorted_bucket, within] = (
+        flat_keys[order] & np.uint64(0xFFFFFFFF)
+    ).astype(np.int32)
+    return RingBuckets(
+        keys=jnp.asarray(skeys),
+        owners=jnp.asarray(sowners),
+        point_bucket=jnp.asarray(bucket.astype(np.int32)),
+    )
+
+
+def _compact_rows(
+    keys: jax.Array,  # [K, M] uint64 static keys
+    pads: jax.Array,  # [K, M] uint64 PAD values for these rows
+    active: jax.Array,  # [K, M] bool
+) -> Tuple[jax.Array, jax.Array]:
+    """Per-row stable mask-compaction of pre-sorted keys: active keys
+    move to the row front (order preserved), the tail is PAD.  The whole
+    're-merge' of a dirty bucket — O(M), no sort."""
+    k, m = keys.shape
+    pos = jnp.cumsum(active.astype(jnp.int32), axis=1, dtype=jnp.int32) - 1
+    rows = jnp.arange(k, dtype=jnp.int32)[:, None]
+    tgt = jnp.where(active, rows * m + pos, jnp.int32(k * m))
+    seg = (
+        pads.reshape(-1)
+        .at[tgt.reshape(-1)]
+        .set(keys.reshape(-1), mode="drop")
+        .reshape(k, m)
+    )
+    cnt = jnp.sum(active.astype(jnp.int32), axis=1, dtype=jnp.int32)
+    return seg, cnt
+
+
+def _derive(
+    seg_keys: jax.Array, count: jax.Array, mask: jax.Array
+) -> RingState:
+    """Refresh the lookup helpers (first/next owner, totals) from the
+    per-bucket segments — O(2^B), every update pays it."""
+    nb = count.shape[0]
+    firsts = jnp.where(
+        count > 0,
+        (seg_keys[:, 0] & jnp.uint64(0xFFFFFFFF)).astype(jnp.int32),
+        jnp.int32(-1),
+    )
+    # smallest non-empty bucket index at-or-after b (suffix min of
+    # masked indices), then shift for strictly-after + wraparound
+    idx = jnp.where(
+        count > 0, jnp.arange(nb, dtype=jnp.int32), jnp.int32(2 * nb)
+    )
+    at_or_after = jax.lax.associative_scan(
+        jnp.minimum, idx, reverse=True
+    )
+    first_idx = at_or_after[0]  # global first non-empty bucket (or 2*nb)
+    after = jnp.concatenate(
+        [at_or_after[1:], jnp.full((1,), 2 * nb, jnp.int32)]
+    )
+    nxt_idx = jnp.where(after < 2 * nb, after, first_idx)
+    ring_nonempty = first_idx < 2 * nb
+    next_owner = jnp.where(
+        ring_nonempty,
+        firsts[jnp.clip(nxt_idx, 0, nb - 1)],
+        jnp.int32(-1),
+    )
+    first_owner = jnp.where(
+        ring_nonempty, firsts[jnp.clip(first_idx, 0, nb - 1)], jnp.int32(-1)
+    )
+    return RingState(
+        seg_keys=seg_keys,
+        count=count,
+        mask=mask,
+        n_points=jnp.sum(count, dtype=jnp.int32),
+        first_owner=first_owner,
+        next_owner=next_owner,
+    )
+
+
+def full_rebuild(buckets: RingBuckets, mask: jax.Array) -> RingState:
+    """Recompact every bucket from the static table + current mask —
+    O(N·R) elementwise, zero sorts.  The init path and the overflow
+    fallback of :func:`update`; bit-identical to the incremental path by
+    construction (same compaction, all rows)."""
+    n = mask.shape[0]
+    nb, m = buckets.keys.shape
+    active = mask[jnp.clip(buckets.owners, 0, n - 1)] & (buckets.owners >= 0)
+    seg, cnt = _compact_rows(buckets.keys, _pad_rows(nb, m), active)
+    return _derive(seg, cnt, mask)
+
+
+def dirty_stats(
+    buckets: RingBuckets,
+    changed: jax.Array,  # [N] bool — servers whose ring membership flipped
+    max_changed: int,
+    max_dirty: int,
+) -> Tuple[jax.Array, jax.Array, jax.Array, jax.Array]:
+    """(n_changed, dirty mask [2^B], n_dirty, overflow).  Shared by the
+    incremental update and the full-sort twin's metrics so the two
+    modes' RouteMetrics stay bitwise-identical: the stats describe what
+    the incremental path WOULD do, whichever path runs."""
+    n = changed.shape[0]
+    nb = buckets.keys.shape[0]
+    n_changed = jnp.sum(changed, dtype=jnp.int32)
+    (c_idx,) = jnp.nonzero(changed, size=max_changed, fill_value=n)
+    pb = buckets.point_bucket[jnp.clip(c_idx, 0, n - 1)]
+    pb = jnp.where((c_idx < n)[:, None], pb, jnp.int32(nb))
+    dirty = (
+        jnp.zeros(nb, bool).at[pb.reshape(-1)].set(True, mode="drop")
+    )
+    n_dirty = jnp.sum(dirty, dtype=jnp.int32)
+    overflow = (n_changed > max_changed) | (n_dirty > max_dirty)
+    return n_changed, dirty, n_dirty, overflow
+
+
+def update(
+    buckets: RingBuckets,
+    state: RingState,
+    new_mask: jax.Array,
+    *,
+    max_changed: int,
+    max_dirty: int,
+) -> Tuple[RingState, jax.Array, jax.Array, jax.Array]:
+    """Incremental ring maintenance: re-merge only the dirty buckets.
+
+    Returns ``(state', n_changed, n_dirty, full_rebuilds)`` where
+    ``full_rebuilds`` is 1 iff the churn overflowed the static caps and
+    the update fell back to :func:`full_rebuild` (bit-identical either
+    way).  Per-tick cost on the incremental path:
+    O(max_changed·R + max_dirty·M + 2^B)."""
+    n = new_mask.shape[0]
+    nb, m = buckets.keys.shape
+    changed = new_mask != state.mask
+    n_changed, dirty, n_dirty, overflow = dirty_stats(
+        buckets, changed, max_changed, max_dirty
+    )
+
+    def _incremental(st: RingState) -> RingState:
+        (d_idx,) = jnp.nonzero(dirty, size=max_dirty, fill_value=nb)
+        dc = jnp.clip(d_idx, 0, nb - 1)
+        k_rows = buckets.keys[dc]
+        o_rows = buckets.owners[dc]
+        act = new_mask[jnp.clip(o_rows, 0, n - 1)] & (o_rows >= 0)
+        seg_rows, cnt_rows = _compact_rows(
+            k_rows, _pad_rows(nb, m)[dc], act
+        )
+        rows_tgt = jnp.where(d_idx < nb, d_idx, jnp.int32(nb))
+        seg = st.seg_keys.at[rows_tgt].set(seg_rows, mode="drop")
+        cnt = st.count.at[rows_tgt].set(cnt_rows, mode="drop")
+        return _derive(seg, cnt, new_mask)
+
+    new_state = jax.lax.cond(
+        overflow,
+        lambda st: full_rebuild(buckets, new_mask),
+        _incremental,
+        state,
+    )
+    return new_state, n_changed, n_dirty, overflow.astype(jnp.int32)
+
+
+def materialize(state: RingState, total_points: int) -> jax.Array:
+    """Flatten the bucketed state into the classic sorted ring layout —
+    ``[total_points]`` uint64 ascending active keys, all-ones-sentinel
+    padded — bitwise-equal to ``device.build_ring(replica_hashes,
+    state.mask)`` (the equivalence gate; also the interop path for
+    consumers of the flat layout like :func:`lookup_n_fixed`)."""
+    nb, m = state.seg_keys.shape
+    slot = jnp.arange(m, dtype=jnp.int32)[None, :]
+    active = slot < state.count[:, None]
+    starts = jnp.cumsum(state.count, dtype=jnp.int32) - state.count
+    pos = starts[:, None] + slot
+    tgt = jnp.where(active, pos, jnp.int32(total_points))
+    return (
+        jnp.full((total_points,), jnp.uint64(SENTINEL), jnp.uint64)
+        .at[tgt.reshape(-1)]
+        .set(state.seg_keys.reshape(-1), mode="drop")
+    )
+
+
+def lookup(state: RingState, key_hashes: jax.Array) -> jax.Array:
+    """Batched owner lookup on the bucketed ring: [Q] uint32 key hashes
+    -> [Q] int32 owners (-1 when the ring is empty).  One searchsorted
+    over the flat segment table (globally sorted with PADs interleaved);
+    a PAD hit routes through ``next_owner`` (successor in a later
+    bucket, wrapping), an off-the-end hit wraps to ``first_owner`` —
+    the reference's lower-bound-with-wraparound, identical to
+    ``device.lookup`` on the materialized ring."""
+    nb, m = state.seg_keys.shape
+    total = nb * m
+    q = key_hashes.astype(jnp.uint64) << jnp.uint64(32)
+    flat = state.seg_keys.reshape(-1)
+    i = jnp.searchsorted(flat, q).astype(jnp.int32)
+    ic = jnp.clip(i, 0, total - 1)
+    owner = (flat[ic] & jnp.uint64(0xFFFFFFFF)).astype(jnp.int32)
+    own = jnp.where(owner < 0, state.next_owner[ic // m], owner)
+    own = jnp.where(i >= total, state.first_owner, own)
+    return jnp.where(state.n_points > 0, own, jnp.int32(-1))
+
+
+def lookup_n_fixed(
+    ring: jax.Array,  # flat sorted ring (device.build_ring / materialize)
+    n_points: jax.Array,
+    key_hash: jax.Array,  # scalar uint32 (vmap for batches)
+    n: int,
+    width: int,
+) -> Tuple[jax.Array, jax.Array]:
+    """Fixed-width W-successor twin of ``device.lookup_n``: gather
+    ``width`` successor slots, mask first-occurrence owners, keep the
+    first ``n``.  Returns ``(owners [n] int32 -1-padded, found)``.
+
+    Bit-identical to the while_loop walk whenever ``found == n`` or
+    ``width >= n_points`` (the window saw the whole ring) — the
+    documented envelope; unlike the walk, the trip count is static, so
+    a vmapped batch never degenerates to the slowest query's bound."""
+    query = key_hash.astype(jnp.uint64) << jnp.uint64(32)
+    start = jnp.searchsorted(ring, query).astype(jnp.int32)
+    steps = jnp.arange(width, dtype=jnp.int32)
+    npts = jnp.maximum(n_points, 1)
+    idx = (start + steps) % npts
+    owners = (ring[idx] & jnp.uint64(0xFFFFFFFF)).astype(jnp.int32)
+    visited = steps < n_points
+    owners = jnp.where(visited, owners, jnp.int32(-1))
+    dup = jnp.tril(owners[:, None] == owners[None, :], k=-1).any(axis=1)
+    is_new = visited & ~dup
+    rank = jnp.cumsum(is_new.astype(jnp.int32), dtype=jnp.int32) - 1
+    found = jnp.sum(is_new.astype(jnp.int32), dtype=jnp.int32)
+    out = (
+        jnp.full((n,), -1, jnp.int32)
+        .at[jnp.where(is_new & (rank < n), rank, jnp.int32(n))]
+        .set(owners, mode="drop")
+    )
+    empty = n_points <= 0
+    return (
+        jnp.where(empty, jnp.int32(-1), out),
+        jnp.where(empty, jnp.int32(0), jnp.minimum(found, n)),
+    )

@@ -1,0 +1,44 @@
+"""Device-side Zipf traffic generator for the routing plane.
+
+Real request traffic is heavy-tailed; the routing plane models it as a
+Zipf(s) draw over a static key universe of ``K`` keys, sampled entirely
+on device (threefry counters via ``jax.random`` — no host RNG anywhere
+in the scanned tick).  The CDF over the K ranks is computed once at
+driver init (``zipf_cdf``) and sampling is one uniform draw + one
+``searchsorted`` per query — the same batched-binary-search shape the
+ring lookups use.
+
+Key identity -> ring position goes through :func:`key_hashes`, the
+integer-keyed record-mix analog of the reference hashing the key string
+with FarmHash32 before the ring lookup (lib/ring/index.js:145-147) —
+string-keyed bit-parity belongs to the full-fidelity host path
+(api/request_proxy.py + models/ring/host.py)."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ringpop_tpu.ops.record_mix import record_mix
+
+
+def zipf_cdf(n_keys: int, s: float) -> jax.Array:
+    """[K] float32 CDF of Zipf(s) over key ranks 1..K (trace-time
+    constant; exact inverse-CDF sampling against it)."""
+    ranks = jnp.arange(1, n_keys + 1, dtype=jnp.float32)
+    w = ranks ** jnp.float32(-s)
+    c = jnp.cumsum(w, dtype=jnp.float32)
+    return c / c[-1]
+
+
+def sample_keys(key: jax.Array, cdf: jax.Array, q: int) -> jax.Array:
+    """[Q] int32 key ids drawn Zipf-distributed via inverse CDF."""
+    u = jax.random.uniform(key, (q,), dtype=jnp.float32)
+    ids = jnp.searchsorted(cdf, u, side="left").astype(jnp.int32)
+    return jnp.clip(ids, 0, cdf.shape[0] - 1)
+
+
+def key_hashes(key_ids: jax.Array, salt: int = 0x51C7E7) -> jax.Array:
+    """[Q] uint32 ring-position hashes of integer key ids."""
+    z = jnp.zeros_like(key_ids)
+    return record_mix(key_ids, z + jnp.int32(salt), z)

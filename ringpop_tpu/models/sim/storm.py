@@ -28,8 +28,12 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ringpop_tpu.models.ring.device import (  # noqa: F401 — re-exported
+    build_ring,
+    device_replica_hashes,
+    ring_checksum,
+)
 from ringpop_tpu.models.sim import engine_scalable as es
-from ringpop_tpu.ops.record_mix import record_mix
 
 
 @dataclasses.dataclass
@@ -91,33 +95,6 @@ class StormSchedule:
         sched.kill[fail_tick, victims] = True
         sched.revive[rejoin_tick, victims] = True
         return sched
-
-
-def device_replica_hashes(n: int, replica_points: int) -> jax.Array:
-    """[N, R] uint32 replica-point hashes from integer node ids (in-jit)."""
-    ids = jnp.arange(n, dtype=jnp.int32)[:, None]
-    reps = jnp.arange(replica_points, dtype=jnp.int32)[None, :]
-    return record_mix(ids, reps, jnp.int64(0x5EED))
-
-
-def build_ring(replica_hashes: jax.Array, mask: jax.Array) -> jax.Array:
-    """Masked-sort ring table: [N*R] uint64 (hash<<32 | owner), inactive
-    replica points pushed past the end as the all-ones sentinel."""
-    n, r = replica_hashes.shape
-    owners = jnp.broadcast_to(jnp.arange(n, dtype=jnp.uint64)[:, None], (n, r))
-    keys = (replica_hashes.astype(jnp.uint64) << jnp.uint64(32)) | owners
-    keys = jnp.where(mask[:, None], keys, jnp.uint64(0xFFFFFFFFFFFFFFFF))
-    return jnp.sort(keys.reshape(-1))
-
-
-def ring_checksum(ring: jax.Array) -> jax.Array:
-    """Order-sensitive uint32 digest of the ring table (the scale analog of
-    hash32 over sorted server names, lib/ring/index.js:96-105)."""
-    x = (ring & jnp.uint64(0xFFFFFFFF)).astype(jnp.uint32)
-    y = (ring >> jnp.uint64(32)).astype(jnp.uint32)
-    pos = jnp.arange(ring.shape[0], dtype=jnp.uint32)
-    mixed = record_mix(pos, x, y.astype(jnp.int64))
-    return jnp.sum(mixed, dtype=jnp.uint32)
 
 
 @functools.lru_cache(maxsize=None)

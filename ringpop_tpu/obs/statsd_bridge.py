@@ -48,6 +48,24 @@ TICK_KEY_MAP: Dict[str, Tuple[str, str]] = {
     "leaves_published": ("increment", "membership-update.leave"),
     "rumors_retired": ("increment", "changes.drop"),
     "mean_heard_frac": ("gauge", "sim.rumors.mean-heard-frac"),
+    # routing plane (RouteMetrics, models/route/plane.py) — mapped onto
+    # the reference's requestProxy.* emission sites (send.js:91-208,
+    # request-proxy/index.js:186-193); ring-maintenance diagnostics ride
+    # the sim. namespace
+    "route_queries": ("increment", "requestProxy.requests.outgoing"),
+    "route_misroutes": ("increment", "sim.route.misroutes"),
+    "route_reroute_local": ("increment", "requestProxy.retry.reroute.local"),
+    "route_reroute_remote": (
+        "increment",
+        "requestProxy.retry.reroute.remote",
+    ),
+    "route_keys_diverged": ("increment", "requestProxy.retry.aborted"),
+    "route_checksums_differ": ("increment", "requestProxy.checksumsDiffer"),
+    "route_checksum_rejects": ("increment", "sim.route.checksum-rejects"),
+    "route_ring_changed": ("increment", "sim.route.ring.changed-servers"),
+    "route_ring_dirty_buckets": ("gauge", "sim.route.ring.dirty-buckets"),
+    "route_ring_full_rebuilds": ("increment", "sim.route.ring.full-rebuilds"),
+    "route_ring_points": ("gauge", "sim.route.ring.points"),
 }
 
 

@@ -25,6 +25,71 @@ import sys
 
 REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
+# Routing-plane schema (round 11): a tick row that carries ANY route_*
+# field must carry the full RouteMetrics counter set — a partial row
+# means the recorder and the engine's RouteMetrics drifted.  Kept in
+# lockstep with models/route/plane.RouteMetrics._fields by
+# tests/obs/test_runlog_schema.py.
+ROUTE_TICK_FIELDS = frozenset(
+    {
+        "route_queries",
+        "route_misroutes",
+        "route_reroute_local",
+        "route_reroute_remote",
+        "route_keys_diverged",
+        "route_checksums_differ",
+        "route_checksum_rejects",
+        "route_ring_changed",
+        "route_ring_dirty_buckets",
+        "route_ring_full_rebuilds",
+        "route_ring_points",
+    }
+)
+# event rows announcing a measured routing window must identify the ring
+# implementation and the workload shape
+ROUTE_EVENT_FIELDS = {
+    "route_window": ("ring_impl", "n", "q"),
+    "route_rebuild_ab": ("n", "incremental_ms", "full_sort_ms"),
+}
+
+
+def _check_route_rows(path: str) -> list:
+    """Routing-plane runlog validation: complete route_* tick rows and
+    well-formed route event rows."""
+    problems = []
+    with open(path, encoding="utf-8") as fh:
+        for ln, line in enumerate(fh, 1):
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                row = json.loads(line)
+            except ValueError:
+                continue  # validate_run_log already reports this
+            if not isinstance(row, dict):
+                continue
+            if row.get("kind") == "tick" and isinstance(
+                row.get("metrics"), dict
+            ):
+                keys = set(row["metrics"])
+                if any(k.startswith("route_") for k in keys):
+                    missing = ROUTE_TICK_FIELDS - keys
+                    if missing:
+                        problems.append(
+                            "%s:%d: route tick row missing %s"
+                            % (path, ln, ", ".join(sorted(missing)))
+                        )
+            elif row.get("kind") == "event":
+                need = ROUTE_EVENT_FIELDS.get(row.get("name"))
+                if need:
+                    for field in need:
+                        if field not in row:
+                            problems.append(
+                                "%s:%d: %s event missing %r"
+                                % (path, ln, row["name"], field)
+                            )
+    return problems
+
 
 def find_run_logs(root: str = REPO_ROOT) -> list:
     return sorted(
@@ -93,6 +158,7 @@ def check(paths=None, verbose: bool = True) -> list:
         else:
             found = validate_run_log(path)
             found.extend(_check_sidecar_links(path))
+            found.extend(_check_route_rows(path))
         problems.extend(found)
         if verbose:
             status = "OK" if not found else "%d problem(s)" % len(found)

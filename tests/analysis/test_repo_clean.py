@@ -47,6 +47,11 @@ def test_jaxpr_prong_covers_required_entry_points():
         "engine-scalable-tick-fused",
         "exchange-xla",
         "exchange-pallas",
+        # ISSUE 6 acceptance: the routing plane's tick (both ring impls)
+        # and the incremental ring-maintenance kernel are traced entries
+        "route-tick-incremental",
+        "route-tick-full",
+        "route-ring-incremental",
     } <= names
     assert len(names) >= 5
 

@@ -19,6 +19,7 @@ CHEAP_PROBES = (
     "fused-checksum-xla",
     "ring-device-lookup",
     "exchange-xla",  # [8,4] op jit — seconds, not an engine-tick compile
+    "route-tick",  # n=8 routing tick — small searchsorted graphs, cheap
 )
 
 

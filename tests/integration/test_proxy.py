@@ -819,3 +819,115 @@ def test_send_on_destroyed_channel_refused_up_front(cluster):
         assert _stat_count(sender, "requestProxy.retry.attempted") == before
     finally:
         sender.channel.destroyed = False
+
+
+# -- retry accounting closure (ISSUE 6 satellite) ---------------------------
+# The routing plane's device counters (models/route/plane.RouteMetrics)
+# mirror these statsd keys one-to-one (obs/statsd_bridge.py); the tests
+# below pin the per-request accounting the aggregate counters must match:
+# send.js:91-208 client semantics, request-proxy/index.js:168-229 server.
+
+
+def _counts(rp, *suffixes):
+    return {s: _stat_count(rp, "requestProxy.%s" % s) for s in suffixes}
+
+
+def test_keys_diverged_abort_closes_retry_aborted_and_send_error(cluster):
+    """A keys-diverged abort on the retry re-lookup closes the request's
+    accounting: retry.aborted + send.error fire exactly once, and NO
+    success stat fires (send.js:91-104 — the request fails permanently,
+    it is not rerouted)."""
+    c = cluster(n=3)
+    wire_echo_handlers(c)
+    sender = c.node(0)
+    sender.request_proxy.retry_schedule_s = [0.0]
+    k1 = key_owned_by(c, c.node(1), tag="acc1")
+    k2 = key_owned_by(c, c.node(2), tag="acc2")
+    before = _counts(
+        sender,
+        "retry.attempted", "retry.aborted", "retry.succeeded",
+        "send.error", "send.success",
+    )
+    # first attempt targets a dead address -> ChannelError -> retry path
+    # re-looks up BOTH keys, finds two owners, aborts
+    with pytest.raises(errors.KeysDivergedError):
+        sender.proxy_req(
+            {"keys": [k1, k2], "dest": "127.0.0.1:1", "req": {"url": "/d"}}
+        )
+    after = _counts(
+        sender,
+        "retry.attempted", "retry.aborted", "retry.succeeded",
+        "send.error", "send.success",
+    )
+    delta = {k: after[k] - before[k] for k in after}
+    assert delta["retry.attempted"] == 1
+    assert delta["retry.aborted"] == 1
+    assert delta["send.error"] == 1
+    assert delta["retry.succeeded"] == 0
+    assert delta["send.success"] == 0
+
+
+def test_reroute_local_fires_full_success_accounting(cluster):
+    """A retry rerouted to the SENDER serves in-process AND fires the
+    complete success accounting — reroute.local, retry.succeeded and
+    send.success — exactly like a remote landing (send.js:190-198); no
+    error stat leaks."""
+    c = cluster(n=2)
+    wire_echo_handlers(c)
+    sender = c.node(0)
+    sender.request_proxy.retry_schedule_s = [0.0]
+    key = key_owned_by(c, sender, tag="accl")
+    before = _counts(
+        sender,
+        "retry.attempted", "retry.reroute.local", "retry.succeeded",
+        "send.success", "send.error", "retry.aborted",
+    )
+    res = sender.proxy_req(
+        {"keys": [key], "dest": "127.0.0.1:1", "req": {"url": "/l"}}
+    )
+    assert res["body"]["handledBy"] == sender.whoami()
+    after = _counts(
+        sender,
+        "retry.attempted", "retry.reroute.local", "retry.succeeded",
+        "send.success", "send.error", "retry.aborted",
+    )
+    delta = {k: after[k] - before[k] for k in after}
+    assert delta["retry.attempted"] == 1
+    assert delta["retry.reroute.local"] == 1
+    assert delta["retry.succeeded"] == 1
+    assert delta["send.success"] == 1
+    assert delta["send.error"] == 0
+    assert delta["retry.aborted"] == 0
+
+
+def test_destroyed_channel_mid_retry_aborts_without_success_stats(cluster):
+    """A channel destroyed between attempts aborts the in-flight retry
+    (send.js:228-234) with NO success accounting and no further retry
+    attempts — the abort happens at the pre-attempt destroyed check,
+    before any forwarding."""
+    c = cluster(n=2)
+    sender = c.node(0)
+    sender.request_proxy.retry_schedule_s = [0.0]
+    remote = c.node(1).whoami()
+
+    def destroy_channel_then_relookup(keys, dest):
+        sender.channel.destroyed = True
+        return remote
+
+    sender.request_proxy._relookup = destroy_channel_then_relookup
+    before = _counts(
+        sender, "retry.attempted", "retry.succeeded", "send.success"
+    )
+    try:
+        with pytest.raises(errors.RequestProxyDestroyedError):
+            sender.proxy_req(
+                {"keys": ["k"], "dest": "127.0.0.1:1", "req": {"url": "/x"}}
+            )
+        after = _counts(
+            sender, "retry.attempted", "retry.succeeded", "send.success"
+        )
+        assert after["retry.attempted"] - before["retry.attempted"] == 1
+        assert after["retry.succeeded"] == before["retry.succeeded"]
+        assert after["send.success"] == before["send.success"]
+    finally:
+        sender.channel.destroyed = False

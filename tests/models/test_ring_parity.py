@@ -1,0 +1,159 @@
+"""Host/device ring parity property test (ISSUE 6 satellite).
+
+Randomized masks, churn sequences and keys: ``HashRing.lookup/lookup_n``
+(models/ring/host.py, the reference-semantics numpy ring) must agree
+BIT-FOR-BIT with ``device.lookup/lookup_n`` (models/ring/device.py) on
+every query — including across replica-point hash collisions, where
+both rings order colliding points by (hash, universe index): the host
+ring lexsorts (hash, server name) and the device ring sorts
+``(hash << 32) | owner``, which coincide because the device universe is
+address-sorted.  This is the collision-order claim pinned in both
+module docstrings."""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from ringpop_tpu.models.ring import HashRing
+from ringpop_tpu.models.ring import device as dring
+from ringpop_tpu.ops import farmhash32 as fh
+
+
+def _universe(n):
+    # mixed port widths so lexicographic name order is exercised
+    return sorted(
+        ["10.0.%d.%d:%d" % (i % 7, i, 3000 + 13 * i) for i in range(n)]
+    )
+
+
+def _host_ring_for(universe, mask):
+    host = HashRing(replica_points=20)
+    host.add_remove_servers(
+        [s for s, m in zip(universe, mask) if m], None
+    )
+    return host
+
+
+@functools.lru_cache(maxsize=None)
+def _lookup_fn(n_lookup: int):
+    # one compiled program per (ring size, n_lookup) shape — eager
+    # per-key retracing of the lookup_n while_loop dominated this
+    # file's runtime otherwise (tier-1 budget)
+    @jax.jit
+    def run(table, mask, khashes):
+        ring = dring.build_ring(table, mask)
+        n_points = dring.ring_size(mask, table.shape[1])
+        one = dring.lookup(ring, n_points, khashes)
+        many = jax.vmap(
+            lambda h: dring.lookup_n(ring, n_points, h, n_lookup)
+        )(khashes)
+        return one, many
+
+    return run
+
+
+def _device_owner_names(universe, table, mask, keys, n_lookup):
+    khashes = jnp.asarray(fh.hash32_strings([str(k) for k in keys]))
+    one, many = _lookup_fn(n_lookup)(
+        jnp.asarray(table), jnp.asarray(mask), khashes
+    )
+    return np.asarray(one), np.asarray(many)
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2])
+def test_host_device_parity_random_masks_and_keys(seed):
+    rng = np.random.default_rng(seed)
+    universe = _universe(24)
+    table = dring.replica_table(universe, replica_points=20)
+    keys = ["key-%d-%d" % (seed, i) for i in range(120)]
+
+    mask = rng.random(24) < rng.uniform(0.15, 0.95)
+    if not mask.any():
+        mask[0] = True
+    host = _host_ring_for(universe, mask)
+    one, many = _device_owner_names(universe, table, mask, keys, 4)
+    for k, o, m in zip(keys, one, many):
+        assert universe[int(o)] == host.lookup(k), k
+        got = [universe[int(x)] for x in m if int(x) >= 0]
+        assert got == host.lookup_n(k, 4), k
+
+
+def test_host_device_parity_under_churn_sequence():
+    rng = np.random.default_rng(7)
+    universe = _universe(16)
+    table = dring.replica_table(universe, replica_points=20)
+    mask = np.ones(16, bool)
+    keys = ["churn-key-%d" % i for i in range(60)]
+    for step in range(12):
+        flips = rng.choice(16, size=int(rng.integers(1, 4)), replace=False)
+        mask = mask.copy()
+        mask[flips] = ~mask[flips]
+        if not mask.any():
+            mask[int(rng.integers(0, 16))] = True
+        host = _host_ring_for(universe, mask)
+        one, many = _device_owner_names(universe, table, mask, keys, 3)
+        for k, o, m in zip(keys, one, many):
+            assert universe[int(o)] == host.lookup(k), (step, k)
+            got = [universe[int(x)] for x in m if int(x) >= 0]
+            assert got == host.lookup_n(k, 3), (step, k)
+
+
+def test_collision_order_is_universe_index_order():
+    """Force replica-point hash collisions across servers with a stub
+    hash and check both rings break the tie identically: owner = the
+    lexicographically smaller server name == the smaller universe
+    index.  (The real-hash property tests above cover the claim
+    statistically; this pins it deterministically.)"""
+
+    def stub_hash(s):
+        # every replica point of every server collides pairwise: the
+        # hash only sees the replica suffix digit
+        return int(str(s)[-1]) if str(s)[-1].isdigit() else 0
+
+    universe = sorted(["b:1", "a:2", "c:3"])
+    host = HashRing(replica_points=4, hash_func=stub_hash)
+    host.add_remove_servers(universe, None)
+
+    # device table under the same stub hash
+    table = np.stack(
+        [
+            np.array(
+                [stub_hash(s + str(i)) for i in range(4)], dtype=np.uint32
+            )
+            for s in universe
+        ]
+    )
+    mask = jnp.ones(3, bool)
+    ring = dring.build_ring(jnp.asarray(table), mask)
+    n_points = dring.ring_size(mask, 4)
+    for key in ["x0", "x1", "x2", "x3", "zz"]:
+        h = jnp.uint32(stub_hash(key))
+        dev = universe[int(dring.lookup(ring, n_points, h))]
+        # host.lookup hashes via the same stub
+        assert dev == host.lookup(key), key
+        walk = [
+            universe[int(x)]
+            for x in np.asarray(dring.lookup_n(ring, n_points, h, 3))
+            if int(x) >= 0
+        ]
+        assert walk == host.lookup_n(key, 3), key
+
+
+def test_empty_host_and_device_agree():
+    universe = _universe(4)
+    table = dring.replica_table(universe, replica_points=20)
+    host = HashRing(replica_points=20)
+    mask = np.zeros(4, bool)
+    jmask = jnp.asarray(mask)
+    ring = dring.build_ring(jnp.asarray(table), jmask)
+    n_points = dring.ring_size(jmask, 20)
+    h = jnp.uint32(fh.hash32("k"))
+    assert host.lookup("k") is None
+    assert int(dring.lookup(ring, n_points, h)) == -1
+    assert host.lookup_n("k", 3) == []
+    assert all(
+        int(x) == -1 for x in np.asarray(dring.lookup_n(ring, n_points, h, 3))
+    )

@@ -1,0 +1,239 @@
+"""Routing plane: counter semantics + impl gate-equivalence + driver.
+
+Pins (ISSUE 6): RouteMetrics bitwise-identical between
+``ring_impl="incremental"`` and the full-sort twin over a churn storm;
+materialized truth rings bitwise-equal; counters follow the
+send.js:91-208 / index.js:168-229 semantics the host proxy's accounting
+tests pin one request at a time."""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from ringpop_tpu.models.route.plane import (
+    RoutedStorm,
+    RouteParams,
+    resolve_ring_impl,
+    resolve_route_params,
+)
+from ringpop_tpu.models.sim import engine_scalable as es
+from ringpop_tpu.models.sim.storm import StormSchedule
+
+
+def _params(n, **kw):
+    return es.ScalableParams(n=n, u=192, suspicion_ticks=4, **kw)
+
+
+def _route(n, **kw):
+    base = dict(queries_per_tick=256, key_space=1024)
+    base.update(kw)
+    return RouteParams(n=n, **base)
+
+
+def _storm(n, ticks, seed=3):
+    return StormSchedule.churn_storm(
+        ticks=ticks, n=n, fraction=0.1, fail_tick=1,
+        rejoin_tick=ticks // 2, seed=seed,
+    )
+
+
+def test_resolution():
+    p = RouteParams(n=16)
+    assert resolve_ring_impl(p, "cpu") == "incremental"
+    assert resolve_ring_impl(p._replace(ring_impl="full"), "tpu") == "full"
+    with pytest.raises(ValueError):
+        resolve_ring_impl(p._replace(ring_impl="rbtree"), "cpu")
+    r = resolve_route_params(p, "cpu")
+    assert r.ring_impl == "incremental" and r.bucket_bits >= 1
+
+
+def test_gate_equivalence_incremental_vs_full_sort_twin():
+    n = 64
+    sched = _storm(n, 30)
+    runs = {}
+    for impl in ("incremental", "full"):
+        rs = RoutedStorm(
+            n, params=_params(n), route=_route(n, ring_impl=impl), seed=1
+        )
+        em, rm = rs.run(sched)
+        runs[impl] = (em, rm, np.asarray(rs.truth_ring()))
+    em_i, rm_i, ring_i = runs["incremental"]
+    em_f, rm_f, ring_f = runs["full"]
+    assert (ring_i == ring_f).all()  # the bitwise ring gate
+    for f in rm_i._fields:
+        assert (
+            np.asarray(getattr(rm_i, f)) == np.asarray(getattr(rm_f, f))
+        ).all(), f
+    for f in em_i._fields:  # routing is membership-trajectory-neutral
+        assert (
+            np.asarray(getattr(em_i, f)) == np.asarray(getattr(em_f, f))
+        ).all(), f
+
+
+def test_quiet_cluster_routes_cleanly():
+    n = 32
+    rs = RoutedStorm(n, params=_params(n), route=_route(n), seed=0)
+    em, rm = rs.run(StormSchedule(ticks=6, n=n))
+    rm = {f: np.asarray(getattr(rm, f)) for f in rm._fields}
+    # no churn: no ring motion, no misroutes, no rejects, no retries
+    assert rm["route_queries"].sum() > 0
+    for f in (
+        "route_misroutes",
+        "route_reroute_local",
+        "route_reroute_remote",
+        "route_keys_diverged",
+        "route_checksums_differ",
+        "route_checksum_rejects",
+        "route_ring_changed",
+        "route_ring_dirty_buckets",
+        "route_ring_full_rebuilds",
+    ):
+        assert rm[f].sum() == 0, f
+    assert (rm["route_ring_points"] == n * 16).all()
+
+
+def test_storm_produces_routing_pathology():
+    n = 64
+    rs = RoutedStorm(
+        n,
+        params=_params(n),
+        route=_route(n, multi_key_frac=0.5),
+        seed=1,
+    )
+    em, rm = rs.run(_storm(n, 30))
+    assert rm.route_misroutes.sum() > 0
+    assert (
+        rm.route_reroute_local.sum() + rm.route_reroute_remote.sum() > 0
+    )
+    # checksum divergence appears during the storm and the reject stat
+    # tracks the differ stat one-to-one under enforce_consistency
+    assert rm.route_checksums_differ.sum() > 0
+    assert (
+        np.asarray(rm.route_checksum_rejects)
+        == np.asarray(rm.route_checksums_differ)
+    ).all()
+    # churn dirtied buckets but never overflowed the default caps
+    assert rm.route_ring_changed.sum() > 0
+    assert rm.route_ring_dirty_buckets.sum() > 0
+    assert rm.route_ring_full_rebuilds.sum() == 0
+
+
+def test_reroute_split_is_exhaustive():
+    # every misroute resolves to exactly one of {local, remote, owner
+    # vanished}: local + remote <= misroutes, componentwise
+    n = 64
+    rs = RoutedStorm(n, params=_params(n), route=_route(n), seed=2)
+    em, rm = rs.run(_storm(n, 25, seed=9))
+    mis = np.asarray(rm.route_misroutes)
+    loc = np.asarray(rm.route_reroute_local)
+    rem = np.asarray(rm.route_reroute_remote)
+    assert (loc + rem <= mis).all()
+    assert (loc >= 0).all() and (rem >= 0).all()
+
+
+def test_enforce_consistency_off_rejects_nothing():
+    n = 32
+    rs = RoutedStorm(
+        n,
+        params=_params(n),
+        route=_route(n, enforce_consistency=False),
+        seed=1,
+    )
+    em, rm = rs.run(_storm(n, 20))
+    assert rm.route_checksums_differ.sum() > 0  # stat fires regardless
+    assert rm.route_checksum_rejects.sum() == 0  # rejection is gated
+
+
+def test_keys_diverged_fires_under_heavy_churn():
+    n = 16
+    sched = StormSchedule(ticks=12, n=n)
+    rng = np.random.default_rng(0)
+    for t in range(1, 12):
+        sched.kill[t, rng.choice(n, 3, replace=False)] = True
+        sched.revive[t, rng.choice(n, 3, replace=False)] = True
+    rs = RoutedStorm(
+        n,
+        params=es.ScalableParams(n=n, u=192, suspicion_ticks=3),
+        route=RouteParams(
+            n=n, queries_per_tick=2048, key_space=512, multi_key_frac=1.0
+        ),
+        seed=0,
+    )
+    em, rm = rs.run(sched)
+    assert rm.route_keys_diverged.sum() > 0
+    # an abort presupposes a multi-key retry: diverged <= misroutes+rejects
+    assert rm.route_keys_diverged.sum() <= (
+        rm.route_misroutes.sum() + rm.route_checksum_rejects.sum()
+    )
+
+
+def test_step_matches_scanned_run():
+    n = 32
+    sched = _storm(n, 6)
+    rs_a = RoutedStorm(n, params=_params(n), route=_route(n), seed=5)
+    em_a, rm_a = rs_a.run(sched)
+    rs_b = RoutedStorm(n, params=_params(n), route=_route(n), seed=5)
+    kills = np.asarray(sched.kill)
+    revives = np.asarray(sched.revive)
+    rows = []
+    for t in range(6):
+        _, rm = rs_b.step(
+            es.ChurnInputs(
+                kill=jnp.asarray(kills[t]), revive=jnp.asarray(revives[t])
+            )
+        )
+        rows.append(rm)
+    for f in rm_a._fields:
+        scanned = np.asarray(getattr(rm_a, f))
+        stepped = np.asarray([getattr(r, f) for r in rows])
+        assert (scanned == stepped).all(), f
+
+
+def test_routed_storm_runlog(tmp_path):
+    from ringpop_tpu.obs.recorder import RunRecorder, read_run_log
+
+    n = 32
+    rs = RoutedStorm(n, params=_params(n), route=_route(n), seed=1)
+    rec = RunRecorder(str(tmp_path) + "/", run_id="route-test")
+    rs.attach_recorder(rec)
+    rs.run(_storm(n, 10))
+    summary = rec.finish()
+    log = read_run_log(rec.path)
+    assert log["header"]["config"]["engine"] == "sim.engine_scalable+route"
+    assert log["header"]["config"]["route_params"]["ring_impl"] == (
+        "incremental"
+    )
+    row = log["ticks"][-1]["metrics"]
+    for f in (
+        "route_queries",
+        "route_misroutes",
+        "route_keys_diverged",
+        "route_checksum_rejects",
+        "route_ring_points",
+        "live_nodes",  # sim metrics ride the same rows
+    ):
+        assert f in row, f
+    assert summary["totals"]["route_queries"] > 0
+    # the extended schema validator accepts the rows it just wrote
+    import importlib.util as ilu
+    import os
+
+    spec = ilu.spec_from_file_location(
+        "check_metrics_schema",
+        os.path.join(
+            os.path.dirname(__file__),
+            "..", "..", "scripts", "check_metrics_schema.py",
+        ),
+    )
+    mod = ilu.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    assert mod.check([rec.path], verbose=False) == []
+
+
+def test_checksum_in_tick_required():
+    n = 16
+    with pytest.raises(ValueError, match="checksum_in_tick"):
+        RoutedStorm(
+            n, params=es.ScalableParams(n=n, u=192, checksum_in_tick=False)
+        )

@@ -39,3 +39,97 @@ def test_checker_catches_a_bad_log(tmp_path):
     bad.write_text('{"kind": "tick", "metrics": {}}\nnot json\n')
     problems = checker.check([str(bad)], verbose=False)
     assert problems, "checker accepted a log with no header + bad JSON"
+
+
+def _header_line():
+    import json
+
+    return json.dumps(
+        {
+            "kind": "header",
+            "schema": 1,
+            "run_id": "r",
+            "config": {},
+            "provenance": {},
+        }
+    )
+
+
+def test_route_fields_stay_in_lockstep_with_route_metrics():
+    # the validator's required set IS RouteMetrics — drift either way
+    # (a renamed counter, a forgotten validator update) fails here
+    from ringpop_tpu.models.route.plane import RouteMetrics
+
+    checker = _load_checker()
+    assert checker.ROUTE_TICK_FIELDS == frozenset(RouteMetrics._fields)
+
+
+def test_partial_route_tick_row_rejected(tmp_path):
+    import json
+
+    checker = _load_checker()
+    log = tmp_path / "route.runlog.jsonl"
+    full = {f: 1 for f in checker.ROUTE_TICK_FIELDS}
+    partial = {"route_queries": 7}  # route_* present but incomplete
+    log.write_text(
+        "\n".join(
+            [
+                _header_line(),
+                json.dumps({"kind": "tick", "tick": 0, "metrics": full}),
+                json.dumps({"kind": "tick", "tick": 1, "metrics": partial}),
+            ]
+        )
+        + "\n"
+    )
+    problems = checker.check([str(log)], verbose=False)
+    assert any("route tick row missing" in p for p in problems)
+    # and the complete row alone passes
+    log.write_text(
+        _header_line()
+        + "\n"
+        + json.dumps({"kind": "tick", "tick": 0, "metrics": full})
+        + "\n"
+    )
+    assert checker.check([str(log)], verbose=False) == []
+
+
+def test_route_event_rows_validated(tmp_path):
+    import json
+
+    checker = _load_checker()
+    log = tmp_path / "routeev.runlog.jsonl"
+    log.write_text(
+        "\n".join(
+            [
+                _header_line(),
+                json.dumps(
+                    {
+                        "kind": "event",
+                        "name": "route_window",
+                        "ring_impl": "incremental",
+                        "n": 64,
+                        "q": 256,
+                    }
+                ),
+                json.dumps({"kind": "event", "name": "route_window"}),
+                json.dumps({"kind": "event", "name": "route_rebuild_ab"}),
+            ]
+        )
+        + "\n"
+    )
+    problems = checker.check([str(log)], verbose=False)
+    assert any(
+        "route_window event missing 'ring_impl'" in p for p in problems
+    )
+    assert any(
+        "route_rebuild_ab event missing 'incremental_ms'" in p
+        for p in problems
+    )
+    # non-route events stay unconstrained
+    log.write_text(
+        _header_line()
+        + "\n"
+        + json.dumps({"kind": "event", "name": "window"})
+        + "\n"
+    )
+    assert checker.check([str(log)], verbose=False) == []
