@@ -513,6 +513,44 @@ def _ring_rebuild_ab(n: int, r: int, ticks: int, churn: int) -> dict:
     }
 
 
+def _fuzz_rate(b: int, n: int, ticks: int, recorder=None) -> dict:
+    """Round-12 scenario-fuzzer phase: B seeded storms through one
+    vmapped scan (warm-then-measure), then the invariant layer over the
+    drained event streams.  Returns artifact fields."""
+    import jax
+
+    from ringpop_tpu.fuzz import executor as fex
+    from ringpop_tpu.fuzz import invariants as finv
+    from ringpop_tpu.fuzz import scenarios as fsc
+
+    cfg = fsc.ScenarioConfig(
+        engine="full", n=n, ticks=ticks, loss_levels=(0.0,)
+    )
+    ex = fex.FullFuzzExecutor(cfg)
+    seeds = list(range(b))
+    ex.run_seeds(seeds)  # warm (compile + first dispatch)
+    t0 = time.perf_counter()
+    run = ex.run_seeds(seeds)
+    jax.block_until_ready(run.final_state)
+    device_el = time.perf_counter() - t0
+    t1 = time.perf_counter()
+    violations = finv.check_run(run)
+    check_el = time.perf_counter() - t1
+    out = {
+        "fuzz_b": b,
+        "fuzz_n": n,
+        "fuzz_ticks": ticks,
+        "fuzz_scenarios_per_sec": round(b / device_el, 1),
+        "fuzz_node_ticks_per_sec": round(b * n * ticks / device_el, 1),
+        "fuzz_events_decoded": sum(len(e) for e in run.events),
+        "fuzz_check_sec": round(check_el, 3),
+        "fuzz_violations": sum(len(v) for v in violations.values()),
+    }
+    if recorder is not None:
+        recorder.record_event("fuzz_window", **out)
+    return out
+
+
 def _batched_rate(b: int, n: int, ticks: int) -> tuple:
     """Aggregate node-ticks/s for B independent clusters in one program
     (the TPU-utilization configuration; models/sim/batched.py)."""
@@ -789,6 +827,30 @@ def _measure_recorded(n: int, ticks: int, platform: str, recorder) -> dict:
             if _is_transient(exc):
                 raise
             result["route_error"] = "%s: %s" % (
+                type(exc).__name__,
+                str(exc)[:300],
+            )
+
+    # fuzz phase (BENCH_FUZZ=0 opts out): the round-12 scenario fuzzer's
+    # aggregate throughput — B full-fidelity storm instances per device
+    # pass (per-instance schedules, flight recorder on) plus the
+    # host-side invariant check, reported as scenarios/s and
+    # node-ticks/s.  The invariant gate doubles as a bench-time
+    # correctness assert: a nonzero violation count fails the artifact
+    # field rather than silently shipping a number from a broken engine.
+    if os.environ.get("BENCH_FUZZ", "1") == "1":
+        try:
+            fb = int(os.environ.get("BENCH_FUZZ_B", "64"))
+            fn_ = int(os.environ.get("BENCH_FUZZ_N", "8"))
+            fticks = int(os.environ.get("BENCH_FUZZ_TICKS", "24"))
+            fuzz = _retry_helper_500(
+                _fuzz_rate, fb, fn_, fticks, recorder=recorder
+            )
+            result.update(fuzz)
+        except Exception as exc:
+            if _is_transient(exc):
+                raise
+            result["fuzz_error"] = "%s: %s" % (
                 type(exc).__name__,
                 str(exc)[:300],
             )

@@ -84,6 +84,9 @@ TRACED_ENTRIES: Dict[str, Set[str]] = {
     },
     "models/route/traffic.py": {"sample_keys", "key_hashes", "zipf_cdf"},
     "models/route/plane.py": {"route_tick", "init_route_state"},
+    # the fuzz executors' vmapped scanned ticks (ISSUE 7): jitted from
+    # the executor classes and the scenario sweep driver
+    "fuzz/executor.py": {"scenario_scan_full", "scenario_scan_scalable"},
 }
 
 # Device modules: code on (or feeding) the compiled path.

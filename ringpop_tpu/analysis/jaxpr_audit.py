@@ -574,6 +574,46 @@ def _entry_route_ring_incremental() -> Tuple[Callable, Tuple]:
     return one, (state.ring, in_ring, keys)
 
 
+def _fuzz_fixture(engine_name: str, b: int = 2, t: int = 2, seed0: int = 0):
+    """Tiny batched-fuzz fixture shared by the jaxpr entries and the
+    retrace probe: B stacked instances + [T, B, N] dense fault planes."""
+    from ringpop_tpu.fuzz import executor as fex
+    from ringpop_tpu.fuzz import scenarios as fsc
+
+    cfg = fsc.ScenarioConfig(engine=engine_name, n=8, ticks=t)
+    ex = fex.executor_for(cfg)
+    states = fex._stack_states(
+        [ex._init_state(seed0 + s) for s in range(b)]
+    )
+    scheds = [fsc._blank_schedule(cfg) for _ in range(b)]
+    inputs = fex._stack_inputs([s.as_inputs() for s in scheds])
+    return ex, states, inputs
+
+
+def _entry_fuzz_scan_full() -> Tuple[Callable, Tuple]:
+    from ringpop_tpu.fuzz import executor as fex
+
+    ex, states, inputs = _fuzz_fixture("full")
+
+    def scan(states, inputs):
+        return fex.scenario_scan_full(
+            states, inputs, ex.params, ex.universe
+        )
+
+    return scan, (states, inputs)
+
+
+def _entry_fuzz_scan_scalable() -> Tuple[Callable, Tuple]:
+    from ringpop_tpu.fuzz import executor as fex
+
+    ex, states, inputs = _fuzz_fixture("scalable")
+
+    def scan(states, inputs):
+        return fex.scenario_scan_scalable(states, inputs, ex.params)
+
+    return scan, (states, inputs)
+
+
 DEFAULT_ENTRIES: List[EntryPoint] = [
     EntryPoint("engine-tick-scan", _entry_engine_tick_scan),
     # the flight-recorder-enabled scanned tick MUST stay callback-free:
@@ -618,6 +658,14 @@ DEFAULT_ENTRIES: List[EntryPoint] = [
     EntryPoint("route-tick-full", lambda: _entry_route_tick("full")),
     EntryPoint(
         "route-ring-incremental", _entry_route_ring_incremental
+    ),
+    # the round-12 scenario fuzzer: both engines' vmapped scanned ticks
+    # (per-instance state AND per-instance fault schedules) must stay
+    # callback-free with the hash dataflow in uint32 lanes — every fuzz
+    # sweep and every shrink candidate batch runs through these
+    EntryPoint("fuzz-scenario-scan-full", _entry_fuzz_scan_full),
+    EntryPoint(
+        "fuzz-scenario-scan-scalable", _entry_fuzz_scan_scalable
     ),
 ]
 

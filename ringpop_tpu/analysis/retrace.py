@@ -412,6 +412,33 @@ def _probe_route_tick() -> "Tuple[Callable, List[Tuple[str, Tuple]]]":
     ]
 
 
+def _probe_fuzz_scan() -> "Tuple[Callable, List[Tuple[str, Tuple]]]":
+    import functools
+
+    import jax
+
+    from ringpop_tpu.analysis import jaxpr_audit as ja
+    from ringpop_tpu.fuzz import executor as fex
+
+    # the round-12 batched fuzz executor (scalable engine: the cheap
+    # compile).  Cache discipline: new schedules/values under the same
+    # [T, B, N] shapes must cache-hit — a fuzz sweep and every shrink
+    # candidate batch reuse one executable; a new batch size B is the
+    # one budgeted recompile (the shrinker pads candidate batches to
+    # powers of two for exactly this reason).
+    ex, states2, inputs2 = ja._fuzz_fixture("scalable", b=2)
+    fn = jax.jit(
+        functools.partial(fex.scenario_scan_scalable, params=ex.params)
+    )
+    _, states2b, inputs2b = ja._fuzz_fixture("scalable", b=2, seed0=7)
+    _, states4, inputs4 = ja._fuzz_fixture("scalable", b=4)
+    return fn, [
+        ("B=2 scenario batch", (states2, inputs2)),
+        ("B=2 new values (expect cache hit)", (states2b, inputs2b)),
+        ("B=4 batch (expect recompile)", (states4, inputs4)),
+    ]
+
+
 DEFAULT_PROBES: List[Probe] = [
     Probe("farmhash-scan", _probe_farmhash_scan),
     Probe("fused-checksum-xla", _probe_fused_checksum_xla),
@@ -423,4 +450,5 @@ DEFAULT_PROBES: List[Probe] = [
         "engine-scalable-tick-fused", _probe_engine_scalable_tick_fused
     ),
     Probe("route-tick", _probe_route_tick),
+    Probe("fuzz-scenario-scan", _probe_fuzz_scan),
 ]

@@ -1,0 +1,51 @@
+"""Scenario fuzzer: adversarial storms + machine-checked SWIM invariants.
+
+The corners SURVEY §7's hard-parts list calls out — incarnation races,
+suspicion-timer edge cases, piggyback-budget overflows, split-brain
+defamation/refute cycles — are exactly where hand-written scenario suites
+run out.  This package turns the flight-recorder stream (PR 4) into an
+adversarial correctness harness:
+
+- :mod:`scenarios` — a seeded generator composing the engines' existing
+  fault-injection primitives (kill/revive/join/leave/resume/partition,
+  packet loss) into arbitrary storm schedules, as pure functions of a
+  uint32 seed.
+- :mod:`executor` — batched executors that vmap B scenario instances
+  through one compiled ``lax.scan`` per engine and drain the per-instance
+  flight-recorder streams.
+- :mod:`invariants` — the machine-checked protocol-invariant layer over
+  decoded events + state snapshots (incarnation monotonicity,
+  alive-after-faulty ⇒ refute, suspicion-timeout bounds, piggyback
+  ceilings, partition-reachability of defamations, metrics↔event
+  reconciliation).
+- :mod:`shrinker` — bisects a failing seed's schedule (tick tail, then
+  per-tick fault sets) to a minimal reproducing schedule and emits it as
+  a committed regression fixture.
+"""
+
+from ringpop_tpu.fuzz.scenarios import (  # noqa: F401
+    ScenarioConfig,
+    generate,
+    packet_loss_of,
+    schedule_from_faults,
+    sparse_faults,
+)
+from ringpop_tpu.fuzz.executor import (  # noqa: F401
+    FullFuzzExecutor,
+    FuzzRun,
+    ScalableFuzzExecutor,
+    executor_for,
+    sweep,
+)
+from ringpop_tpu.fuzz.invariants import (  # noqa: F401
+    Violation,
+    check_run,
+)
+from ringpop_tpu.fuzz.shrinker import (  # noqa: F401
+    ShrinkResult,
+    load_fixture,
+    replay_fixture,
+    save_fixture,
+    shrink,
+    shrink_seed,
+)

@@ -1,0 +1,344 @@
+"""Batched scenario executor: B storms per device pass, one compiled scan.
+
+One scenario instance is tiny (n = 8-64): a single run leaves the device
+almost idle, and a fuzz campaign wants thousands of them.  The executors
+here vmap B independent instances — per-instance initial state AND
+per-instance fault schedule (``in_axes=(0, 0)``, unlike
+``models/sim/batched.py`` whose B clusters share one schedule) — through
+one ``lax.scan`` over the [T, B, ...] input planes, so a whole batch of
+storms costs one device dispatch.
+
+Full-fidelity instances run with the flight recorder ON: the per-instance
+event buffers come back [B, cap, 8] and are decoded into per-instance
+streams for the invariant layer (ringpop_tpu/fuzz/invariants.py).  The
+scalable engine has no event plane; its invariants check final state +
+per-tick metrics.
+
+``gate_phases`` is forced off exactly as in ``BatchedSimClusters``: under
+vmap a cond with a batched (state-derived) predicate lowers to a
+run-both select anyway, and the two settings are bitwise-identical in
+trajectory.
+
+Executables are shared per (params, universe, B, T) via ``lru_cache`` —
+mutation-gate tests that monkeypatch engine internals MUST pass
+``shared_cache=False`` so their broken traces never enter the shared
+cache (the persistent XLA cache is safe either way: a mutated trace has
+a different fingerprint).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, List, NamedTuple, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ringpop_tpu.fuzz import scenarios
+from ringpop_tpu.fuzz.scenarios import FULL, SCALABLE, ScenarioConfig
+from ringpop_tpu.models.sim import engine
+from ringpop_tpu.models.sim import engine_scalable as es
+from ringpop_tpu.models.sim.cluster import default_addresses
+from ringpop_tpu.ops import checksum_encode as ce
+
+
+def event_capacity_for(n: int, ticks: int) -> int:
+    """Per-instance event-buffer bound: the EXACT per-tick emission
+    ceiling (flight.max_events_per_tick — the sum of every emission
+    mask's lanes) times the window, rounded up to a power of two.
+    Sized so a fuzzed storm never truncates — the invariant layer
+    treats drops as a violation (an honest-but-truncated stream can
+    hide protocol bugs)."""
+    from ringpop_tpu.models.sim import flight
+
+    need = (ticks + 1) * flight.max_events_per_tick(n)
+    cap = 1024
+    while cap < need:
+        cap *= 2
+    return cap
+
+
+def default_full_params(
+    n: int, ticks: int, packet_loss: float = 0.0
+) -> engine.SimParams:
+    """Fuzz-campaign engine config: flight recorder on (the whole point),
+    "fast" checksum mode (the FarmHash string pipeline is the parity
+    suite's job — fuzz wants cheap compiles and big batches), short
+    suspicion so suspect->faulty->refute cycles fit small windows."""
+    params = engine.SimParams(
+        n=n,
+        checksum_mode="fast",
+        hash_impl="scan",
+        suspicion_ticks=6,
+        packet_loss=packet_loss,
+        gate_phases=False,
+        flight_recorder=True,
+        event_capacity=event_capacity_for(n, ticks),
+    )
+    # resolve the trace-time "auto" knobs exactly as SimCluster would, so
+    # a fuzz instance and a single-cluster replay of it share one params
+    # value (and therefore one executable-cache key family)
+    return engine.resolve_auto_parity(params, jax.default_backend())
+
+
+def default_scalable_params(
+    n: int, packet_loss: float = 0.0, enable_leave: bool = True
+) -> es.ScalableParams:
+    digits = len(str(n))
+    spt = es.SLOTS_PER_TICK + (1 if enable_leave else 0)
+    need = spt * (15 * digits + 8 + 2)
+    u = 128
+    while u < need:
+        u *= 2
+    return es.ScalableParams(
+        n=n,
+        u=u,
+        suspicion_ticks=6,
+        packet_loss=packet_loss,
+        enable_leave=enable_leave,
+        gate_phases=False,
+        perm_impl="sortless",
+        fused_exchange="off",
+    )
+
+
+# -- the traced entry points (jaxgate: registered in analysis/) -------------
+
+
+def scenario_scan_full(states, inputs, params, universe):
+    """[B]-stacked states + [T, B, N] input planes -> (final [B] states,
+    [T, B] metrics): vmapped full-fidelity tick under one scan."""
+
+    def vtick(st, inp):
+        return jax.vmap(
+            lambda s, i: engine.tick(s, i, params, universe)
+        )(st, inp)
+
+    return jax.lax.scan(vtick, states, inputs)
+
+
+def scenario_scan_scalable(states, inputs, params):
+    """The scalable twin: [B] states + [T, B, N] churn planes."""
+
+    def vtick(st, inp):
+        return jax.vmap(lambda s, i: es.tick(s, i, params))(st, inp)
+
+    return jax.lax.scan(vtick, states, inputs)
+
+
+@functools.lru_cache(maxsize=None)
+def _full_scan_fn(params: engine.SimParams, universe: ce.Universe):
+    return jax.jit(
+        functools.partial(
+            scenario_scan_full, params=params, universe=universe
+        )
+    )
+
+
+@functools.lru_cache(maxsize=None)
+def _scalable_scan_fn(params: es.ScalableParams):
+    return jax.jit(functools.partial(scenario_scan_scalable, params=params))
+
+
+def clear_executable_cache() -> None:
+    _full_scan_fn.cache_clear()
+    _scalable_scan_fn.cache_clear()
+
+
+class FuzzRun(NamedTuple):
+    """One batched pass: everything the invariant layer consumes."""
+
+    engine: str
+    params: Any  # SimParams | ScalableParams
+    config: ScenarioConfig
+    seeds: Tuple[int, ...]  # per-instance init/schedule seeds
+    schedules: Tuple[Any, ...]  # per-instance schedule objects
+    final_state: Any  # [B, ...]-stacked engine state
+    metrics: Any  # [B, T]-stacked per-tick metrics
+    events: Optional[Tuple[Any, ...]]  # per-instance decoded streams (full)
+    drops: Optional[Tuple[int, ...]]  # per-instance overflow counts
+
+
+def _to_instance_major(a):  # jaxgate: host — post-run numpy transpose
+    return np.moveaxis(np.asarray(a), 0, 1)
+
+
+def _stack_states(states: Sequence[Any]) -> Any:
+    return jax.tree.map(lambda *xs: jnp.stack(xs), *states)
+
+
+def _stack_inputs(inputs_list: Sequence[Any]) -> Any:
+    """Per-instance [T, N] input pytrees -> one [T, B, N] pytree.
+    Optional planes must agree (all None or none None) — guaranteed by
+    the campaign's single ``_blank_schedule`` shape."""
+    return jax.tree.map(
+        lambda *xs: jnp.stack(xs, axis=1), *inputs_list
+    )
+
+
+class _FuzzExecutorBase:
+    """Shared run plumbing; subclasses bind the engine specifics."""
+
+    engine_name: str = ""
+
+    def __init__(self, config: ScenarioConfig, params, shared_cache: bool):
+        self.config = config
+        self.params = params
+        self._shared_cache = shared_cache
+        self._fn = None  # built lazily (or fetched from the shared cache)
+
+    # subclass hooks ----------------------------------------------------
+    def _init_state(self, seed: int):
+        raise NotImplementedError
+
+    def _build_fn(self):
+        raise NotImplementedError
+
+    def _decode(self, final_state):
+        return None, None
+
+    # driver ------------------------------------------------------------
+    def _scan(self):
+        if self._fn is None:
+            self._fn = self._build_fn()
+        return self._fn
+
+    def run_seeds(self, seeds: Sequence[int]) -> FuzzRun:
+        """Generate + run one schedule per seed (seed also seeds the
+        engine's init rng, so an instance is fully determined by it)."""
+        scheds = [scenarios.generate(s, self.config) for s in seeds]
+        return self.run_schedules(scheds, seeds)
+
+    def run_schedules(
+        self, schedules: Sequence[Any], seeds: Optional[Sequence[int]] = None
+    ) -> FuzzRun:
+        if seeds is None:
+            seeds = [0] * len(schedules)
+        if len(seeds) != len(schedules):
+            raise ValueError("len(seeds) != len(schedules)")
+        states = _stack_states([self._init_state(s) for s in seeds])
+        inputs = _stack_inputs([s.as_inputs() for s in schedules])
+        final, metrics = self._scan()(states, inputs)
+        # metrics arrive scan-major [T, B]; instance-major is what the
+        # per-instance checks want
+        metrics = jax.tree.map(_to_instance_major, metrics)
+        events, drops = self._decode(final)
+        return FuzzRun(
+            engine=self.engine_name,
+            params=self.params,
+            config=self.config,
+            seeds=tuple(int(s) for s in seeds),
+            schedules=tuple(schedules),
+            final_state=final,
+            metrics=metrics,
+            events=events,
+            drops=drops,
+        )
+
+
+class FullFuzzExecutor(_FuzzExecutorBase):
+    engine_name = FULL
+
+    def __init__(
+        self,
+        config: ScenarioConfig,
+        params: Optional[engine.SimParams] = None,
+        packet_loss: float = 0.0,
+        shared_cache: bool = True,
+    ):
+        if params is None:
+            params = default_full_params(
+                config.n, config.ticks, packet_loss
+            )
+        if not params.flight_recorder:
+            raise ValueError(
+                "the fuzz executor drains flight-recorder streams — "
+                "construct params with flight_recorder=True"
+            )
+        self.universe = ce.Universe.from_addresses(
+            default_addresses(config.n)
+        )
+        super().__init__(config, params, shared_cache)
+
+    def _init_state(self, seed: int):
+        return engine.init_state(
+            self.params, seed=int(seed), universe=self.universe
+        )
+
+    def _build_fn(self):
+        if self._shared_cache:
+            return _full_scan_fn(self.params, self.universe)
+        return jax.jit(
+            functools.partial(
+                scenario_scan_full,
+                params=self.params,
+                universe=self.universe,
+            )
+        )
+
+    def _decode(self, final_state):
+        from ringpop_tpu.obs import events as obs_events
+
+        bufs = np.asarray(final_state.ev_buf)
+        heads = np.asarray(final_state.ev_head)
+        drops = np.asarray(final_state.ev_drops)
+        streams = tuple(
+            obs_events.decode_events(bufs[b], heads[b], drops[b])
+            for b in range(bufs.shape[0])
+        )
+        return streams, tuple(int(d) for d in drops)
+
+
+class ScalableFuzzExecutor(_FuzzExecutorBase):
+    engine_name = SCALABLE
+
+    def __init__(
+        self,
+        config: ScenarioConfig,
+        params: Optional[es.ScalableParams] = None,
+        packet_loss: float = 0.0,
+        shared_cache: bool = True,
+    ):
+        if params is None:
+            params = default_scalable_params(
+                config.n, packet_loss, enable_leave=config.use_leave
+            )
+        super().__init__(config, params, shared_cache)
+
+    def _init_state(self, seed: int):
+        return es.init_state(self.params, seed=int(seed))
+
+    def _build_fn(self):
+        if self._shared_cache:
+            return _scalable_scan_fn(self.params)
+        return jax.jit(
+            functools.partial(scenario_scan_scalable, params=self.params)
+        )
+
+
+def executor_for(
+    config: ScenarioConfig,
+    packet_loss: float = 0.0,
+    shared_cache: bool = True,
+) -> _FuzzExecutorBase:
+    cls = FullFuzzExecutor if config.engine == FULL else ScalableFuzzExecutor
+    return cls(config, packet_loss=packet_loss, shared_cache=shared_cache)
+
+
+def sweep(
+    seeds: Sequence[int],
+    config: ScenarioConfig,
+    shared_cache: bool = True,
+) -> List[FuzzRun]:
+    """Run every seed, bucketed by its packet-loss level so each level
+    shares one compiled executor; returns one FuzzRun per bucket.
+    Feed the runs to :func:`ringpop_tpu.fuzz.invariants.check_run`."""
+    buckets: dict = {}
+    for s in seeds:
+        buckets.setdefault(scenarios.packet_loss_of(s, config), []).append(s)
+    runs: List[FuzzRun] = []
+    for loss in sorted(buckets):
+        ex = executor_for(config, packet_loss=loss, shared_cache=shared_cache)
+        runs.append(ex.run_seeds(buckets[loss]))
+    return runs

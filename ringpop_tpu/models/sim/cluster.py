@@ -26,6 +26,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ringpop_tpu.models.sim import engine
+from ringpop_tpu.models.sim.schedule import DeviceScheduleMixin
 from ringpop_tpu.ops import checksum_encode as ce
 
 
@@ -34,7 +35,7 @@ def default_addresses(n: int, base_port: int = 3000, host: str = "127.0.0.1") ->
 
 
 @dataclasses.dataclass
-class EventSchedule:
+class EventSchedule(DeviceScheduleMixin):
     """Dense per-tick fault-injection plan for ``run()``."""
 
     ticks: int
@@ -57,18 +58,11 @@ class EventSchedule:
         if self.partition is None:
             self.partition = np.full((T, n), -1, np.int32)  # -1 keeps current
 
-    def as_inputs(self) -> engine.TickInputs:
-        # resume/leave stay None (not dense zeros) when unused, keeping the
-        # pytree structure of plain inputs — no jit retrace.  The device
-        # arrays are memoized: re-running one schedule (the bench's
-        # warm-then-measure pattern) must not re-upload [T, N] host
-        # arrays through the device transport on every run.  A schedule
-        # is therefore FROZEN at its first run — mutate kill/revive/...
-        # before running, or call invalidate() after mutating.
-        cached = getattr(self, "_device_inputs", None)
-        if cached is not None:
-            return cached
-        inputs = engine.TickInputs(
+    def _build_inputs(self) -> engine.TickInputs:
+        # resume/leave stay None (not dense zeros) when unused, keeping
+        # the pytree structure of plain inputs — no jit retrace.
+        # Memoization/freezing semantics: DeviceScheduleMixin.as_inputs.
+        return engine.TickInputs(
             kill=jnp.asarray(self.kill),
             revive=jnp.asarray(self.revive),
             join=jnp.asarray(self.join),
@@ -76,12 +70,6 @@ class EventSchedule:
             resume=None if self.resume is None else jnp.asarray(self.resume),
             leave=None if self.leave is None else jnp.asarray(self.leave),
         )
-        object.__setattr__(self, "_device_inputs", inputs)
-        return inputs
-
-    def invalidate(self) -> None:
-        """Drop the memoized device inputs after mutating the schedule."""
-        object.__setattr__(self, "_device_inputs", None)
 
     @staticmethod
     def churn_window(

@@ -37,6 +37,17 @@ from ringpop_tpu.obs import events as ev
 ALIVE, SUSPECT, FAULTY = 0, 1, 2
 
 
+def max_events_per_tick(n: int, ping_req_size: int = 3) -> int:
+    """Exact upper bound on records one tick can append — the sum of
+    every emission mask's lane count in :func:`record_tick_events`:
+    pings [N] + status [N, N] + suspect [N, N] + faulty [N, N] +
+    full-sync [N] + ping-req full-sync [N, K] + 4 refute lanes [N] +
+    joins [N].  Consumers sizing drop-free buffers (the fuzz executor's
+    ``event_capacity_for``) derive from THIS so the contract lives next
+    to the emitters."""
+    return 3 * n * n + (7 + ping_req_size) * n
+
+
 def init_recorder_fields(n: int, capacity: int):
     """(ev_buf, ev_head, ev_drops, first_heard) initial values.
 

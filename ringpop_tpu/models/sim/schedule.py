@@ -1,0 +1,47 @@
+"""Shared dense fault-schedule machinery for both engines' drivers.
+
+``cluster.EventSchedule`` (full-fidelity engine) and ``storm.StormSchedule``
+(scalable engine) are dense per-tick fault-injection plans with the same
+driver contract:
+
+- ``as_inputs()`` converts the host numpy planes into the engine's input
+  pytree ONCE and memoizes the device arrays — re-running one schedule
+  (the bench's warm-then-measure pattern) must not re-upload [T, N] host
+  arrays through the device transport on every run.  A schedule is
+  therefore FROZEN at its first run.
+- ``invalidate()`` drops the memo after mutating the planes.
+
+That memoization pattern used to be copy-pasted between the two schedule
+classes; this mixin is its one home (the scenario fuzzer targets this one
+API for both engines, ringpop_tpu/fuzz/).  Subclasses implement
+``_build_inputs()`` returning the engine's input pytree; optional planes
+must stay ``None`` (not dense zeros) when unused so the pytree structure
+matches plain inputs — no jit retrace.
+"""
+
+from __future__ import annotations
+
+
+class DeviceScheduleMixin:
+    """Memoized ``as_inputs()``/``invalidate()`` over ``_build_inputs()``."""
+
+    def as_inputs(self):
+        """Engine input pytree for this schedule (memoized device arrays).
+
+        The schedule is FROZEN at its first use — mutate the planes
+        before running, or call :meth:`invalidate` after mutating."""
+        cached = getattr(self, "_device_inputs", None)
+        if cached is not None:
+            return cached
+        inputs = self._build_inputs()
+        # object.__setattr__: works for frozen and unfrozen dataclasses
+        # alike, and keeps the cache out of dataclass field semantics
+        object.__setattr__(self, "_device_inputs", inputs)
+        return inputs
+
+    def invalidate(self) -> None:
+        """Drop the memoized device inputs after mutating the schedule."""
+        object.__setattr__(self, "_device_inputs", None)
+
+    def _build_inputs(self):
+        raise NotImplementedError

@@ -34,10 +34,11 @@ from ringpop_tpu.models.ring.device import (  # noqa: F401 — re-exported
     ring_checksum,
 )
 from ringpop_tpu.models.sim import engine_scalable as es
+from ringpop_tpu.models.sim.schedule import DeviceScheduleMixin
 
 
 @dataclasses.dataclass
-class StormSchedule:
+class StormSchedule(DeviceScheduleMixin):
     """Dense [T, N] churn plan."""
 
     ticks: int
@@ -47,6 +48,11 @@ class StormSchedule:
     # graceful leaves ([T, N] bool) or None; requires
     # ScalableParams(enable_leave=True)
     leave: np.ndarray = None
+    # partition regroups ([T, N] int32, -1 keeps the current group) or
+    # None — None (not a dense -1 plane) keeps the pytree structure of
+    # plain kill/revive inputs, so partition-free storms share the
+    # compiled executable (ChurnInputs.partition has the same contract)
+    partition: np.ndarray = None
 
     def __post_init__(self):
         if self.kill is None:
@@ -54,27 +60,20 @@ class StormSchedule:
         if self.revive is None:
             self.revive = np.zeros((self.ticks, self.n), bool)
 
-    def as_inputs(self) -> es.ChurnInputs:
-        # leave stays None when unused: identical pytree to plain inputs.
-        # Device arrays memoized — a [60, 1M] bool pair is 120 MB of
-        # host->device transfer that must not repeat per run (the storm
-        # bench's warm-then-measure pattern).  The schedule is FROZEN at
-        # first use: mutate kill/revive/leave before running, or call
-        # invalidate() after mutating.
-        cached = getattr(self, "_device_inputs", None)
-        if cached is not None:
-            return cached
-        inputs = es.ChurnInputs(
+    def _build_inputs(self) -> es.ChurnInputs:
+        # leave/partition stay None when unused: identical pytree to
+        # plain inputs.  Device arrays memoized — a [60, 1M] bool pair is
+        # 120 MB of host->device transfer that must not repeat per run
+        # (the storm bench's warm-then-measure pattern).  Freezing
+        # semantics: DeviceScheduleMixin.as_inputs.
+        return es.ChurnInputs(
             kill=jnp.asarray(self.kill),
             revive=jnp.asarray(self.revive),
+            partition=(
+                None if self.partition is None else jnp.asarray(self.partition)
+            ),
             leave=None if self.leave is None else jnp.asarray(self.leave),
         )
-        self._device_inputs = inputs
-        return inputs
-
-    def invalidate(self) -> None:
-        """Drop the memoized device inputs after mutating the schedule."""
-        self._device_inputs = None
 
     @staticmethod
     def churn_storm(

@@ -157,10 +157,23 @@ def _as_arrays(events: Any) -> Dict[str, np.ndarray]:
     """Accept decode_arrays output, decode_events output, or a raw
     (buf, head) pair."""
     if isinstance(events, dict):
+        missing = [f for f in FIELDS if f not in events]
+        if missing:
+            # a field-incomplete dict would otherwise surface as a bare
+            # KeyError deep inside a derivation lambda
+            raise ValueError(
+                "columnar events dict is missing fields %r" % (missing,)
+            )
         return events
     if isinstance(events, (list, tuple)) and events and isinstance(
         events[0], dict
     ):
+        for i, e in enumerate(events):
+            missing = [f for f in FIELDS if f not in e]
+            if missing:
+                raise ValueError(
+                    "event %d is missing fields %r" % (i, missing)
+                )
         return {
             name: np.asarray([ev[name] for ev in events], np.int64)
             for name in FIELDS

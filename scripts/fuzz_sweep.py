@@ -1,0 +1,164 @@
+#!/usr/bin/env python
+"""Scenario-fuzzer operator CLI: sweep seed ranges, shrink failures,
+replay fixtures.
+
+Examples::
+
+    # 512 full-engine storms, n=8, default loss menu
+    python scripts/fuzz_sweep.py sweep --engine full --seeds 0:512
+
+    # wide scalable sweep
+    python scripts/fuzz_sweep.py sweep --engine scalable --n 32 --seeds 0:256
+
+    # shrink one failing seed to a minimal schedule and save the fixture
+    python scripts/fuzz_sweep.py shrink --engine full --seed 45 \
+        --out tests/fuzz/fixtures/my_bug.json
+
+    # replay a committed fixture on the current engines
+    python scripts/fuzz_sweep.py replay tests/fuzz/fixtures/*.json
+
+A sweep exits nonzero when any scenario violates an invariant, printing
+per-seed violation names — feed the failing seed to ``shrink``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parents[1]))
+
+
+def _seed_range(spec: str):
+    if ":" in spec:
+        lo, hi = spec.split(":", 1)
+        return list(range(int(lo), int(hi)))
+    return [int(s) for s in spec.split(",")]
+
+
+def _config(args):
+    from ringpop_tpu.fuzz import scenarios as sc
+
+    return sc.ScenarioConfig(
+        engine=args.engine,
+        n=args.n,
+        ticks=args.ticks,
+        loss_levels=tuple(float(x) for x in args.loss.split(",")),
+    )
+
+
+def cmd_sweep(args) -> int:
+    from ringpop_tpu.fuzz import executor as fex
+    from ringpop_tpu.fuzz import invariants as inv
+
+    cfg = _config(args)
+    seeds = _seed_range(args.seeds)
+    runs = fex.sweep(seeds, cfg)
+    n_bad = 0
+    for run in runs:
+        for b, vs in sorted(inv.check_run(run).items()):
+            n_bad += 1
+            print(
+                "FAIL seed=%d loss=%g invariants=%s"
+                % (
+                    run.seeds[b],
+                    run.params.packet_loss,
+                    ",".join(inv.violation_names(vs)),
+                )
+            )
+            for v in vs[: args.verbose_violations]:
+                print("  %s: %s" % (v.invariant, v.message))
+    total = sum(len(r.seeds) for r in runs)
+    print(
+        "%d/%d scenarios clean (%s engine, n=%d, T=%d, %d loss buckets)"
+        % (total - n_bad, total, cfg.engine, cfg.n, cfg.ticks, len(runs))
+    )
+    return 1 if n_bad else 0
+
+
+def cmd_shrink(args) -> int:
+    from ringpop_tpu.fuzz import executor as fex
+    from ringpop_tpu.fuzz import scenarios as sc
+    from ringpop_tpu.fuzz import shrinker
+
+    cfg = _config(args)
+    ex = fex.executor_for(
+        cfg, packet_loss=sc.packet_loss_of(args.seed, cfg)
+    )
+    res = shrinker.shrink_seed(ex, args.seed)
+    print(
+        "seed %d -> %d fault cells (%d evaluations): %s"
+        % (
+            args.seed,
+            len(res.faults),
+            res.evaluations,
+            res.invariant_names,
+        )
+    )
+    for f in res.faults:
+        print("  %s t=%d node=%d value=%d" % f)
+    if args.out:
+        shrinker.save_fixture(res, args.out, note=args.note)
+        print("fixture written: %s" % args.out)
+    return 0
+
+
+def cmd_replay(args) -> int:
+    from ringpop_tpu.fuzz import shrinker
+
+    bad = 0
+    for path in args.fixtures:
+        doc = shrinker.load_fixture(path)
+        vs = shrinker.replay_fixture(doc)
+        if vs:
+            bad += 1
+            print(
+                "FAIL %s: %s"
+                % (path, sorted({v.invariant for v in vs}))
+            )
+            for v in vs[:4]:
+                print("  %s: %s" % (v.invariant, v.message))
+        else:
+            print(
+                "ok   %s (guards: %s)"
+                % (path, ",".join(doc["invariants"]))
+            )
+    return 1 if bad else 0
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    sub = p.add_subparsers(dest="cmd", required=True)
+
+    def common(sp):
+        sp.add_argument("--engine", choices=("full", "scalable"), default="full")
+        sp.add_argument("--n", type=int, default=None)
+        sp.add_argument("--ticks", type=int, default=24)
+        sp.add_argument("--loss", default="0.0,0.05,0.2")
+
+    sp = sub.add_parser("sweep", help="run a seed range, check invariants")
+    common(sp)
+    sp.add_argument("--seeds", default="0:64", help="lo:hi or comma list")
+    sp.add_argument("--verbose-violations", type=int, default=2)
+    sp.set_defaults(fn=cmd_sweep)
+
+    sp = sub.add_parser("shrink", help="minimize one failing seed")
+    common(sp)
+    sp.add_argument("--seed", type=int, required=True)
+    sp.add_argument("--out", default=None, help="fixture JSON path")
+    sp.add_argument("--note", default="", help="fixture provenance note")
+    sp.set_defaults(fn=cmd_shrink)
+
+    sp = sub.add_parser("replay", help="replay committed fixtures")
+    sp.add_argument("fixtures", nargs="+")
+    sp.set_defaults(fn=cmd_replay)
+
+    args = p.parse_args(argv)
+    if getattr(args, "n", None) is None and hasattr(args, "engine"):
+        args.n = 8 if args.engine == "full" else 32
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -52,6 +52,10 @@ def test_jaxpr_prong_covers_required_entry_points():
         "route-tick-incremental",
         "route-tick-full",
         "route-ring-incremental",
+        # ISSUE 7 acceptance: both engines' vmapped fuzz-scenario scans
+        # (per-instance schedules) hold the same purity / uint32 gates
+        "fuzz-scenario-scan-full",
+        "fuzz-scenario-scan-scalable",
     } <= names
     assert len(names) >= 5
 

@@ -20,6 +20,9 @@ CHEAP_PROBES = (
     "ring-device-lookup",
     "exchange-xla",  # [8,4] op jit — seconds, not an engine-tick compile
     "route-tick",  # n=8 routing tick — small searchsorted graphs, cheap
+    # n=8 B=2/4 scalable fuzz scan — the shrinker's cache discipline;
+    # ~11 s cold, warm via the persistent XLA cache
+    "fuzz-scenario-scan",
 )
 
 

@@ -1,0 +1,117 @@
+"""Batched fuzz executor: vmap gate-equivalence against the single-
+cluster drivers, flight-stream drain, and sweep bucketing."""
+
+from __future__ import annotations
+
+import jax
+import numpy as np
+import pytest
+
+from ringpop_tpu.fuzz import executor as fex
+from ringpop_tpu.fuzz import scenarios as sc
+from ringpop_tpu.models.sim.cluster import SimCluster
+from ringpop_tpu.models.sim.storm import ScalableCluster
+
+FULL_CFG = sc.ScenarioConfig(engine="full", n=8, ticks=12, loss_levels=(0.0,))
+SCAL_CFG = sc.ScenarioConfig(
+    engine="scalable", n=16, ticks=12, loss_levels=(0.0,)
+)
+
+
+@pytest.fixture(scope="module")
+def full_run():
+    ex = fex.FullFuzzExecutor(FULL_CFG)
+    return ex, ex.run_seeds([0, 1, 2])
+
+
+def test_full_instances_match_single_cluster_bitwise(full_run):
+    """vmap is semantics-preserving: instance b of the batched run IS
+    the single-cluster trajectory for its (seed, schedule)."""
+    ex, run = full_run
+    for b, seed in enumerate(run.seeds):
+        solo = SimCluster(n=FULL_CFG.n, params=ex.params, seed=seed)
+        assert solo.params == ex.params  # no silent param drift
+        sched = sc.generate(seed, FULL_CFG)
+        solo.run(sched)
+        solo_state = jax.device_get(solo.state)
+        for field, batched in zip(
+            type(solo_state)._fields, run.final_state
+        ):
+            if batched is None:
+                continue
+            got = np.asarray(batched)[b]
+            want = np.asarray(getattr(solo_state, field))
+            assert np.array_equal(got, want), (field, seed)
+
+
+def test_full_event_streams_are_per_instance(full_run):
+    ex, run = full_run
+    assert len(run.events) == 3
+    assert run.drops == (0, 0, 0)
+    # every instance bootstraps: 8 joins recorded at tick 1
+    for stream in run.events:
+        joins = [e for e in stream if e["kind_name"] == "join"]
+        assert len([e for e in joins if e["tick"] == 1]) == FULL_CFG.n
+    # streams differ between instances (different storms)
+    assert len(run.events[0]) != len(run.events[1]) or any(
+        a != b for a, b in zip(run.events[0], run.events[1])
+    )
+
+
+def test_metrics_are_instance_major(full_run):
+    _, run = full_run
+    assert np.asarray(run.metrics.pings_sent).shape == (3, FULL_CFG.ticks)
+
+
+def test_scalable_instances_match_single_cluster_bitwise():
+    ex = fex.ScalableFuzzExecutor(SCAL_CFG)
+    seeds = [4, 9]
+    run = ex.run_schedules(
+        [sc.generate(s, SCAL_CFG) for s in seeds], seeds=seeds
+    )
+    for b, seed in enumerate(seeds):
+        solo = ScalableCluster(n=SCAL_CFG.n, params=ex.params, seed=seed)
+        solo.run(sc.generate(seed, SCAL_CFG))
+        solo_state = jax.device_get(solo.state)
+        for field, batched in zip(
+            type(solo_state)._fields, run.final_state
+        ):
+            if batched is None:
+                continue
+            got = np.asarray(batched)[b]
+            want = np.asarray(getattr(solo_state, field))
+            assert np.array_equal(got, want), (field, seed)
+
+
+def test_sweep_buckets_by_packet_loss():
+    cfg = FULL_CFG._replace(loss_levels=(0.0, 0.25))
+    seeds = list(range(12))
+    runs = fex.sweep(seeds, cfg)
+    assert {r.params.packet_loss for r in runs} == {
+        sc.packet_loss_of(s, cfg) for s in seeds
+    }
+    covered = sorted(s for r in runs for s in r.seeds)
+    assert covered == seeds
+    for r in runs:
+        for s in r.seeds:
+            assert sc.packet_loss_of(s, cfg) == r.params.packet_loss
+
+
+def test_executor_rejects_recorderless_params():
+    with pytest.raises(ValueError, match="flight_recorder"):
+        fex.FullFuzzExecutor(
+            FULL_CFG,
+            params=fex.default_full_params(8, 12)._replace(
+                flight_recorder=False
+            ),
+        )
+
+
+def test_event_capacity_bound_covers_the_emitters():
+    from ringpop_tpu.models.sim import flight
+
+    # the sizing derives from the emitters' EXACT per-tick lane count
+    assert flight.max_events_per_tick(8) == 3 * 64 + 10 * 8
+    cap = fex.event_capacity_for(8, 24)
+    assert cap >= 25 * flight.max_events_per_tick(8)
+    assert cap & (cap - 1) == 0  # power of two

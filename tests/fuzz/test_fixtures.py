@@ -1,0 +1,36 @@
+"""Committed regression fixtures: every shrunk schedule under
+tests/fuzz/fixtures/ replays clean on the CURRENT engines.
+
+A fixture is born when the shrinker minimizes a failing seed (a real
+bug, or a mutation-gate hunt); committing it turns that storm into a
+permanent cheap regression test — if a future change re-introduces the
+failure mode, the named invariant fires here with the minimal schedule
+already in hand."""
+
+from __future__ import annotations
+
+from pathlib import Path
+
+import pytest
+
+from ringpop_tpu.fuzz import shrinker
+
+FIXTURE_DIR = Path(__file__).parent / "fixtures"
+FIXTURES = sorted(FIXTURE_DIR.glob("*.json"))
+
+
+def test_fixture_dir_is_populated():
+    assert FIXTURES, "at least one shrunk regression fixture is committed"
+
+
+@pytest.mark.parametrize(
+    "path", FIXTURES, ids=[p.stem for p in FIXTURES]
+)
+def test_fixture_replays_clean(path):
+    doc = shrinker.load_fixture(str(path))
+    assert doc["invariants"], "a fixture names the invariant it once broke"
+    assert doc["faults"], "a fixture carries a minimal non-empty schedule"
+    violations = shrinker.replay_fixture(doc)
+    assert violations == [], [
+        "%s: %s" % (v.invariant, v.message) for v in violations
+    ]

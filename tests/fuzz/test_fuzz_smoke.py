@@ -1,0 +1,59 @@
+"""Clean-engine fuzz sweeps: the fixed-seed tier-1 smoke and the wide
+slow-tier sweep (ISSUE 7 acceptance: >= 1000 scenarios, zero violations
+on the unmodified engines)."""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from ringpop_tpu.fuzz import executor as fex
+from ringpop_tpu.fuzz import invariants as inv
+from ringpop_tpu.fuzz import scenarios as sc
+
+
+def _sweep_clean(cfg, seeds):
+    runs = fex.sweep(seeds, cfg)
+    bad = {}
+    for run in runs:
+        for b, vs in inv.check_run(run).items():
+            bad[run.seeds[b]] = [
+                "%s: %s" % (v.invariant, v.message) for v in vs[:3]
+            ]
+    assert bad == {}, bad
+    return runs
+
+
+def test_smoke_full_engine_fixed_seeds():
+    cfg = sc.ScenarioConfig(
+        engine="full", n=8, ticks=20, loss_levels=(0.0, 0.1)
+    )
+    runs = _sweep_clean(cfg, list(range(8)))
+    # the sweep exercised real storms, not quiet ticks
+    assert sum(len(r.events[b]) for r in runs for b in range(len(r.seeds))) > 200
+    assert all(d == 0 for r in runs for d in r.drops)
+
+
+def test_smoke_scalable_engine_fixed_seeds():
+    cfg = sc.ScenarioConfig(
+        engine="scalable", n=32, ticks=24, loss_levels=(0.0, 0.1)
+    )
+    runs = _sweep_clean(cfg, list(range(8)))
+    total_susp = sum(
+        int(np.asarray(r.metrics.suspects_published).sum()) for r in runs
+    )
+    assert total_susp > 0, "storms must provoke the failure detector"
+
+
+@pytest.mark.slow
+def test_wide_sweep_1000_scenarios():
+    # ISSUE 7 acceptance: >= 1000 fixed-seed scenarios across both
+    # engines pass the full invariant suite
+    full_cfg = sc.ScenarioConfig(
+        engine="full", n=8, ticks=24, loss_levels=(0.0, 0.05, 0.2)
+    )
+    _sweep_clean(full_cfg, list(range(640)))
+    scal_cfg = sc.ScenarioConfig(
+        engine="scalable", n=32, ticks=24, loss_levels=(0.0, 0.05, 0.2)
+    )
+    _sweep_clean(scal_cfg, list(range(384)))

@@ -1,0 +1,350 @@
+"""Invariant checker unit tests over SYNTHETIC event streams — each
+invariant must fire on a crafted counterexample and stay silent on the
+matching clean stream (the engine-level clean sweep is
+test_fuzz_smoke.py; the engine-level counterexamples are
+test_mutation_gate.py)."""
+
+from __future__ import annotations
+
+from types import SimpleNamespace
+
+import numpy as np
+import pytest
+
+from ringpop_tpu.fuzz import invariants as inv
+from ringpop_tpu.fuzz.scenarios import ScenarioConfig, _blank_schedule
+from ringpop_tpu.obs import events as ev
+
+N, T = 4, 10
+CONTRACT = SimpleNamespace(suspicion_ticks=4, piggyback_factor=15)
+
+
+def _sched(**planes):
+    sched = _blank_schedule(ScenarioConfig(engine="full", n=N, ticks=T))
+    sched.join[0, :] = False  # quiet harness for synthetic streams
+    for name, cells in planes.items():
+        arr = getattr(sched, name)
+        for t, node in cells:
+            arr[t, node] = True
+    return sched
+
+
+def _state(ch_pb=0):
+    return SimpleNamespace(
+        ch_active=np.zeros((N, N), bool) if not ch_pb else np.ones((N, N), bool),
+        ch_pb=np.full((N, N), ch_pb, np.int32),
+    )
+
+
+def _ev(tick, kind, observer, subject, old=-1, new=-1, inc=0, aux=0):
+    return {
+        "tick": tick,
+        "kind": kind,
+        "observer": observer,
+        "subject": subject,
+        "old_status": old,
+        "new_status": new,
+        "inc": inc,
+        "aux": aux,
+        "kind_name": ev.EVENT_KINDS[kind],
+    }
+
+
+def _metrics(events):
+    """TickMetrics-compatible dict that reconciles with ``events``."""
+    a = ev._as_arrays(events) if events else {
+        k: np.zeros(0, np.int64) for k in ev.FIELDS
+    }
+    return {
+        "pings_sent": np.array([int(np.sum(a["kind"] == ev.EV_PING))]),
+        "suspects_marked": np.array(
+            [int(np.sum(a["kind"] == ev.EV_SUSPECT))]
+        ),
+        "faulties_marked": np.array(
+            [int(np.sum(a["kind"] == ev.EV_FAULTY))]
+        ),
+        "refutes": np.array([int(np.sum(a["kind"] == ev.EV_REFUTE))]),
+        "join_merges": np.array([int(np.sum(a["kind"] == ev.EV_JOIN))]),
+    }
+
+
+def _check(events, sched=None, state=None, metrics=None):
+    return inv.check_full_instance(
+        events,
+        state if state is not None else _state(),
+        metrics if metrics is not None else _metrics(events),
+        sched if sched is not None else _sched(),
+        CONTRACT,
+        contract=CONTRACT,
+    )
+
+
+def _names(violations):
+    return inv.violation_names(violations)
+
+
+def test_clean_stream_passes():
+    events = [
+        _ev(2, ev.EV_STATUS, 0, 1, old=-1, new=0, inc=1),
+        _ev(3, ev.EV_STATUS, 0, 1, old=0, new=1, inc=1),  # suspect arm
+        _ev(7, ev.EV_FAULTY, 0, 1, old=1, new=2, inc=1),
+        _ev(7, ev.EV_STATUS, 0, 1, old=1, new=2, inc=1, aux=16),
+    ]
+    assert _check(events) == []
+
+
+def test_incarnation_regression_fires():
+    events = [
+        _ev(2, ev.EV_STATUS, 0, 1, old=-1, new=0, inc=5),
+        _ev(4, ev.EV_STATUS, 0, 1, old=0, new=0, inc=3),
+    ]
+    assert "incarnation-monotonic" in _names(_check(events))
+
+
+def test_incarnation_regression_allowed_across_observer_revive():
+    # observer 0 dies and revives: its view resets, the relearn may
+    # legitimately regress
+    sched = _sched(kill=[(2, 0)], revive=[(5, 0)])
+    events = [
+        _ev(2, ev.EV_STATUS, 0, 1, old=-1, new=0, inc=5),
+        _ev(8, ev.EV_STATUS, 0, 1, old=-1, new=0, inc=3),
+    ]
+    assert _check(events, sched=sched) == []
+
+
+def test_view_continuity_break_fires():
+    events = [
+        _ev(2, ev.EV_STATUS, 0, 1, old=-1, new=1, inc=1),
+        _ev(4, ev.EV_STATUS, 0, 1, old=0, new=2, inc=1),  # old != prev new
+    ]
+    assert "view-continuity" in _names(_check(events))
+
+
+def test_alive_after_faulty_without_refute_fires():
+    events = [
+        _ev(2, ev.EV_STATUS, 0, 1, old=-1, new=2, inc=1),
+        _ev(5, ev.EV_STATUS, 0, 1, old=2, new=0, inc=1),
+    ]
+    assert "alive-after-faulty-refute" in _names(_check(events))
+
+
+def test_alive_after_faulty_with_matching_refute_passes():
+    events = [
+        _ev(2, ev.EV_STATUS, 0, 1, old=-1, new=2, inc=1),
+        _ev(4, ev.EV_REFUTE, 1, 1, new=0, inc=5),
+        _ev(4, ev.EV_SUSPECT, 3, 1, old=0, new=1, inc=1),
+        _ev(4, ev.EV_STATUS, 3, 1, old=0, new=1, inc=1),
+        _ev(5, ev.EV_STATUS, 0, 1, old=2, new=0, inc=5),
+    ]
+    assert _check(events) == []
+
+
+def test_alive_after_faulty_with_wrong_inc_refute_fires():
+    events = [
+        _ev(2, ev.EV_STATUS, 0, 1, old=-1, new=2, inc=1),
+        _ev(4, ev.EV_REFUTE, 1, 1, new=0, inc=9),
+        _ev(4, ev.EV_SUSPECT, 3, 1, old=0, new=1, inc=1),
+        _ev(4, ev.EV_STATUS, 3, 1, old=0, new=1, inc=1),
+        _ev(5, ev.EV_STATUS, 0, 1, old=2, new=0, inc=5),
+    ]
+    assert "alive-after-faulty-refute" in _names(_check(events))
+
+
+def test_alive_after_faulty_via_scheduled_revive_passes():
+    # subject 1 revived at row 5: stamp 7 minted at tick 6
+    sched = _sched(kill=[(1, 1)], revive=[(5, 1)])
+    events = [
+        _ev(2, ev.EV_STATUS, 0, 1, old=-1, new=2, inc=1),
+        _ev(8, ev.EV_STATUS, 0, 1, old=2, new=0, inc=7),
+    ]
+    assert _check(events, sched=sched) == []
+
+
+def test_self_defamation_fires():
+    events = [_ev(3, ev.EV_STATUS, 1, 1, old=0, new=1, inc=2)]
+    assert "self-view-alive" in _names(_check(events))
+
+
+def test_suspicion_lower_bound_fires():
+    events = [
+        _ev(3, ev.EV_STATUS, 0, 1, old=-1, new=1, inc=1),  # arm at 3
+        _ev(5, ev.EV_FAULTY, 0, 1, old=1, new=2, inc=1),  # fire at 5 < 3+4
+        _ev(5, ev.EV_STATUS, 0, 1, old=1, new=2, inc=1, aux=16),
+    ]
+    assert "suspicion-lower-bound" in _names(_check(events))
+
+
+def test_suspicion_upper_bound_fires_for_undisturbed_observer():
+    events = [
+        _ev(2, ev.EV_STATUS, 0, 1, old=-1, new=1, inc=1),
+        _ev(9, ev.EV_FAULTY, 0, 1, old=1, new=2, inc=1),  # 7 > 4 late
+        _ev(9, ev.EV_STATUS, 0, 1, old=1, new=2, inc=1, aux=16),
+    ]
+    assert "suspicion-upper-bound" in _names(_check(events))
+
+
+def test_suspicion_late_fire_allowed_for_disturbed_observer():
+    # observer 0 SIGSTOP'd then resumed: its timers fire late, as the
+    # reference's do
+    sched = _sched(kill=[(3, 0)], resume=[(7, 0)])
+    events = [
+        _ev(2, ev.EV_STATUS, 0, 1, old=-1, new=1, inc=1),
+        _ev(9, ev.EV_FAULTY, 0, 1, old=1, new=2, inc=1),
+        _ev(9, ev.EV_STATUS, 0, 1, old=1, new=2, inc=1, aux=16),
+    ]
+    assert _check(events, sched=sched) == []
+
+
+def test_piggyback_ceiling_fires():
+    events = []
+    vs = _check(events, state=_state(ch_pb=16))
+    assert "piggyback-ceiling" in _names(vs)
+    assert _check(events, state=_state(ch_pb=0)) == []
+
+
+def test_refute_without_defamation_fires():
+    events = [_ev(5, ev.EV_REFUTE, 2, 2, new=0, inc=7)]
+    assert "refute-reachability" in _names(_check(events))
+
+
+def test_refute_across_partition_cut_fires():
+    # observers 0,1 in group 0 defame node 3; node 3 is alone in group 1
+    # for the whole run — it could never have heard the defamation
+    sched = _sched()
+    sched.partition[1] = np.array([0, 0, 0, 1], np.int32)
+    events = [
+        _ev(3, ev.EV_SUSPECT, 0, 3, old=0, new=1, inc=1),
+        _ev(3, ev.EV_STATUS, 0, 3, old=0, new=1, inc=1),
+        _ev(6, ev.EV_REFUTE, 3, 3, new=0, inc=8),
+    ]
+    assert "refute-reachability" in _names(_check(events, sched=sched))
+    # heal at row 4: now the defamation can reach it — clean
+    sched2 = _sched()
+    sched2.partition[1] = np.array([0, 0, 0, 1], np.int32)
+    sched2.partition[4] = np.zeros(N, np.int32)
+    assert _check(events, sched=sched2) == []
+
+
+def test_reachability_closure_hops_through_groups():
+    groups = np.array(
+        [
+            [0, 0, 1, 1],  # t0: 0~1, 2~3
+            [0, 1, 1, 0],  # t1: 1~2 bridges
+            [0, 0, 0, 0],
+        ],
+        np.int32,
+    )
+    assert inv._reachable(groups, 0, 0, 2, 1)  # 0->1 at t0, 1->2 at t1
+    assert not inv._reachable(groups, 0, 0, 2, 0)  # no bridge yet
+    assert inv._reachable(groups, 0, 0, 3, 2)
+
+
+def test_metrics_reconcile_mismatch_fires():
+    events = [_ev(2, ev.EV_PING, 0, 1, aux=1)]
+    m = _metrics(events)
+    m["pings_sent"] = np.array([3])  # counter says 3, stream says 1
+    assert "metrics-reconcile" in _names(_check(events, metrics=m))
+
+
+def test_event_overflow_fires():
+    vs = inv.check_full_instance(
+        [], _state(), _metrics([]), _sched(), CONTRACT,
+        contract=CONTRACT, drops=5,
+    )
+    assert "event-overflow" in _names(vs)
+
+
+# -- scalable checker --------------------------------------------------------
+
+
+def _scal_sched(ticks=8, n=4):
+    cfg = ScenarioConfig(engine="scalable", n=n, ticks=ticks)
+    return _blank_schedule(cfg)
+
+
+def _scal_metrics(ticks=8, **cols):
+    base = {
+        "suspects_published": np.zeros(ticks, np.int32),
+        "faulties_published": np.zeros(ticks, np.int32),
+        "refutes_published": np.zeros(ticks, np.int32),
+        "pings_sent": np.full(ticks, 4, np.int32),
+        "pings_delivered": np.full(ticks, 4, np.int32),
+    }
+    base.update({k: np.asarray(v) for k, v in cols.items()})
+    return SimpleNamespace(**base)
+
+
+def _scal_state(n=4, checksum=None, proc_alive=None):
+    return SimpleNamespace(
+        checksum=(
+            checksum
+            if checksum is not None
+            else np.zeros(n, np.uint32)
+        ),
+        proc_alive=(
+            proc_alive if proc_alive is not None else np.ones(n, bool)
+        ),
+    )
+
+
+SCAL_PARAMS = SimpleNamespace(suspicion_ticks=4, checksum_in_tick=True)
+
+
+def test_scalable_checksum_divergence_fires():
+    vs = inv.check_scalable_instance(
+        _scal_state(checksum=np.array([1, 2, 3, 4], np.uint32)),
+        _scal_metrics(),
+        _scal_sched(),
+        SCAL_PARAMS,
+        recomputed_checksum=np.array([1, 2, 3, 5], np.uint32),
+    )
+    assert "scalable-checksum-exact" in _names(vs)
+
+
+def test_scalable_proc_alive_fold_fires():
+    sched = _scal_sched()
+    sched.kill[2, 1] = True
+    vs = inv.check_scalable_instance(
+        _scal_state(proc_alive=np.ones(4, bool)),  # engine says alive
+        _scal_metrics(),
+        sched,
+        SCAL_PARAMS,
+    )
+    assert "scalable-proc-alive" in _names(vs)
+
+
+def test_scalable_suspicion_lower_bound_fires():
+    m = _scal_metrics(
+        suspects_published=[0, 1, 0, 0, 0, 0, 0, 0],
+        faulties_published=[0, 0, 0, 1, 0, 0, 0, 0],  # 2 < 4 ticks later
+    )
+    vs = inv.check_scalable_instance(
+        _scal_state(), m, _scal_sched(), SCAL_PARAMS
+    )
+    assert "suspicion-lower-bound" in _names(vs)
+    m2 = _scal_metrics(
+        suspects_published=[0, 1, 0, 0, 0, 0, 0, 0],
+        faulties_published=[0, 0, 0, 0, 0, 1, 0, 0],  # 4 ticks later: ok
+    )
+    assert (
+        inv.check_scalable_instance(
+            _scal_state(), m2, _scal_sched(), SCAL_PARAMS
+        )
+        == []
+    )
+
+
+def test_scalable_refutes_need_defamation_fires():
+    m = _scal_metrics(refutes_published=[0, 0, 1, 0, 0, 0, 0, 0])
+    vs = inv.check_scalable_instance(
+        _scal_state(), m, _scal_sched(), SCAL_PARAMS
+    )
+    assert "refutes-need-defamation" in _names(vs)
+
+
+def test_scalable_pings_conserved_fires():
+    m = _scal_metrics(pings_delivered=np.full(8, 9, np.int32))
+    vs = inv.check_scalable_instance(
+        _scal_state(), m, _scal_sched(), SCAL_PARAMS
+    )
+    assert "pings-conserved" in _names(vs)

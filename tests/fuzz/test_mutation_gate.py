@@ -1,0 +1,165 @@
+"""Mutation-detection gate (ISSUE 7 acceptance): deliberately breaking
+the protocol makes the invariant checker fail with a NAMED invariant,
+and the shrinker reduces a failing seed to a minimal schedule.
+
+Every mutated executor is built with ``shared_cache=False`` so broken
+traces never enter the shared executable caches."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from ringpop_tpu.fuzz import executor as fex
+from ringpop_tpu.fuzz import invariants as inv
+from ringpop_tpu.fuzz import scenarios as sc
+from ringpop_tpu.fuzz import shrinker
+from ringpop_tpu.models.sim import engine
+from ringpop_tpu.models.sim import engine_scalable as es
+
+FULL_CFG = sc.ScenarioConfig(
+    engine="full", n=8, ticks=24, loss_levels=(0.05,)
+)
+SCAL_CFG = sc.ScenarioConfig(
+    engine="scalable", n=16, ticks=20, loss_levels=(0.05,)
+)
+
+
+def _viol_names(run, contract=None):
+    by = inv.check_run(run, contract=contract)
+    return sorted({v.invariant for vs in by.values() for v in vs}), by
+
+
+def test_shortened_suspicion_is_caught_and_shrunk(tmp_path):
+    """The engine expires suspicions after 2 ticks while the protocol
+    contract says 6: the checker names suspicion-lower-bound and the
+    shrinker reduces a failing storm to a minimal schedule that still
+    reproduces it."""
+    contract = fex.default_full_params(8, 24, 0.05)
+    broken = contract._replace(suspicion_ticks=2)
+    ex = fex.FullFuzzExecutor(FULL_CFG, params=broken, shared_cache=False)
+    run = ex.run_seeds(list(range(8)))
+    names, by = _viol_names(run, contract=contract)
+    assert "suspicion-lower-bound" in names
+    failing_seed = run.seeds[sorted(by)[0]]
+
+    res = shrinker.shrink_seed(ex, failing_seed, contract=contract)
+    assert "suspicion-lower-bound" in res.invariant_names
+    # minimal reproduction: a single fault — one kill (dead partner) or
+    # one partition cell (cross-side false suspect) arms a suspicion
+    # that then expires early
+    assert len(res.faults) == 1
+    assert res.faults[0][0] in ("kill", "partition")
+
+    # the fixture round-trips, and the UNBROKEN engine passes it
+    path = tmp_path / "m.json"
+    shrinker.save_fixture(res, str(path), note="shortened suspicion")
+    doc = shrinker.load_fixture(str(path))
+    assert doc["invariants"] == ["suspicion-lower-bound"]
+    assert shrinker.replay_fixture(doc, contract=contract) == []
+
+
+def test_suppressed_refute_path_is_caught(monkeypatch):
+    """A node that believes its own defamation instead of refuting
+    (member.js:76-81 disabled) trips self-view-alive."""
+    orig = engine._apply_updates
+
+    def no_refute(state, now, recv_mask, u_status, u_inc, u_src, u_sinc):
+        n = state.known.shape[0]
+        ids = jnp.arange(n, dtype=jnp.int32)
+        is_self = ids[:, None] == ids[None, :]
+        self_defame = recv_mask & is_self & (
+            (u_status == 1) | (u_status == 2)
+        )
+        st, gate, start_t, stop_t, refute = orig(
+            state, now, recv_mask & ~self_defame, u_status, u_inc,
+            u_src, u_sinc,
+        )
+        st = st._replace(
+            status=jnp.where(self_defame, u_status, st.status),
+            inc=jnp.where(self_defame, u_inc, st.inc),
+        )
+        return st, gate | self_defame, start_t, stop_t, refute & False
+
+    monkeypatch.setattr(engine, "_apply_updates", no_refute)
+    ex = fex.FullFuzzExecutor(
+        FULL_CFG, packet_loss=0.05, shared_cache=False
+    )
+    run = ex.run_seeds(list(range(6)))
+    names, _ = _viol_names(run)
+    assert "self-view-alive" in names
+
+
+def test_scalable_dropped_publish_delta_is_caught_and_shrunk(monkeypatch):
+    """An incremental-checksum path that forgets the publish delta
+    diverges from the full recompute — scalable-checksum-exact, with a
+    shrunk minimal schedule."""
+    orig = es._publish_batch
+
+    def no_delta(state, csum, slot, subj, new_status, new_inc, hearer, tick):
+        st, _csum2 = orig(
+            state, csum, slot, subj, new_status, new_inc, hearer, tick
+        )
+        return st, csum  # hearers' checksums silently miss the delta
+
+    monkeypatch.setattr(es, "_publish_batch", no_delta)
+    ex = fex.ScalableFuzzExecutor(
+        SCAL_CFG, packet_loss=0.05, shared_cache=False
+    )
+    run = ex.run_seeds(list(range(6)))
+    names, by = _viol_names(run)
+    assert "scalable-checksum-exact" in names
+
+    res = shrinker.shrink_seed(
+        ex,
+        run.seeds[sorted(by)[0]],
+        target=["scalable-checksum-exact"],
+    )
+    assert res.invariant_names == ["scalable-checksum-exact"]
+    assert len(res.faults) <= 2  # one fault class suffices to publish
+
+
+def test_scalable_shortened_suspicion_is_caught():
+    contract = fex.default_scalable_params(16, 0.05)
+    broken = contract._replace(suspicion_ticks=2)
+    ex = fex.ScalableFuzzExecutor(
+        SCAL_CFG, params=broken, shared_cache=False
+    )
+    run = ex.run_seeds(list(range(8)))
+    names, _ = _viol_names(run, contract=contract)
+    assert "suspicion-lower-bound" in names
+
+
+@pytest.mark.slow
+def test_stale_alive_override_is_caught():
+    """SWIM precedence broken so a stale ALIVE at an EQUAL incarnation
+    overrides FAULTY (member.js:171-202 requires strictly greater): a
+    full-sync carrying the stale record flips a faulty view back without
+    any refute — alive-after-faulty-refute."""
+    cfg = sc.ScenarioConfig(
+        engine="full", n=8, ticks=32, loss_levels=(0.2,)
+    )
+    orig = engine._overrides
+
+    def broken(u_status, u_inc, c_status, c_inc):
+        return orig(u_status, u_inc, c_status, c_inc) | (
+            (u_status == 0) & (c_status == 2) & (u_inc >= c_inc)
+        )
+
+    engine._overrides = broken
+    try:
+        ex = fex.FullFuzzExecutor(
+            cfg, packet_loss=0.2, shared_cache=False
+        )
+        run = ex.run_seeds(list(range(48)))
+        names, _ = _viol_names(run)
+        assert "alive-after-faulty-refute" in names
+    finally:
+        engine._overrides = orig
+
+
+def test_shrink_refuses_a_passing_schedule():
+    ex = fex.FullFuzzExecutor(FULL_CFG, packet_loss=0.05)
+    with pytest.raises(ValueError, match="does not violate"):
+        shrinker.shrink(ex, [("kill", 3, 1, 1)], seed=0)

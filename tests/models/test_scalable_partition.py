@@ -1,0 +1,167 @@
+"""Scalable engine partition-input merge (engine_scalable.py fault plane).
+
+ISSUE 7 satellite: the ``inputs.partition >= 0`` masked partial-regroup
+path and the ``partition=None`` pytree-structure-preserving path had no
+direct coverage — the fuzzer leans on both (every fuzz schedule carries a
+dense partition plane; quiet drivers carry None)."""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from ringpop_tpu.models.sim import engine_scalable as es
+from ringpop_tpu.models.sim.storm import ScalableCluster, StormSchedule
+
+N = 16
+
+
+def _params(**kw):
+    kw.setdefault("n", N)
+    kw.setdefault("u", 128)
+    kw.setdefault("suspicion_ticks", 4)
+    return es.ScalableParams(**kw)
+
+
+def _state_eq(a, b):
+    fa = jax.tree.flatten(jax.tree.map(np.asarray, a))[0]
+    fb = jax.tree.flatten(jax.tree.map(np.asarray, b))[0]
+    assert len(fa) == len(fb)
+    return all(np.array_equal(x, y) for x, y in zip(fa, fb))
+
+
+def test_partial_regroup_masks_negative_entries():
+    """Entries >= 0 reassign; -1 entries keep the CURRENT group — a
+    partial regroup touches only the named nodes."""
+    params = _params()
+    state = es.init_state(params, seed=0)
+    # first: a full split
+    groups = np.zeros(N, np.int32)
+    groups[N // 2:] = 1
+    inputs = es.ChurnInputs.quiet(N)._replace(
+        partition=jnp.asarray(groups)
+    )
+    state, _ = es.tick(state, inputs, params)
+    assert np.array_equal(np.asarray(state.partition), groups)
+    # then: move ONLY node 3 to group 1, everyone else -1 (keep)
+    partial = np.full(N, -1, np.int32)
+    partial[3] = 1
+    state, _ = es.tick(
+        state,
+        es.ChurnInputs.quiet(N)._replace(partition=jnp.asarray(partial)),
+        params,
+    )
+    want = groups.copy()
+    want[3] = 1
+    assert np.array_equal(np.asarray(state.partition), want)
+
+
+def test_partition_none_matches_dense_keep_and_preserves_structure():
+    """partition=None must be bitwise-identical to a dense all -1 plane,
+    and must keep the quiet-inputs pytree structure (one compiled
+    executable serves partition-free ticks: the jit cache does not grow
+    when None-structured inputs repeat)."""
+    params = _params()
+    state0 = es.init_state(params, seed=1)
+    fn = jax.jit(functools.partial(es.tick, params=params))
+
+    quiet = es.ChurnInputs.quiet(N)
+    assert quiet.partition is None  # the structure-preserving contract
+    s_none, m_none = fn(state0, quiet)
+    caches = getattr(fn, "_cache_size", None)
+    if caches is not None:
+        assert fn._cache_size() == 1
+    # same structure, fresh values: must reuse the executable
+    s_none2, _ = fn(s_none, es.ChurnInputs.quiet(N))
+    if caches is not None:
+        assert fn._cache_size() == 1
+
+    dense = quiet._replace(partition=jnp.full(N, -1, jnp.int32))
+    s_dense, m_dense = fn(state0, dense)
+    if caches is not None:
+        assert fn._cache_size() == 2  # new pytree structure: one recompile
+    assert _state_eq(s_none, s_dense)
+    assert _state_eq(m_none, m_dense)
+
+
+def test_split_blocks_rumor_flow_until_heal():
+    """Partition cuts gate every exchange: an ISOLATED node (alone in
+    its group — rumor slots themselves are shared by both sides, so a
+    lone node is the clean witness) hears no rumor born during the cut,
+    then floods after the heal.  The wavefront matrix is the proof."""
+    params = _params(wavefront=True, packet_loss=0.0)
+    lone = N - 1
+    cluster = ScalableCluster(n=N, params=params, seed=3)
+    # split at row 1 (node `lone` alone in group 1), kill at row 2
+    pre = StormSchedule(ticks=10, n=N)
+    pre.partition = np.full((10, N), -1, np.int32)
+    groups = np.zeros(N, np.int32)
+    groups[lone] = 1
+    pre.partition[1] = groups
+    pre.kill[2, 0] = True
+    ms = cluster.run(pre)
+    assert int(np.asarray(ms.suspects_published).sum()) >= 1
+    fh = np.asarray(cluster.state.first_heard)
+    births = np.asarray(cluster.state.r_birth)
+    born = np.asarray(cluster.state.r_active) & (births >= 3)
+    assert born.any(), "the kill must have published a rumor"
+    # rumor slots are SHARED batches: the lone node may co-publish into
+    # a slot (it falsely suspects its unreachable partners), stamping
+    # its own first_heard at the slot's birth tick — but it can never
+    # LEARN a slot via exchange across the cut (stamp > birth)
+    lone_fh = fh[lone, np.nonzero(born)[0]]
+    lone_birth = births[np.nonzero(born)[0]]
+    assert (
+        (lone_fh == -1) | (lone_fh == lone_birth)
+    ).all(), "an isolated node must not learn rumors across the cut"
+    unheard = born.copy()
+    unheard[np.nonzero(born)[0]] &= fh[lone, np.nonzero(born)[0]] == -1
+    # heal + a few ticks: the rumors flood the rejoined node
+    post = StormSchedule(ticks=6, n=N)
+    post.partition = np.full((6, N), -1, np.int32)
+    post.partition[0] = 0
+    cluster.run(post)
+    fh2 = np.asarray(cluster.state.first_heard)
+    still_active = np.asarray(cluster.state.r_active) & unheard
+    assert still_active.any()
+    assert (fh2[lone, np.nonzero(still_active)[0]] >= 0).all(), (
+        "healed node must catch up on the cut's rumors"
+    )
+
+
+def test_storm_schedule_partition_plane_matches_stepwise():
+    """StormSchedule's partition plane drives the scanned run exactly
+    like per-tick ChurnInputs partitions."""
+    params = _params()
+    sched = StormSchedule(ticks=6, n=N)
+    sched.partition = np.full((6, N), -1, np.int32)
+    sched.partition[1, :4] = 2
+    sched.kill[2, 1] = True
+    sched.partition[4] = 0
+
+    scanned = ScalableCluster(n=N, params=params, seed=5)
+    scanned.run(sched)
+    # snapshot into OWNED host copies BEFORE running the twin: the
+    # driver's executables donate their input state, and comparing two
+    # live device states across further donating dispatches is exactly
+    # the aliasing hazard the ScalableCluster docstring warns about —
+    # and on CPU a bare device_get can be ZERO-COPY, which would keep
+    # the snapshot aliased to the buffer at risk
+    scanned_state = jax.tree.map(
+        lambda a: np.array(a, copy=True), jax.device_get(scanned.state)
+    )
+
+    stepped = ScalableCluster(n=N, params=params, seed=5)
+    for t in range(6):
+        stepped.step(
+            es.ChurnInputs(
+                kill=jnp.asarray(sched.kill[t]),
+                revive=jnp.asarray(sched.revive[t]),
+                partition=jnp.asarray(sched.partition[t]),
+            )
+        )
+    assert _state_eq(scanned_state, jax.device_get(stepped.state))

@@ -24,6 +24,8 @@ TICKS = 46
 
 
 def _run(wavefront: bool, ticks: int = TICKS):
+    import jax
+
     sc = ScalableCluster(
         n=N,
         params=es.ScalableParams(
@@ -34,7 +36,19 @@ def _run(wavefront: bool, ticks: int = TICKS):
     sched = StormSchedule(ticks=ticks, n=N)
     sched.kill[3, 5] = True
     sched.revive[ticks // 2, 5] = True
-    return sc, sc.run(sched)
+    ms = sc.run(sched)
+    # snapshot the state into OWNED host copies: the driver's scan
+    # DONATES its input state, and this module compares two clusters'
+    # final states across further donating dispatches — exactly the
+    # aliasing hazard the ScalableCluster docstring warns about (a
+    # donated-aliased buffer read after later dispatches has been seen
+    # to return zeros on this image's CPU backend).  np.array(copy=True)
+    # matters: on CPU both device_get and a re-upload can be ZERO-COPY,
+    # which would keep the snapshot aliased to the very buffer at risk.
+    sc.state = jax.tree.map(
+        lambda a: np.array(a, copy=True), jax.device_get(sc.state)
+    )
+    return sc, ms
 
 
 @pytest.fixture(scope="module")

@@ -1,0 +1,62 @@
+"""Shared schedule-builder semantics (ISSUE 7 satellite): EventSchedule
+and StormSchedule ride ONE memoized as_inputs()/invalidate() base
+(models/sim/schedule.py) with identical freeze semantics, and
+StormSchedule's new partition plane keeps the ChurnInputs None-structure
+contract."""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from ringpop_tpu.models.sim.cluster import EventSchedule
+from ringpop_tpu.models.sim.schedule import DeviceScheduleMixin
+from ringpop_tpu.models.sim.storm import StormSchedule
+
+
+@pytest.mark.parametrize(
+    "make",
+    [
+        lambda: EventSchedule(ticks=4, n=6),
+        lambda: StormSchedule(ticks=4, n=6),
+    ],
+    ids=["event", "storm"],
+)
+def test_shared_memoize_and_invalidate_semantics(make):
+    sched = make()
+    assert isinstance(sched, DeviceScheduleMixin)
+    first = sched.as_inputs()
+    # frozen at first use: same object back, mutations invisible...
+    assert sched.as_inputs() is first
+    sched.kill[2, 3] = True
+    assert not bool(np.asarray(sched.as_inputs().kill)[2, 3])
+    # ...until invalidate() drops the memo
+    sched.invalidate()
+    fresh = sched.as_inputs()
+    assert fresh is not first
+    assert bool(np.asarray(fresh.kill)[2, 3])
+
+
+def test_unused_planes_stay_none_for_both_schedules():
+    ev = EventSchedule(ticks=3, n=4).as_inputs()
+    assert ev.resume is None and ev.leave is None
+    st = StormSchedule(ticks=3, n=4).as_inputs()
+    assert st.partition is None and st.leave is None
+
+
+def test_storm_partition_plane_becomes_dense_when_set():
+    sched = StormSchedule(ticks=3, n=4)
+    sched.partition = np.full((3, 4), -1, np.int32)
+    sched.partition[1, 2] = 5
+    inputs = sched.as_inputs()
+    part = np.asarray(inputs.partition)
+    assert part.shape == (3, 4)
+    assert part[1, 2] == 5 and part[0, 0] == -1
+
+
+def test_mixin_requires_build_inputs():
+    class Bare(DeviceScheduleMixin):
+        pass
+
+    with pytest.raises(NotImplementedError):
+        Bare().as_inputs()

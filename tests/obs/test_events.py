@@ -146,3 +146,64 @@ def test_scalable_wavefront_summary_shape():
         live=np.asarray([True, True, False]),
     )
     assert out2["rumors"][0]["observers"] == 2
+
+
+# -- degenerate-buffer hardening (ISSUE 7 satellite) -------------------------
+
+
+def test_decode_full_ring_head_equals_capacity():
+    """head == capacity is the 'buffer exactly full' honest state: every
+    row decodes, nothing is clamped away."""
+    rows = [[t, ev.EV_PING, 0, 1, -1, -1, 0, 1] for t in range(1, 5)]
+    buf = _buf(rows)  # capacity 4
+    assert buf.shape[0] == 4
+    assert len(ev.decode_events(buf, 4)) == 4
+    arrs = ev.decode_arrays(buf, 4)
+    assert arrs["tick"].tolist() == [1, 2, 3, 4]
+    # full ring + drops: decoded prefix is annotated, derivations work
+    truncated = ev.decode_events(buf, 4, drops=3)
+    assert all(e["truncated_stream"] for e in truncated)
+    assert ev.rumor_wavefronts(truncated) == {}
+
+
+def test_decode_degenerate_heads_and_buffers():
+    buf = _buf([[1, ev.EV_PING, 0, 1, -1, -1, 0, 1]])
+    # head=0 with drops>0: an empty honest prefix — no crash, no rows
+    assert ev.decode_events(buf, 0, drops=9) == []
+    assert ev.decode_arrays(buf, 0)["tick"].shape == (0,)
+    # negative head clamps to empty rather than wrapping from the tail
+    assert ev.decode_events(buf, -2) == []
+    # zero-capacity buffer round-trips through decode + derivations
+    z = np.zeros((0, ev.RECORD_WIDTH), np.int32)
+    assert ev.decode_events(z, 0) == []
+    assert ev.decode_arrays(z, 7)["tick"].shape == (0,)
+    assert ev.rumor_wavefronts(ev.decode_arrays(z, 0)) == {}
+
+
+def test_reconcile_accepts_raw_pair_and_empty_stream():
+    import collections
+
+    MT = collections.namedtuple("MT", ["pings_sent", "refutes"])
+    buf = _buf([[1, ev.EV_PING, 0, 1, -1, -1, 0, 1]])
+    out = ev.reconcile((buf, 1), MT(pings_sent=np.ones(1, np.int32),
+                                    refutes=np.zeros(1, np.int32)))
+    assert out["pings_sent"]["match"] and out["refutes"]["match"]
+    # empty stream vs zero counters reconciles
+    out0 = ev.reconcile([], MT(pings_sent=np.zeros(2, np.int32),
+                               refutes=np.zeros(2, np.int32)))
+    assert all(row["match"] for row in out0.values())
+
+
+def test_field_incomplete_inputs_raise_value_error_not_key_error():
+    """A half-built columnar dict or event list must fail loudly at the
+    boundary (these used to surface as bare KeyErrors deep inside the
+    reconciliation lambdas)."""
+    with pytest.raises(ValueError, match="missing fields"):
+        ev._as_arrays({"tick": np.zeros(1)})
+    with pytest.raises(ValueError, match="missing fields"):
+        ev._as_arrays([{"tick": 1}, {"tick": 2}])
+    import collections
+
+    MT = collections.namedtuple("MT", ["pings_sent"])
+    with pytest.raises(ValueError, match="missing fields"):
+        ev.reconcile({}, MT(pings_sent=np.zeros(1)))
