@@ -351,6 +351,118 @@ def _exchange_gbps(heard, r_delta) -> tuple:
     return gbps, impl
 
 
+def _ckpt_rate(n: int, ticks: int, every: int, recorder=None) -> dict:
+    """Round-13 recovery-plane numbers at the storm shape: (a) per-tick
+    overhead of a ``checkpoint_every`` cadence vs the same storm
+    un-checkpointed (scan split at cadence lines + atomic manifest
+    writes), (b) save/restore throughput (MB/s) for the single-file vs
+    sharded manifest paths, with the restored states gated bitwise.
+    Checkpoints go under BENCH_CKPT_DIR (default: a temp dir, cleaned)."""
+    import shutil
+    import tempfile
+
+    import jax
+
+    from ringpop_tpu.models.sim import checkpoint as ckpt
+    from ringpop_tpu.models.sim import engine_scalable as es
+    from ringpop_tpu.models.sim.storm import ScalableCluster, StormSchedule
+
+    workdir = os.environ.get("BENCH_CKPT_DIR") or tempfile.mkdtemp(
+        prefix="bench-ckpt-"
+    )
+    out: dict = {"ckpt_n": n, "ckpt_ticks": ticks, "ckpt_every": every}
+    params = es.ScalableParams(n=n)
+
+    def _storm(seed=0):
+        sc = ScalableCluster(n=n, params=params, seed=seed)
+        sched = StormSchedule.churn_storm(
+            ticks, n, fraction=0.10, fail_tick=1, seed=0
+        )
+        return sc, sched
+
+    # warm the compile (both the full-window and the cadence-split
+    # shapes), then measure base vs cadenced windows
+    sc, sched = _storm()
+    sc.run(sched)
+    jax.block_until_ready(sc.state)
+    sc, sched = _storm()
+    t0 = time.perf_counter()
+    sc.run(sched)
+    jax.block_until_ready(sc.state)
+    base_s = time.perf_counter() - t0
+
+    ck, sched2 = _storm()
+    ck.enable_checkpoints(os.path.join(workdir, "warm"), every=every, keep=2)
+    ck.run(sched2)  # warm the chunked window shapes
+    jax.block_until_ready(ck.state)
+    ck, sched2 = _storm()
+    ck.enable_checkpoints(os.path.join(workdir, "fam"), every=every, keep=2)
+    t0 = time.perf_counter()
+    with _profile_ctx("ckpt-cadence", recorder=recorder):
+        ck.run(sched2)
+        jax.block_until_ready(ck.state)
+    ckpt_s = time.perf_counter() - t0
+    saves = len(ck.checkpoint_manager.list_checkpoints())
+    out["ckpt_base_s"] = round(base_s, 3)
+    out["ckpt_cadence_s"] = round(ckpt_s, 3)
+    out["ckpt_saves_in_window"] = saves
+    out["ckpt_overhead_frac"] = round(max(0.0, ckpt_s / base_s - 1.0), 4)
+    # cadence must not change the trajectory (the resume-bitwise plane
+    # already gates this at small n; this is the at-scale sanity)
+    out["ckpt_bitwise_equal"] = bool(
+        (np.asarray(sc.state.checksum) == np.asarray(ck.state.checksum)).all()
+        and (np.asarray(sc.state.heard) == np.asarray(ck.state.heard)).all()
+    )
+
+    # save/restore throughput, single-file vs sharded A/B
+    shards_ab = int(os.environ.get("BENCH_CKPT_SHARDS", "8"))
+    for label, shards in (("single", 1), ("sharded%d" % shards_ab, shards_ab)):
+        path = os.path.join(workdir, "ab-%s" % label)
+        t0 = time.perf_counter()
+        manifest = ckpt.save_checkpoint(
+            path,
+            ck.state,
+            ck.params,
+            shards=shards,
+            sharded_fields=es.NODE_SHARDED_FIELDS if shards > 1 else None,
+        )
+        save_s = time.perf_counter() - t0
+        mb = manifest["nbytes"] / 1e6
+        t0 = time.perf_counter()
+        loaded = ckpt.load_checkpoint(path, es.ScalableState, ck.params)
+        restore_s = time.perf_counter() - t0
+        equal = all(
+            getattr(loaded, f) is None
+            if getattr(ck.state, f) is None
+            else (
+                np.asarray(getattr(loaded, f))
+                == np.asarray(getattr(ck.state, f))
+            ).all()
+            for f in es.ScalableState._fields
+        )
+        out["ckpt_mb"] = round(mb, 2)
+        out["ckpt_save_mbps_%s" % label] = round(mb / save_s, 1)
+        out["ckpt_restore_mbps_%s" % label] = round(mb / restore_s, 1)
+        out["ckpt_roundtrip_equal_%s" % label] = bool(equal)
+    if recorder is not None:
+        recorder.record_event(
+            "ckpt_window",
+            n=n,
+            every=every,
+            saves=saves,
+            overhead_frac=out["ckpt_overhead_frac"],
+            mb=out["ckpt_mb"],
+            save_mbps_single=out["ckpt_save_mbps_single"],
+            save_mbps_sharded=out[
+                "ckpt_save_mbps_sharded%d" % shards_ab
+            ],
+        )
+        recorder.record_phase("measure[ckpt-cadence]", ckpt_s)
+    if not os.environ.get("BENCH_CKPT_DIR"):
+        shutil.rmtree(workdir, ignore_errors=True)
+    return out
+
+
 def _sparse_churn_schedule(n: int, ticks: int, churn: int, seed: int = 0):
     """Sparse per-tick churn: ``churn`` random kills each tick, revived
     two ticks later — the steady trickle the incremental ring kernel is
@@ -738,6 +850,29 @@ def _measure_recorded(n: int, ticks: int, platform: str, recorder) -> dict:
             if _is_transient(exc):
                 raise
             result["scalable_error"] = "%s: %s" % (
+                type(exc).__name__,
+                str(exc)[:300],
+            )
+
+    # checkpoint phase (BENCH_CKPT=0 opts out): the round-13 recovery
+    # plane at the storm shape — checkpoint-cadence per-tick overhead vs
+    # the un-checkpointed storm (bitwise-gated), and save/restore MB/s
+    # single-file vs sharded (BENCH_CKPT_N/_TICKS/_EVERY/_SHARDS knobs;
+    # ckpt_window runlog event stamps the headline numbers).
+    if os.environ.get("BENCH_CKPT", "1") == "1":
+        try:
+            kn = int(os.environ.get("BENCH_CKPT_N", "100000"))
+            kticks = int(os.environ.get("BENCH_CKPT_TICKS", "8"))
+            kevery = int(os.environ.get("BENCH_CKPT_EVERY", "4"))
+            result.update(
+                _retry_helper_500(
+                    _ckpt_rate, kn, kticks, kevery, recorder=recorder
+                )
+            )
+        except Exception as exc:
+            if _is_transient(exc):
+                raise
+            result["ckpt_error"] = "%s: %s" % (
                 type(exc).__name__,
                 str(exc)[:300],
             )

@@ -719,6 +719,29 @@ def phase_route(results: dict) -> None:
         )
 
 
+def phase_ckpt(results: dict) -> None:
+    """Round-13 recovery plane on-chip: checkpoint-cadence overhead and
+    save/restore MB/s at n=1M (device->host gather + atomic manifest
+    write, single-file vs sharded A/B with bitwise roundtrip gates) —
+    the chip capture of BENCH_r12's CPU ckpt_* fields.  The number that
+    matters for the weak-scaling runs: what fraction of storm wall time
+    a checkpoint_every cadence costs when preemption is the norm."""
+    import sys
+
+    sys.path.insert(
+        0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    )
+    import bench as bench_mod
+
+    n = int(os.environ.get("TPU_MEASURE_CKPT_N", "1000000"))
+    ticks = int(os.environ.get("TPU_MEASURE_CKPT_TICKS", "8"))
+    every = int(os.environ.get("TPU_MEASURE_CKPT_EVERY", "4"))
+    out = bench_mod._ckpt_rate(n, ticks, every)
+    for k, v in out.items():
+        results["tpu_%s" % k] = v
+    print(json.dumps({k: out[k] for k in sorted(out) if "mbps" in k or "frac" in k}))
+
+
 def phase_epidemic_100k(results: dict) -> None:
     import jax
     import numpy as np
@@ -1000,6 +1023,7 @@ def main() -> int:
         ("fused_parity", phase_fused_parity),
         ("fused_exchange", phase_fused_exchange),
         ("route", phase_route),
+        ("ckpt", phase_ckpt),
         ("epidemic_100k", phase_epidemic_100k),
         ("batched", phase_batched),
         ("convergence", phase_convergence),

@@ -21,14 +21,27 @@ adversarial correctness harness:
 - :mod:`shrinker` — bisects a failing seed's schedule (tick tail, then
   per-tick fault sets) to a minimal reproducing schedule and emits it as
   a committed regression fixture.
+- :mod:`crash` — the ``crash_resume`` move: preempt a checkpointing
+  driver at a seed-drawn tick (including mid-checkpoint-write, leaving a
+  torn/bit-rotted artifact), restart cold, auto-recover from the newest
+  valid checkpoint, and gate the final state bitwise against the
+  uninterrupted run (the ``resume-bitwise`` invariant).
 """
 
 from ringpop_tpu.fuzz.scenarios import (  # noqa: F401
+    CrashPlan,
     ScenarioConfig,
+    crash_plan_of,
     generate,
     packet_loss_of,
     schedule_from_faults,
     sparse_faults,
+)
+from ringpop_tpu.fuzz.crash import (  # noqa: F401
+    RESUME_BITWISE,
+    CrashReport,
+    run_crash_resume,
+    sweep_crash,
 )
 from ringpop_tpu.fuzz.executor import (  # noqa: F401
     FullFuzzExecutor,

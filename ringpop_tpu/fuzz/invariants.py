@@ -59,6 +59,18 @@ refutes-need-defamation     a refute batch requires an earlier
 pings-conserved             pings_delivered <= pings_sent per tick
 ==========================  ================================================
 
+host-level (checked by :mod:`ringpop_tpu.fuzz.crash`, not here — the
+property spans two driver processes, not one run's event stream):
+
+==========================  ================================================
+resume-bitwise              a driver preempted at an arbitrary tick
+                            (including mid-checkpoint-write, leaving a
+                            torn/corrupt newest checkpoint) and restarted
+                            through recovery (newest VALID checkpoint, or
+                            clean restart) reaches a final state bitwise
+                            equal to the uninterrupted run's
+==========================  ================================================
+
 Every checker is pure host-side numpy over already-fetched arrays; a
 violation names its invariant (the shrinker minimizes against those
 names, and the mutation-gate tests assert them).

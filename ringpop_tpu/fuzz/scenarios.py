@@ -39,7 +39,20 @@ partial_regroup     move a node subset to another group mid-run (the
 leave_rejoin        graceful leave at t0, rejoin at t1 (admin plane)
 stall_resume        full engine only: SIGSTOP at t0, SIGCONT at t1 —
                     the node returns with stale state and must refute
+crash_resume        HOST-level move (ringpop_tpu/fuzz/crash.py): the
+                    *driver process* is preempted at a seed-drawn tick —
+                    optionally mid-checkpoint-write, leaving a torn or
+                    bit-rotted newest checkpoint — then restarted; it
+                    must auto-recover from the newest valid checkpoint
+                    and replay to a final state bitwise-identical to the
+                    uninterrupted run (the ``resume-bitwise`` invariant)
 ==================  ========================================================
+
+``crash_resume`` composes with the device-plane moves: the preemption
+point and checkpoint damage are drawn by :func:`crash_plan_of` (a pure
+seed derivation like :func:`packet_loss_of`, so the storm schedule
+stream is unchanged by it), and the harness replays the SAME generated
+schedule through kill and recovery.
 """
 
 from __future__ import annotations
@@ -91,6 +104,49 @@ def packet_loss_of(seed: int, config: ScenarioConfig) -> float:
         return 0.0
     mixed = (((int(seed) & 0xFFFFFFFF) * 0x9E3779B9) & 0xFFFFFFFF) >> 16
     return float(config.loss_levels[mixed % len(config.loss_levels)])
+
+
+class CrashPlan(NamedTuple):
+    """The ``crash_resume`` move's host-level shape: when the driver is
+    preempted and what the interrupted checkpoint write left behind."""
+
+    kill_tick: int  # driver preempted after this many driven ticks
+    # damage to the NEWEST checkpoint (the save the kill interrupted):
+    # "none" = clean preemption between saves; the rest model a torn or
+    # bit-rotted artifact the recovery scan must fall back past
+    corrupt: str
+    frac: float  # truncation offset / flip position, as a size fraction
+
+
+CRASH_CORRUPT_MODES = (
+    "none",
+    "torn-manifest",
+    "torn-array",
+    "flip-byte",
+    "missing-shard",
+)
+
+
+def crash_plan_of(seed: int, config: ScenarioConfig) -> CrashPlan:
+    """Pure ``(seed, config) -> CrashPlan`` — an independent derivation
+    (not the move rng), so the storm schedule stream is unchanged by the
+    crash plane, exactly like :func:`packet_loss_of`."""
+    if config.ticks < 2:
+        # kill_tick draws from [1, ticks); shorter windows would surface
+        # as an opaque numpy low >= high error (generate() has the same
+        # guard shape for its move draws)
+        raise ValueError(
+            "crash planning needs ticks >= 2, got %d" % config.ticks
+        )
+    rng = np.random.default_rng(
+        (int(np.uint32(seed)) * 0x9E3779B9 + 0x5CA1AB1E) & 0xFFFFFFFF
+    )
+    kill_tick = int(rng.integers(1, config.ticks))
+    corrupt = CRASH_CORRUPT_MODES[
+        int(rng.integers(0, len(CRASH_CORRUPT_MODES)))
+    ]
+    frac = float(rng.uniform(0.05, 0.95))
+    return CrashPlan(kill_tick=kill_tick, corrupt=corrupt, frac=frac)
 
 
 def _blank_schedule(config: ScenarioConfig) -> Schedule:

@@ -62,6 +62,7 @@ from ringpop_tpu.models.ring import device as ringdev
 from ringpop_tpu.models.route import ring_kernel as rk
 from ringpop_tpu.models.route import traffic
 from ringpop_tpu.models.sim import engine_scalable as es
+from ringpop_tpu.models.sim.recovery import CheckpointableMixin, CheckpointSpec
 
 
 class RouteParams(NamedTuple):
@@ -99,6 +100,19 @@ class RouteState(NamedTuple):
     ring: Optional[rk.RingState]
     flat_ring: Optional[jax.Array]  # [N*R] uint64
     mask: Optional[jax.Array]  # [N] bool (full impl only)
+    rng: jax.Array  # threefry key
+
+
+class RouteCarry(NamedTuple):
+    """The checkpointed routing-plane carry: everything in
+    :class:`RouteState` that is not a pure function of it.  The ring —
+    bucketed or flat — is REBUILT from ``mask`` on load (rk.full_rebuild
+    / device.build_ring are deterministic functions of (universe, mask)),
+    so the checkpoint stays O(N) instead of O(2^B·M) and a resume may
+    switch ``ring_impl``/bucket caps freely (those params are
+    trajectory-neutral, checkpoint._TRAJECTORY_NEUTRAL_PARAMS)."""
+
+    mask: jax.Array  # [N] bool — membership the stale ring reflects
     rng: jax.Array  # threefry key
 
 
@@ -315,11 +329,15 @@ def _routed_fns(es_params: es.ScalableParams, route_params: RouteParams):
         )
         return (est, rst), (em, rm)
 
-    @functools.partial(jax.jit, donate_argnums=(0,))
+    # donation backend-gated like the storm driver's (CPU warm-cache
+    # executables mis-execute donation — storm.donate_state_argnums)
+    from ringpop_tpu.models.sim.storm import donate_state_argnums
+
+    @functools.partial(jax.jit, donate_argnums=donate_state_argnums())
     def _tick(carry, inputs, buckets, reps, cdf):
         return _body(carry, inputs, buckets, reps, cdf)
 
-    @functools.partial(jax.jit, donate_argnums=(0,))
+    @functools.partial(jax.jit, donate_argnums=donate_state_argnums())
     def _scanned(carry, inputs, buckets, reps, cdf):
         def body(c, inp):
             return _body(c, inp, buckets, reps, cdf)
@@ -335,7 +353,7 @@ def clear_executable_cache() -> None:
     _routed_fns.cache_clear()
 
 
-class RoutedStorm:
+class RoutedStorm(CheckpointableMixin):
     """ScalableCluster + routing plane under one scanned program.
 
     Wraps a :class:`~ringpop_tpu.models.sim.storm.ScalableCluster` and
@@ -415,9 +433,15 @@ class RoutedStorm:
         em = jax.tree.map(np.asarray, em)
         rm = jax.tree.map(np.asarray, rm)
         self._record(em, rm)
+        self._after_ticks(1)
         return em, rm
 
     def run(self, schedule):
+        """With a checkpoint cadence enabled the scan splits at cadence
+        boundaries — trajectory- and metrics-bitwise-neutral."""
+        return self._run_chunked(schedule, self._run_window)
+
+    def _run_window(self, schedule):
         carry, (em, rm) = self._scanned(
             (self.cluster.state, self.rstate),
             schedule.as_inputs(),
@@ -463,3 +487,78 @@ class RoutedStorm:
 
     def ring_checksum(self) -> int:
         return int(ringdev.ring_checksum(self.truth_ring()))
+
+    # -- checkpoint/resume (models/sim/recovery.py) -----------------------
+    # Two named states per checkpoint: "sim" (the scalable engine state,
+    # node fields shardable) and "route" (the RouteCarry — stale-ring
+    # membership mask + traffic rng).  On load the bucketed (or flat)
+    # ring is rebuilt from the restored mask, bit-identically to the
+    # incrementally-maintained one (tests/models/test_route_plane.py
+    # roundtrip + the crash-resume gate).
+
+    def _route_carry(self) -> RouteCarry:
+        mask = (
+            self.rstate.ring.mask
+            if self.route_params.ring_impl == "incremental"
+            else self.rstate.mask
+        )
+        return RouteCarry(mask=mask, rng=self.rstate.rng)
+
+    def _rebuild_route_state(self, carry: RouteCarry) -> RouteState:
+        mask = jnp.asarray(carry.mask)
+        rng = jnp.asarray(carry.rng)
+        if self.route_params.ring_impl == "incremental":
+            return RouteState(
+                ring=rk.full_rebuild(self.buckets, mask),
+                flat_ring=None,
+                mask=None,
+                rng=rng,
+            )
+        return RouteState(
+            ring=None,
+            flat_ring=ringdev.build_ring(self.reps, mask),
+            mask=mask,
+            rng=rng,
+        )
+
+    def _ckpt_spec(self) -> CheckpointSpec:
+        return CheckpointSpec(
+            state_cls={"sim": es.ScalableState, "route": RouteCarry},
+            params={"sim": self.cluster.params, "route": self.route_params},
+            sharded_fields={
+                "sim": es.NODE_SHARDED_FIELDS,
+                "route": frozenset({"mask"}),
+            },
+        )
+
+    def _ckpt_states(self):
+        return {"sim": self.cluster.state, "route": self._route_carry()}
+
+    def _ckpt_install(self, states) -> None:
+        from ringpop_tpu.models.sim.storm import fixup_scalable_state
+
+        self.cluster.state = fixup_scalable_state(
+            states["sim"], self.cluster.params
+        )
+        self.rstate = self._rebuild_route_state(states["route"])
+
+    def save(self, path: str, shards: int = 1) -> None:
+        """Manifest-format checkpoint directory at ``path``."""
+        from ringpop_tpu.models.sim import checkpoint as ckpt
+
+        spec = self._ckpt_spec()
+        ckpt.save_checkpoint(
+            path,
+            self._ckpt_states(),
+            spec.params,
+            shards=shards,
+            sharded_fields=spec.sharded_fields,
+        )
+
+    def load(self, path: str) -> None:
+        from ringpop_tpu.models.sim import checkpoint as ckpt
+
+        spec = self._ckpt_spec()
+        self._ckpt_install(
+            ckpt.load_checkpoint(path, spec.state_cls, spec.params)
+        )

@@ -2,16 +2,45 @@
 
 The reference persists nothing — a restarted node rebuilds via join
 full-sync (server/protocol/join.js:131) — but multi-minute 100k/1M-node
-sweeps deserve kill-and-resume.  Any engine state (``SimState``,
-``ScalableState`` — any NamedTuple of arrays) round-trips through one
-``.npz`` file; resuming from a checkpoint continues the exact trajectory
-bit-for-bit (the engines are deterministic pure functions of state).
+sweeps deserve kill-and-resume, and at weak-scaling scale (ROADMAP item
+2) preemption is the norm: a checkpoint layer that can silently serve a
+torn or bit-rotted file is worse than none.  Two formats live here:
+
+- the **legacy single-file format** (``save_state``/``load_state``): one
+  ``.npz`` per state.  Writes go through tmp + fsync + ``os.replace`` so
+  an interrupted save never shadows a previous good checkpoint, but the
+  file carries no content digests — corruption surfaces only as far as
+  ``np.load`` notices.
+- the **manifest format** (``save_checkpoint``/``load_checkpoint``): a
+  checkpoint *directory* holding one or more ``.npz`` array files plus a
+  ``manifest.json`` carrying per-file AND per-array CRC32 content
+  digests, shapes, dtypes, params, and free-form meta (the driver's tick
+  counter).  Every file is written atomically and the manifest is
+  written LAST — a directory without a valid manifest is not a
+  checkpoint, so a crash at ANY byte of the save leaves either the
+  previous complete checkpoint or an ignorable partial, never a torn
+  artifact at a valid path.  Truncation, bit-rot, missing shards, and
+  format drift are detected at load with **named errors** (the
+  ``CheckpointError`` taxonomy below) instead of a silently corrupt
+  resume.  States may be **sharded**: node-axis fields split across
+  per-shard files, restorable onto any shard count (the loader always
+  reassembles full arrays; the driver re-places them on its own mesh),
+  bitwise-identical to the single-file path
+  (tests/models/test_checkpoint.py).
+
+Resuming from either format continues the exact trajectory bit-for-bit
+(the engines are deterministic pure functions of state); rotation,
+cadence, and newest-valid discovery live in
+:mod:`ringpop_tpu.models.sim.recovery`.
 """
 
 from __future__ import annotations
 
+import io
 import json
-from typing import Any, Optional, Type, TypeVar
+import os
+import zlib
+from typing import Any, Dict, List, Mapping, Optional, Tuple, Type, TypeVar
 
 import jax.numpy as jnp
 import numpy as np
@@ -49,6 +78,15 @@ _TRAJECTORY_NEUTRAL_PARAMS = frozenset(
         # CPU resume ("off"), and pre-round-10 checkpoints lack the keys
         "perm_impl",
         "fused_exchange",
+        # routing plane (RouteParams): the ring REPRESENTATION is not
+        # part of the checkpointed carry — RoutedStorm persists only the
+        # membership mask + rng and rebuilds the bucketed (or flat) ring
+        # under its own impl/caps on load, bit-identically
+        # (tests/models/test_route_plane.py roundtrip)
+        "ring_impl",
+        "bucket_bits",
+        "max_changed",
+        "max_dirty",
     }
 )
 # v2: incarnation fields are int32 tick stamps (engine.stamp_to_ms), not
@@ -68,6 +106,95 @@ _FIELD_DEFAULTS = {
     ),
 }
 
+# -- named load-failure taxonomy --------------------------------------------
+# All subclass ValueError so pre-round-13 callers catching ValueError keep
+# working; the recovery scan (recovery.CheckpointManager) catches
+# CheckpointError specifically and falls back past the corrupt artifact.
+
+
+class CheckpointError(ValueError):
+    """Base: this path does not hold a loadable checkpoint."""
+
+
+class CheckpointNotFoundError(CheckpointError):
+    """No checkpoint here at all (missing path/manifest, foreign file)."""
+
+
+class CheckpointTornError(CheckpointError):
+    """Partial/interrupted write: truncated file, unparseable manifest,
+    or an archive ``np.load`` cannot open."""
+
+
+class CheckpointDigestError(CheckpointError):
+    """Content digest mismatch at full length — bit-rot or tampering."""
+
+
+class CheckpointShardError(CheckpointError):
+    """Sharded-manifest inconsistency: missing shard file or shard
+    list/count drift."""
+
+
+class CheckpointVersionError(CheckpointError):
+    """Format version mismatch (a cross-version resume would silently
+    corrupt the trajectory)."""
+
+
+class CheckpointFieldError(CheckpointError):
+    """State class / field set / dtype does not match the resuming
+    engine's."""
+
+
+class CheckpointParamsError(CheckpointError):
+    """Trajectory-relevant params differ between save and resume."""
+
+
+# -- atomic writes -----------------------------------------------------------
+
+
+def _fsync_dir(dirpath: str) -> None:
+    """Best-effort fsync of a directory so the rename itself is durable
+    (platforms without directory fds just skip)."""
+    try:
+        fd = os.open(dirpath, os.O_RDONLY)
+    except OSError:
+        return
+    try:
+        os.fsync(fd)
+    finally:
+        os.close(fd)
+
+
+def atomic_write_bytes(path: str, data: bytes) -> None:
+    """tmp + fsync + ``os.replace``: ``path`` either keeps its previous
+    content or holds all of ``data`` — never a prefix.  The tmp file
+    lives in the same directory (rename must not cross filesystems) and
+    carries a ``.tmp.<pid>`` suffix the checkpoint scanners ignore."""
+    path = os.fspath(path)
+    tmp = "%s.tmp.%d" % (path, os.getpid())
+    with open(tmp, "wb") as f:
+        f.write(data)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+    _fsync_dir(os.path.dirname(os.path.abspath(path)))
+
+
+def _npz_bytes(arrays: Dict[str, np.ndarray]) -> bytes:
+    bio = io.BytesIO()
+    np.savez(bio, **arrays)
+    return bio.getvalue()
+
+
+def _crc(buf: bytes) -> int:
+    return zlib.crc32(buf) & 0xFFFFFFFF
+
+
+def _array_crc(arr: np.ndarray) -> int:
+    return _crc(np.ascontiguousarray(arr).tobytes())
+
+
+# -- legacy single-file format ----------------------------------------------
+
 
 def save_state(path: str, state: Any, params: Any = None) -> None:
     """Write a NamedTuple-of-arrays engine state to ``path``.
@@ -75,7 +202,9 @@ def save_state(path: str, state: Any, params: Any = None) -> None:
     ``params`` (the engine's SimParams/ScalableParams NamedTuple) is stored
     alongside so a resume can verify it runs under the same protocol
     constants.  The literal path is used — no silent ``.npz`` suffixing —
-    so ``save(p)`` / ``load(p)`` always round-trip.
+    so ``save(p)`` / ``load(p)`` always round-trip.  The write is atomic
+    (tmp + fsync + ``os.replace``): an interrupted save never shadows a
+    previous good checkpoint with a torn file.
     """
     fields = getattr(state, "_fields", None)
     if fields is None:
@@ -95,90 +224,539 @@ def save_state(path: str, state: Any, params: Any = None) -> None:
         arrays[_PARAMS_KEY] = np.array(
             [json.dumps(dict(params._asdict()), sort_keys=True)]
         )
-    with open(path, "wb") as f:
-        np.savez(f, **arrays)
+    atomic_write_bytes(path, _npz_bytes(arrays))
+
+
+def _params_jsonable(params: Any) -> Any:
+    return json.loads(json.dumps(dict(params._asdict()), sort_keys=True))
+
+
+def _check_params(saved_params: Any, params: Any, where: str) -> None:
+    """Raise CheckpointParamsError when trajectory-relevant params differ
+    (the _TRAJECTORY_NEUTRAL_PARAMS set may differ freely on either
+    side)."""
+    saved = dict(saved_params)
+    current = _params_jsonable(params)
+    for neutral in _TRAJECTORY_NEUTRAL_PARAMS:
+        saved.pop(neutral, None)
+        current.pop(neutral, None)
+    if saved != current:
+        diff = {
+            k: (saved.get(k), current.get(k))
+            for k in set(saved) | set(current)
+            if saved.get(k) != current.get(k)
+        }
+        raise CheckpointParamsError(
+            "%s: checkpoint params differ from the resuming engine's "
+            "(saved, current): %r" % (where, diff)
+        )
+
+
+def _reconcile_fields(
+    state_cls: Type[T], available: Dict[str, Any], where: str
+) -> T:
+    """Shared field-matching half of both load paths: missing/extra field
+    detection, derived defaults for fields added post-ship
+    (_FIELD_DEFAULTS), optional (None-default) fields, and the
+    dtype-truncation guard.  ``available`` maps field name -> np array
+    (fields stored as None simply absent)."""
+    optional = set(getattr(state_cls, "_field_defaults", {}))
+    missing = [
+        f
+        for f in state_cls._fields
+        if f not in available and f not in _FIELD_DEFAULTS and f not in optional
+    ]
+    extra = [f for f in available if f not in state_cls._fields]
+    if missing or extra:
+        raise CheckpointFieldError(
+            "%s: checkpoint fields do not match %s (missing=%r, extra=%r)"
+            % (where, state_cls.__name__, missing, extra)
+        )
+    out = {}
+    for f in state_cls._fields:
+        if f not in available:
+            if f in _FIELD_DEFAULTS:
+                sibling, default_of = _FIELD_DEFAULTS[f]
+                # dtype comes from the stored sibling array by design
+                out[f] = jnp.array(  # jaxgate: ignore[implicit-dtype]
+                    default_of(np.asarray(available[sibling])), copy=True
+                )
+            else:  # optional field: its NamedTuple default (None)
+                out[f] = state_cls._field_defaults[f]
+            continue
+        src = np.asarray(available[f])
+        # copy=True: on CPU, jnp.asarray(np_array) may ZERO-COPY the
+        # numpy buffer — a restored state handed to a donating tick
+        # (storm._tick_fn donate_argnums) would then let XLA scribble
+        # over (or read after free of) host memory numpy still owns.
+        # The loaded state must be device-owned.
+        # dtype deliberately inherited from the stored array — the x64
+        # truncation check right below is the guard
+        arr = jnp.array(src, copy=True)  # jaxgate: ignore[implicit-dtype]
+        if arr.dtype != src.dtype:
+            # e.g. int64 incarnations truncated to int32 because JAX
+            # x64 is disabled (RINGPOP_TPU_NO_X64): resuming would
+            # silently wrap epoch-ms timestamps
+            raise CheckpointFieldError(
+                "%s: checkpoint field %r is %s but this process loads it "
+                "as %s (is JAX x64 mode off?)"
+                % (where, f, src.dtype, arr.dtype)
+            )
+        out[f] = arr
+    return state_cls(**out)
 
 
 def load_state(path: str, state_cls: Type[T], params: Any = None) -> T:
     """Rebuild ``state_cls`` from a checkpoint written by ``save_state``.
 
     Mismatched fields (older engine revision) or — when both sides provide
-    them — mismatched params raise rather than resuming a silently wrong
-    trajectory.
+    them — mismatched params raise named :class:`CheckpointError`
+    subclasses rather than resuming a silently wrong trajectory.
     """
-    with np.load(path, allow_pickle=False) as data:
+    if not os.path.exists(path):
+        raise CheckpointNotFoundError("%s does not exist" % path)
+    try:
+        ctx = np.load(path, allow_pickle=False)
+    except Exception as e:
+        raise CheckpointTornError(
+            "%s is not a readable npz archive (truncated or partial "
+            "write?): %s" % (path, e)
+        )
+    with ctx as data:
         meta = data.get(_FORMAT_KEY)
         if meta is None:
-            raise ValueError("%s is not a ringpop_tpu checkpoint" % path)
+            raise CheckpointNotFoundError(
+                "%s is not a ringpop_tpu checkpoint" % path
+            )
         saved_name = str(meta[0])
         if saved_name != state_cls.__name__:
-            raise ValueError(
+            raise CheckpointFieldError(
                 "checkpoint holds %s, expected %s" % (saved_name, state_cls.__name__)
             )
         saved_version = int(meta[1]) if len(meta) > 1 else 0
         if saved_version != _FORMAT_VERSION:
-            raise ValueError(
+            raise CheckpointVersionError(
                 "checkpoint format v%d, this build reads v%d (incarnation "
                 "representation changed; a cross-version resume would "
                 "silently corrupt the trajectory)"
                 % (saved_version, _FORMAT_VERSION)
             )
         if params is not None and _PARAMS_KEY in data.files:
-            saved_params = json.loads(str(data[_PARAMS_KEY][0]))
-            current = json.loads(
-                json.dumps(dict(params._asdict()), sort_keys=True)
+            _check_params(
+                json.loads(str(data[_PARAMS_KEY][0])), params, path
             )
-            for neutral in _TRAJECTORY_NEUTRAL_PARAMS:
-                saved_params.pop(neutral, None)
-                current.pop(neutral, None)
-            if saved_params != current:
-                diff = {
-                    k: (saved_params.get(k), current.get(k))
-                    for k in set(saved_params) | set(current)
-                    if saved_params.get(k) != current.get(k)
-                }
-                raise ValueError(
-                    "checkpoint params differ from the resuming engine's "
-                    "(saved, current): %r" % diff
+        try:
+            available = {
+                f: data[f]
+                for f in data.files
+                if f not in (_FORMAT_KEY, _PARAMS_KEY)
+            }
+        except Exception as e:
+            raise CheckpointTornError(
+                "%s: array member unreadable (truncated archive?): %s"
+                % (path, e)
+            )
+        return _reconcile_fields(state_cls, available, path)
+
+
+# -- manifest format ---------------------------------------------------------
+
+MANIFEST_NAME = "manifest.json"
+MANIFEST_FORMAT = "ringpop-tpu-ckpt"
+MANIFEST_VERSION = 1
+_COMMON_FILE = "common.npz"
+
+
+def _shard_file(s: int, shards: int) -> str:
+    return "shard-%05d-of-%05d.npz" % (s, shards)
+
+
+def _as_state_map(states: Any) -> Dict[str, Any]:
+    if hasattr(states, "_fields"):
+        return {"state": states}
+    if isinstance(states, Mapping):
+        for name, st in states.items():
+            if not hasattr(st, "_fields"):
+                raise TypeError(
+                    "state %r must be a NamedTuple of arrays" % name
                 )
-        optional = set(getattr(state_cls, "_field_defaults", {}))
-        missing = [
-            f
-            for f in state_cls._fields
-            if f not in data.files
-            and f not in _FIELD_DEFAULTS
-            and f not in optional
-        ]
-        extra = [
-            f
-            for f in data.files
-            if f not in state_cls._fields and f not in (_FORMAT_KEY, _PARAMS_KEY)
-        ]
-        if missing or extra:
-            raise ValueError(
-                "checkpoint fields do not match %s (missing=%r, extra=%r)"
-                % (state_cls.__name__, missing, extra)
-            )
-        out = {}
-        for f in state_cls._fields:
-            if f not in data.files:
-                if f in _FIELD_DEFAULTS:
-                    sibling, default_of = _FIELD_DEFAULTS[f]
-                    out[f] = jnp.asarray(
-                        default_of(np.asarray(data[sibling]))
-                    )
-                else:  # optional field: its NamedTuple default (None)
-                    out[f] = state_cls._field_defaults[f]
+        return dict(states)
+    raise TypeError("states must be a NamedTuple or a dict of NamedTuples")
+
+
+def _per_state(value: Any, names, what: str) -> Dict[str, Any]:
+    """Broadcast a singleton (params / sharded_fields) over state names,
+    or validate an explicit per-state mapping."""
+    if isinstance(value, Mapping) and not hasattr(value, "_fields"):
+        unknown = set(value) - set(names)
+        if unknown:
+            raise ValueError("%s for unknown states %r" % (what, unknown))
+        return {n: value.get(n) for n in names}
+    return {n: value for n in names}
+
+
+def save_checkpoint(
+    path: str,
+    states: Any,
+    params: Any = None,
+    *,
+    shards: int = 1,
+    sharded_fields: Any = None,
+    meta: Optional[Dict[str, Any]] = None,
+) -> Dict[str, Any]:
+    """Write a manifest-format checkpoint directory at ``path``.
+
+    ``states`` is one NamedTuple-of-arrays (stored under the name
+    ``"state"``) or a dict of them (e.g. RoutedStorm's ``{"sim": ...,
+    "route": ...}``); ``params``/``sharded_fields`` may be singletons or
+    per-state dicts.  With ``shards > 1``, every field named in
+    ``sharded_fields`` is split along axis 0 into per-shard files
+    (``np.array_split`` — restorable onto ANY shard count since the
+    loader reassembles full arrays); everything else lands in
+    ``common.npz``.  Every array file is written atomically, and
+    ``manifest.json`` — carrying per-file and per-array CRC32 digests —
+    is written LAST: the manifest IS the commit point, so a crash at any
+    earlier byte leaves no valid checkpoint at ``path`` (the recovery
+    scan skips it and falls back).  Returns the manifest dict.
+    """
+    if shards < 1:
+        raise ValueError("shards must be >= 1, got %d" % shards)
+    state_map = _as_state_map(states)
+    params_map = _per_state(params, state_map, "params")
+    shard_map = _per_state(sharded_fields, state_map, "sharded_fields")
+    os.makedirs(path, exist_ok=True)
+
+    common: Dict[str, np.ndarray] = {}
+    shard_arrays: List[Dict[str, np.ndarray]] = [{} for _ in range(shards)]
+    manifest_states: Dict[str, Any] = {}
+    for name, state in state_map.items():
+        split = frozenset(shard_map[name] or ()) if shards > 1 else frozenset()
+        fields: Dict[str, Any] = {}
+        for f in state._fields:
+            v = getattr(state, f)
+            if v is None:
+                fields[f] = None  # optional field: restored as None
                 continue
-            arr = jnp.asarray(data[f])
-            if arr.dtype != data[f].dtype:
-                # e.g. int64 incarnations truncated to int32 because JAX
-                # x64 is disabled (RINGPOP_TPU_NO_X64): resuming would
-                # silently wrap epoch-ms timestamps
-                raise ValueError(
-                    "checkpoint field %r is %s but this process loads it "
-                    "as %s (is JAX x64 mode off?)"
-                    % (f, data[f].dtype, arr.dtype)
-                )
-            out[f] = arr
-        return state_cls(**out)
+            arr = np.asarray(v)
+            key = "%s.%s" % (name, f)
+            entry = {
+                "dtype": str(arr.dtype),
+                "shape": list(arr.shape),
+            }
+            if f in split and arr.ndim >= 1:
+                pieces = np.array_split(arr, shards, axis=0)
+                for s, piece in enumerate(pieces):
+                    shard_arrays[s][key] = piece
+                entry["where"] = "shards"
+                entry["crc32"] = [_array_crc(p) for p in pieces]
+            else:
+                common[key] = arr
+                entry["where"] = "common"
+                entry["crc32"] = _array_crc(arr)
+            fields[f] = entry
+        p = params_map[name]
+        manifest_states[name] = {
+            "class": type(state).__name__,
+            "params": None if p is None else _params_jsonable(p),
+            "fields": fields,
+        }
+
+    files: Dict[str, Any] = {}
+    total = 0
+
+    def _commit(fname: str, arrays: Dict[str, np.ndarray]) -> None:
+        nonlocal total
+        buf = _npz_bytes(arrays)
+        atomic_write_bytes(os.path.join(path, fname), buf)
+        files[fname] = {"nbytes": len(buf), "crc32": _crc(buf)}
+        total += len(buf)
+
+    _commit(_COMMON_FILE, common)
+    shard_names = []
+    for s in range(shards) if shards > 1 else ():
+        fname = _shard_file(s, shards)
+        _commit(fname, shard_arrays[s])
+        shard_names.append(fname)
+
+    manifest = {
+        "format": MANIFEST_FORMAT,
+        "version": MANIFEST_VERSION,
+        "engine_version": _FORMAT_VERSION,
+        "shards": shards,
+        "common": _COMMON_FILE,
+        "shard_files": shard_names,
+        "files": files,
+        "states": manifest_states,
+        "nbytes": total,
+        "meta": dict(meta or {}),
+    }
+    atomic_write_bytes(
+        os.path.join(path, MANIFEST_NAME),
+        json.dumps(manifest, sort_keys=True, indent=1).encode("utf-8"),
+    )
+    return manifest
+
+
+def load_any(path: str, state_cls: Type[T], params: Any = None) -> T:
+    """Format-dispatching single-state load: a directory is a manifest
+    checkpoint, a file the legacy npz — the drivers' ``load(path)``
+    entry point, so an operator can hand either artifact kind to any
+    driver."""
+    if os.path.isdir(path):
+        return load_checkpoint(path, state_cls, params)
+    return load_state(path, state_cls, params)
+
+
+def read_manifest(path: str) -> Dict[str, Any]:
+    """Parse + format-check ``path``'s manifest (no array I/O).  Raises
+    the named taxonomy: missing -> NotFound, unparseable -> Torn,
+    foreign format -> NotFound, version drift -> Version."""
+    mpath = os.path.join(path, MANIFEST_NAME)
+    if not os.path.isdir(path) or not os.path.exists(mpath):
+        raise CheckpointNotFoundError(
+            "%s holds no %s — not a (complete) checkpoint" % (path, MANIFEST_NAME)
+        )
+    try:
+        with open(mpath, encoding="utf-8") as fh:
+            manifest = json.load(fh)
+    except (ValueError, OSError) as e:
+        raise CheckpointTornError(
+            "%s: manifest unparseable (interrupted write?): %s" % (path, e)
+        )
+    if not isinstance(manifest, dict) or manifest.get("format") != MANIFEST_FORMAT:
+        raise CheckpointNotFoundError(
+            "%s: manifest is not %r" % (path, MANIFEST_FORMAT)
+        )
+    if manifest.get("version") != MANIFEST_VERSION:
+        raise CheckpointVersionError(
+            "%s: manifest format v%r, this build reads v%d"
+            % (path, manifest.get("version"), MANIFEST_VERSION)
+        )
+    if manifest.get("engine_version") != _FORMAT_VERSION:
+        raise CheckpointVersionError(
+            "%s: engine state format v%r, this build reads v%d "
+            "(incarnation representation changed; a cross-version resume "
+            "would silently corrupt the trajectory)"
+            % (path, manifest.get("engine_version"), _FORMAT_VERSION)
+        )
+    shard_names = manifest.get("shard_files", [])
+    shards = manifest.get("shards", 1)
+    if shards > 1 and len(shard_names) != shards:
+        raise CheckpointShardError(
+            "%s: manifest names %d shard files for shards=%d"
+            % (path, len(shard_names), shards)
+        )
+    return manifest
+
+
+def _verify_file(path: str, fname: str, entry: Dict[str, Any], deep: bool) -> None:
+    fpath = os.path.join(path, fname)
+    is_shard = fname.startswith("shard-")
+    if not os.path.exists(fpath):
+        err = CheckpointShardError if is_shard else CheckpointTornError
+        raise err("%s: missing array file %s" % (path, fname))
+    size = os.path.getsize(fpath)
+    if size != entry["nbytes"]:
+        raise CheckpointTornError(
+            "%s: %s is %d bytes, manifest says %d (truncated/partial write)"
+            % (path, fname, size, entry["nbytes"])
+        )
+    if deep:
+        with open(fpath, "rb") as fh:
+            buf = fh.read()
+        if _crc(buf) != entry["crc32"]:
+            raise CheckpointDigestError(
+                "%s: %s content digest mismatch (bit-rot or tampering: "
+                "crc32 %08x != manifest %08x)"
+                % (path, fname, _crc(buf), entry["crc32"])
+            )
+
+
+def verify_checkpoint(path: str, deep: bool = True) -> Dict[str, Any]:
+    """Validate a manifest-format checkpoint without constructing states.
+
+    ``deep=False``: manifest parse + file existence + exact sizes (the
+    rotation scan's cheap validity probe).  ``deep=True``: additionally
+    re-verify every file AND per-array digest (the CI validator).  Raises
+    the named error; returns the manifest when valid."""
+    manifest = read_manifest(path)
+    names = [manifest["common"]] + list(manifest.get("shard_files", []))
+    for fname in names:
+        entry = manifest["files"].get(fname)
+        if entry is None:
+            raise CheckpointShardError(
+                "%s: manifest lists no digest for %s" % (path, fname)
+            )
+        _verify_file(path, fname, entry, deep)
+    if deep:
+        _load_arrays(path, manifest)  # per-array digests + shapes
+    return manifest
+
+
+def _open_npz(path: str, fname: str, entry: Optional[Dict[str, Any]] = None):
+    """Open an array file, verifying the manifest's whole-file digest
+    first when given: ANY flipped byte on disk — array data, npy header
+    padding, zip structure — is a named CheckpointDigestError before
+    numpy parses a single byte."""
+    fpath = os.path.join(path, fname)
+    try:
+        with open(fpath, "rb") as fh:
+            buf = fh.read()
+    except OSError as e:
+        raise CheckpointTornError("%s: %s unreadable: %s" % (path, fname, e))
+    if entry is not None:
+        if len(buf) != entry["nbytes"]:
+            raise CheckpointTornError(
+                "%s: %s is %d bytes, manifest says %d (truncated/partial "
+                "write)" % (path, fname, len(buf), entry["nbytes"])
+            )
+        if _crc(buf) != entry["crc32"]:
+            raise CheckpointDigestError(
+                "%s: %s content digest mismatch (bit-rot or tampering: "
+                "crc32 %08x != manifest %08x)"
+                % (path, fname, _crc(buf), entry["crc32"])
+            )
+    try:
+        return np.load(io.BytesIO(buf), allow_pickle=False)
+    except Exception as e:
+        raise CheckpointTornError(
+            "%s: %s unreadable as npz (truncated?): %s" % (path, fname, e)
+        )
+
+
+def _read_member(arch, path: str, key: str) -> np.ndarray:
+    """Extract one npz member, folding the zip layer's own failure modes
+    into the named taxonomy (zipfile raises BadZipFile mid-read on its
+    per-member CRC — flipped bits — and assorted errors on truncated
+    members)."""
+    import zipfile
+
+    try:
+        return arch[key]
+    except Exception as e:
+        if isinstance(e, zipfile.BadZipFile) and "CRC" in str(e):
+            raise CheckpointDigestError(
+                "%s: member %r content digest mismatch (flipped bits on "
+                "disk?): %s" % (path, key, e)
+            )
+        raise CheckpointTornError(
+            "%s: member %r unreadable (truncated archive?): %s"
+            % (path, key, e)
+        )
+
+
+def _load_arrays(
+    path: str, manifest: Dict[str, Any]
+) -> Dict[str, Dict[str, np.ndarray]]:
+    """Read + digest-verify every stored array; reassemble sharded fields
+    by concatenation along axis 0.  Returns {state: {field: array}}."""
+    shards = manifest.get("shards", 1)
+    files = manifest.get("files", {})
+    archives = {
+        _COMMON_FILE: _open_npz(
+            path, manifest["common"], files.get(manifest["common"])
+        )
+    }
+    for fname in manifest.get("shard_files", []):
+        archives[fname] = _open_npz(path, fname, files.get(fname))
+    try:
+        out: Dict[str, Dict[str, np.ndarray]] = {}
+        for sname, sdesc in manifest["states"].items():
+            fields: Dict[str, np.ndarray] = {}
+            for f, entry in sdesc["fields"].items():
+                if entry is None:
+                    continue  # stored None: optional-field default
+                key = "%s.%s" % (sname, f)
+                if entry["where"] == "shards":
+                    pieces = []
+                    for s in range(shards):
+                        arch = archives[manifest["shard_files"][s]]
+                        if key not in arch.files:
+                            raise CheckpointShardError(
+                                "%s: shard %d holds no %r" % (path, s, key)
+                            )
+                        piece = _read_member(arch, path, key)
+                        if _array_crc(piece) != entry["crc32"][s]:
+                            raise CheckpointDigestError(
+                                "%s: field %r shard %d digest mismatch "
+                                "(flipped bits on disk?)" % (path, key, s)
+                            )
+                        pieces.append(piece)
+                    arr = np.concatenate(pieces, axis=0) if pieces else None
+                else:
+                    arch = archives[_COMMON_FILE]
+                    if key not in arch.files:
+                        raise CheckpointTornError(
+                            "%s: common file holds no %r" % (path, key)
+                        )
+                    arr = _read_member(arch, path, key)
+                    if _array_crc(arr) != entry["crc32"]:
+                        raise CheckpointDigestError(
+                            "%s: field %r digest mismatch (flipped bits "
+                            "on disk?)" % (path, key)
+                        )
+                if list(arr.shape) != entry["shape"] or str(arr.dtype) != entry["dtype"]:
+                    raise CheckpointFieldError(
+                        "%s: field %r is %s%r, manifest says %s%r"
+                        % (
+                            path,
+                            key,
+                            arr.dtype,
+                            arr.shape,
+                            entry["dtype"],
+                            tuple(entry["shape"]),
+                        )
+                    )
+                fields[f] = arr
+            out[sname] = fields
+        return out
+    finally:
+        for arch in archives.values():
+            arch.close()
+
+
+def load_checkpoint(
+    path: str, state_cls: Any, params: Any = None
+) -> Any:
+    """Rebuild state(s) from a manifest-format checkpoint directory.
+
+    ``state_cls`` is a NamedTuple type (returns one state) or a dict
+    name -> type matching the saved layout (returns a dict of states);
+    ``params`` likewise.  Every file and array digest is re-verified —
+    truncation raises :class:`CheckpointTornError`, flipped bits
+    :class:`CheckpointDigestError`, missing shards
+    :class:`CheckpointShardError`, and class/field/params drift their
+    named errors — never a silent resume."""
+    single = hasattr(state_cls, "_fields")
+    cls_map = {"state": state_cls} if single else dict(state_cls)
+    manifest = read_manifest(path)
+    for fname in [manifest["common"]] + list(manifest.get("shard_files", [])):
+        _verify_file(path, fname, manifest["files"][fname], deep=False)
+    params_map = _per_state(params, cls_map, "params")
+    for name, cls in cls_map.items():
+        sdesc = manifest["states"].get(name)
+        if sdesc is None:
+            raise CheckpointFieldError(
+                "%s: checkpoint holds states %r, requested %r"
+                % (path, sorted(manifest["states"]), name)
+            )
+        if sdesc["class"] != cls.__name__:
+            raise CheckpointFieldError(
+                "%s: state %r holds %s, expected %s"
+                % (path, name, sdesc["class"], cls.__name__)
+            )
+        extra = [f for f in sdesc["fields"] if f not in cls._fields]
+        if extra:
+            raise CheckpointFieldError(
+                "%s: state %r carries fields %r unknown to %s (newer "
+                "engine revision?)" % (path, name, extra, cls.__name__)
+            )
+        p = params_map[name]
+        if p is not None and sdesc.get("params") is not None:
+            _check_params(sdesc["params"], p, "%s[%s]" % (path, name))
+    arrays = _load_arrays(path, manifest)
+    out = {
+        name: _reconcile_fields(cls, arrays[name], "%s[%s]" % (path, name))
+        for name, cls in cls_map.items()
+    }
+    return out["state"] if single else out

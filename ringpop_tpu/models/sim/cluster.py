@@ -26,6 +26,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ringpop_tpu.models.sim import engine
+from ringpop_tpu.models.sim.recovery import CheckpointableMixin, CheckpointSpec
 from ringpop_tpu.models.sim.schedule import DeviceScheduleMixin
 from ringpop_tpu.ops import checksum_encode as ce
 
@@ -149,7 +150,65 @@ def clear_executable_cache() -> None:
     _scanned_fn.cache_clear()
 
 
-class SimCluster:
+def fixup_sim_state(
+    state: engine.SimState, params: engine.SimParams, universe: ce.Universe
+) -> engine.SimState:
+    """Align a just-loaded SimState with the resuming engine's params —
+    the ONE post-load fixup shared by SimCluster, ShardedSim and the
+    recovery plane (checkpoint knobs in _TRAJECTORY_NEUTRAL_PARAMS may
+    legally differ between save and resume)."""
+    if params.fused_checksum == "on":
+        # the record cache is a pure function of (known, status,
+        # inc) — rebuild it UNCONDITIONALLY at this boundary.  A
+        # checkpoint's stored cache cannot be trusted: an
+        # intervening unfused resume (fused_checksum is
+        # trajectory-neutral, checkpoint.py) carries the saved
+        # cache through unchanged while the views evolve, and a
+        # later fused resume hashing those stale bytes would
+        # silently break the parity contract.
+        from ringpop_tpu.ops import fused_checksum as fc
+
+        rec_b, rec_l = fc.member_records(
+            universe,
+            state.known,
+            state.status,
+            engine.stamp_to_ms(state.inc, params),
+            params.max_digits,
+        )
+        state = state._replace(rec_bytes=rec_b, rec_len=rec_l)
+    elif state.rec_bytes is not None:
+        # unfused resume of a fused checkpoint: drop the cache so
+        # this run never saves forward bytes it does not maintain
+        state = state._replace(rec_bytes=None, rec_len=None)
+    # flight-recorder plane: telemetry, not trajectory — a resume may
+    # toggle it freely.  Recorder-on resumes start a fresh (empty)
+    # buffer when the checkpoint has none or its capacity differs;
+    # recorder-off resumes drop the saved buffer so this run never
+    # carries forward events it will not append to.
+    if params.flight_recorder:
+        buf = state.ev_buf
+        if buf is None or buf.shape[0] != params.event_capacity:
+            from ringpop_tpu.models.sim import flight
+
+            ev_buf, ev_head, ev_drops, first_heard = (
+                flight.init_recorder_fields(params.n, params.event_capacity)
+            )
+            if state.first_heard is not None:
+                first_heard = state.first_heard  # keep wavefront
+            state = state._replace(
+                ev_buf=ev_buf,
+                ev_head=ev_head,
+                ev_drops=ev_drops,
+                first_heard=first_heard,
+            )
+    elif state.ev_buf is not None:
+        state = state._replace(
+            ev_buf=None, ev_head=None, ev_drops=None, first_heard=None
+        )
+    return state
+
+
+class SimCluster(CheckpointableMixin):
     def __init__(
         self,
         n: Optional[int] = None,
@@ -257,11 +316,18 @@ class SimCluster:
         metrics = jax.tree.map(np.asarray, metrics)
         if self.recorder is not None:
             self.recorder.record_ticks(metrics)
+        self._after_ticks(1)
         return metrics
 
     def run(self, schedule: EventSchedule):
         """Scan the tick over a dense event schedule; returns stacked
-        per-tick metrics (a TickMetrics of [T]-arrays)."""
+        per-tick metrics (a TickMetrics of [T]-arrays).  With a
+        checkpoint cadence enabled (enable_checkpoints(every=k)) the
+        scan is split at cadence boundaries — trajectory- and
+        metrics-bitwise-neutral (tests/models/test_recovery.py)."""
+        return self._run_chunked(schedule, self._run_window)
+
+    def _run_window(self, schedule: EventSchedule):
         inputs = schedule.as_inputs()
         pre = self.state
         self.state, metrics = self._scanned(pre, inputs)
@@ -451,58 +517,25 @@ class SimCluster:
         save_state(path, self.state, self.params)
 
     def load(self, path: str) -> None:
-        from ringpop_tpu.models.sim.checkpoint import load_state
+        """Resume from ``path`` — a legacy ``.npz`` file or a manifest
+        checkpoint directory (any shard count) alike."""
+        from ringpop_tpu.models.sim.checkpoint import load_any
 
-        self.state = load_state(path, engine.SimState, self.params)
-        if self.params.fused_checksum == "on":
-            # the record cache is a pure function of (known, status,
-            # inc) — rebuild it UNCONDITIONALLY at this boundary.  A
-            # checkpoint's stored cache cannot be trusted: an
-            # intervening unfused resume (fused_checksum is
-            # trajectory-neutral, checkpoint.py) carries the saved
-            # cache through unchanged while the views evolve, and a
-            # later fused resume hashing those stale bytes would
-            # silently break the parity contract.
-            from ringpop_tpu.ops import fused_checksum as fc
+        self.state = fixup_sim_state(
+            load_any(path, engine.SimState, self.params),
+            self.params,
+            self.universe,
+        )
 
-            rec_b, rec_l = fc.member_records(
-                self.universe,
-                self.state.known,
-                self.state.status,
-                engine.stamp_to_ms(self.state.inc, self.params),
-                self.params.max_digits,
-            )
-            self.state = self.state._replace(
-                rec_bytes=rec_b, rec_len=rec_l
-            )
-        elif self.state.rec_bytes is not None:
-            # unfused resume of a fused checkpoint: drop the cache so
-            # this run never saves forward bytes it does not maintain
-            self.state = self.state._replace(rec_bytes=None, rec_len=None)
-        # flight-recorder plane: telemetry, not trajectory — a resume may
-        # toggle it freely.  Recorder-on resumes start a fresh (empty)
-        # buffer when the checkpoint has none or its capacity differs;
-        # recorder-off resumes drop the saved buffer so this run never
-        # carries forward events it will not append to.
-        if self.params.flight_recorder:
-            buf = self.state.ev_buf
-            if buf is None or buf.shape[0] != self.params.event_capacity:
-                from ringpop_tpu.models.sim import flight
+    # -- recovery plane (models/sim/recovery.py) --------------------------
 
-                ev_buf, ev_head, ev_drops, first_heard = (
-                    flight.init_recorder_fields(
-                        self.params.n, self.params.event_capacity
-                    )
-                )
-                if self.state.first_heard is not None:
-                    first_heard = self.state.first_heard  # keep wavefront
-                self.state = self.state._replace(
-                    ev_buf=ev_buf,
-                    ev_head=ev_head,
-                    ev_drops=ev_drops,
-                    first_heard=first_heard,
-                )
-        elif self.state.ev_buf is not None:
-            self.state = self.state._replace(
-                ev_buf=None, ev_head=None, ev_drops=None, first_heard=None
-            )
+    def _ckpt_spec(self) -> CheckpointSpec:
+        # sharded_fields=None -> dynamic: every non-scalar SimState field
+        # is node-leading (parallel/mesh._spec_for shards them all)
+        return CheckpointSpec(engine.SimState, self.params, None)
+
+    def _ckpt_states(self):
+        return self.state
+
+    def _ckpt_install(self, state) -> None:
+        self.state = fixup_sim_state(state, self.params, self.universe)

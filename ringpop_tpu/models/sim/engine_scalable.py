@@ -210,6 +210,29 @@ class ScalableState(NamedTuple):
     first_heard: Optional[jax.Array] = None  # [N, U] int32
 
 
+# ScalableState fields indexed by NODE along axis 0 — the single source
+# for the mesh's P("nodes") shardings (parallel/mesh.py) and the sharded
+# checkpoint split (models/sim/recovery.py).  Decided by NAME, not shape:
+# u == n would make shape checks ambiguous.  Everything else — the
+# bounded [U] rumor table, the scalar clock/base, the rng, the telemetry
+# wavefront — replicates / stays in the common checkpoint file.
+NODE_SHARDED_FIELDS = frozenset(
+    {
+        "proc_alive",
+        "gossip_on",
+        "partition",
+        "truth_status",
+        "truth_inc",
+        "heard",
+        "susp_subject",
+        "susp_since",
+        "defame_slot",
+        "defame_by",
+        "checksum",
+    }
+)
+
+
 class ScalableMetrics(NamedTuple):
     live_nodes: jax.Array
     active_rumors: jax.Array

@@ -21,9 +21,30 @@ matches plain inputs — no jit retrace.
 
 from __future__ import annotations
 
+import dataclasses
+
 
 class DeviceScheduleMixin:
     """Memoized ``as_inputs()``/``invalidate()`` over ``_build_inputs()``."""
+
+    def window(self, t0: int, t1: int):
+        """A new schedule holding ticks ``[t0, t1)`` of this one — the
+        checkpoint-cadence/crash-resume slice (recovery._run_chunked,
+        fuzz/crash.py).  Dense [T, N] planes are sliced and copied
+        (mutating the window never leaks into the parent or vice versa);
+        optional ``None`` planes stay ``None`` so the window's input
+        pytree structure matches the parent's — no jit retrace."""
+        if not (0 <= t0 <= t1 <= self.ticks):
+            raise ValueError(
+                "window [%d, %d) outside schedule of %d ticks"
+                % (t0, t1, self.ticks)
+            )
+        kw = {}
+        for f in dataclasses.fields(self):
+            v = getattr(self, f.name)
+            kw[f.name] = v[t0:t1].copy() if hasattr(v, "ndim") else v
+        kw["ticks"] = t1 - t0
+        return type(self)(**kw)
 
     def as_inputs(self):
         """Engine input pytree for this schedule (memoized device arrays).

@@ -34,6 +34,7 @@ from ringpop_tpu.models.ring.device import (  # noqa: F401 — re-exported
     ring_checksum,
 )
 from ringpop_tpu.models.sim import engine_scalable as es
+from ringpop_tpu.models.sim.recovery import CheckpointableMixin, CheckpointSpec
 from ringpop_tpu.models.sim.schedule import DeviceScheduleMixin
 
 
@@ -96,21 +97,43 @@ class StormSchedule(DeviceScheduleMixin):
         return sched
 
 
+def donate_state_argnums() -> tuple:
+    """Donation policy for the storm/route carry (round 13): donate
+    everywhere EXCEPT the CPU backend.
+
+    Donation is the round-10 HBM win (the [N, U/32] heard mask updates
+    in place instead of allocating a second copy per tick — 64 MB/copy
+    at 1M nodes).  On this image's CPU backend, however, executables
+    DESERIALIZED from the persistent compilation cache mis-execute
+    buffer donation whenever another dispatch interleaves between calls
+    (a checkpoint save's host reads, even an unrelated jnp.zeros):
+    warm-cache runs silently compute a wrong trajectory — cold compiles
+    are correct, and neither the legacy nor the thunk CPU runtime is
+    immune (bisect: round-13 session; repro pattern preserved in
+    tests/models/test_recovery.py's cadence tests, which flake within
+    minutes if donation is re-enabled under the cache).  A host-RAM
+    copy per tick is noise at CPU test/bench scales, so correctness
+    wins; TPU keeps the in-place path."""
+    import jax as _jax
+
+    return () if _jax.default_backend() == "cpu" else (0,)
+
+
 @functools.lru_cache(maxsize=None)
 def _tick_fn(params: es.ScalableParams):
-    # donate the state: the tick's output state reuses the input's
-    # buffers (the [N, U/32] heard mask updates in place instead of
-    # allocating a second copy per tick — at 1M nodes the mask alone is
-    # 64 MB).  Drivers always overwrite self.state with the result, so
-    # the donated input is never re-read.
+    # donate the state (backend-gated, see donate_state_argnums): the
+    # tick's output state reuses the input's buffers.  Drivers always
+    # overwrite self.state with the result, so the donated input is
+    # never re-read.
     return jax.jit(
-        functools.partial(es.tick, params=params), donate_argnums=(0,)
+        functools.partial(es.tick, params=params),
+        donate_argnums=donate_state_argnums(),
     )
 
 
 @functools.lru_cache(maxsize=None)
 def _scanned_fn(params: es.ScalableParams):
-    @functools.partial(jax.jit, donate_argnums=(0,))
+    @functools.partial(jax.jit, donate_argnums=donate_state_argnums())
     def _scanned(state, inputs):
         def body(st, inp):
             return es.tick(st, inp, params)
@@ -143,7 +166,23 @@ def _ring_checksum_fn(n: int, replica_points: int):
     return _ring_and_checksum
 
 
-class ScalableCluster:
+def fixup_scalable_state(
+    state: es.ScalableState, params: es.ScalableParams
+) -> es.ScalableState:
+    """Align a just-loaded ScalableState with the resuming engine's
+    params (shared by ScalableCluster, ShardedStorm and RoutedStorm).
+    The wavefront plane is telemetry, not trajectory — a resume may
+    toggle it regardless of what the checkpoint carried."""
+    if params.wavefront and state.first_heard is None:
+        state = state._replace(
+            first_heard=jnp.full((params.n, params.u), -1, jnp.int32)
+        )
+    elif not params.wavefront and state.first_heard is not None:
+        state = state._replace(first_heard=None)
+    return state
+
+
+class ScalableCluster(CheckpointableMixin):
     """Driver for the scalable engine (construction pins the trace-time
     knobs; step/run go through shared compiled executables).
 
@@ -201,9 +240,16 @@ class ScalableCluster:
         m = jax.tree.map(np.asarray, m)
         if self.recorder is not None:
             self.recorder.record_ticks(m)
+        self._after_ticks(1)
         return m
 
     def run(self, schedule: StormSchedule):
+        """Scan over the storm plan; with a checkpoint cadence enabled
+        the scan is split at cadence boundaries (trajectory- and
+        metrics-bitwise-neutral, tests/models/test_recovery.py)."""
+        return self._run_chunked(schedule, self._run_window)
+
+    def _run_window(self, schedule: StormSchedule):
         self.state, ms = self._scanned(self.state, schedule.as_inputs())
         ms = jax.tree.map(np.asarray, ms)
         if self.recorder is not None:
@@ -263,18 +309,23 @@ class ScalableCluster:
         save_state(path, self.state, self.params)
 
     def load(self, path: str) -> None:
-        from ringpop_tpu.models.sim.checkpoint import load_state
+        """Resume from ``path`` — a legacy ``.npz`` file or a manifest
+        checkpoint directory (any shard count) alike."""
+        from ringpop_tpu.models.sim.checkpoint import load_any
 
-        self.state = load_state(path, es.ScalableState, self.params)
-        # wavefront plane: telemetry, not trajectory — align with this
-        # cluster's params regardless of what the checkpoint carried
-        if self.params.wavefront and self.state.first_heard is None:
-            self.state = self.state._replace(
-                first_heard=jnp.full(
-                    (self.params.n, self.params.u), -1, jnp.int32
-                )
-            )
-        elif not self.params.wavefront and (
-            self.state.first_heard is not None
-        ):
-            self.state = self.state._replace(first_heard=None)
+        self.state = fixup_scalable_state(
+            load_any(path, es.ScalableState, self.params), self.params
+        )
+
+    # -- recovery plane (models/sim/recovery.py) --------------------------
+
+    def _ckpt_spec(self) -> CheckpointSpec:
+        return CheckpointSpec(
+            es.ScalableState, self.params, es.NODE_SHARDED_FIELDS
+        )
+
+    def _ckpt_states(self):
+        return self.state
+
+    def _ckpt_install(self, state) -> None:
+        self.state = fixup_scalable_state(state, self.params)

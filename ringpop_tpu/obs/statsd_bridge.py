@@ -68,6 +68,18 @@ TICK_KEY_MAP: Dict[str, Tuple[str, str]] = {
     "route_ring_points": ("gauge", "sim.route.ring.points"),
 }
 
+# Recovery-plane lifecycle counters (models/sim/recovery.py): emitted by
+# CheckpointManager directly (they are per-event, not per-tick, so they
+# ride their own map rather than TICK_KEY_MAP).  The reference has no
+# checkpoint analog — a restarted ringpop rebuilds via join full-sync —
+# so these live under the sim. namespace.
+CKPT_KEY_MAP: Dict[str, str] = {
+    "ckpt.saved": "sim.ckpt.saved",
+    "ckpt.corrupt": "sim.ckpt.corrupt",
+    "ckpt.resumed": "sim.ckpt.resumed",
+    "ckpt.gc": "sim.ckpt.gc",
+}
+
 
 def stat_prefix(host_port: str) -> str:
     """The reference's stats identity: ``ringpop.<host_port>`` with

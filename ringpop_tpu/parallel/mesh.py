@@ -39,6 +39,7 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ringpop_tpu.models.sim import engine
+from ringpop_tpu.models.sim.recovery import CheckpointableMixin, CheckpointSpec
 from ringpop_tpu.ops import checksum_encode as ce
 
 AXIS = "nodes"
@@ -195,7 +196,7 @@ def clear_executable_cache() -> None:
     _storm_scan_fn.cache_clear()
 
 
-class ShardedSim:
+class ShardedSim(CheckpointableMixin):
     """A SimCluster-shaped driver whose state lives sharded on the mesh.
 
     The multi-chip twin of :class:`ringpop_tpu.models.sim.cluster.SimCluster`:
@@ -282,9 +283,13 @@ class ShardedSim:
         )
         if replayed is not None:
             self.state, metrics = replayed
+        self._after_ticks(1)
         return jax.tree.map(np.asarray, metrics)
 
     def run(self, schedule) -> engine.TickMetrics:
+        return self._run_chunked(schedule, self._run_window)
+
+    def _run_window(self, schedule) -> engine.TickMetrics:
         inputs = schedule.as_inputs()
         pre = self.state
         self.state, metrics = self._scan(pre, inputs)
@@ -298,6 +303,64 @@ class ShardedSim:
     def checksums(self) -> np.ndarray:
         return np.asarray(self.state.checksum)
 
+    # -- checkpoint/resume (models/sim/recovery.py) -----------------------
+    # Saves gather the node-sharded state to host and split it across
+    # per-shard files (default: one per mesh device); loads reassemble
+    # full arrays and re-place them under THIS mesh's shardings, so a
+    # checkpoint restores onto any device count — including down to the
+    # single-device SimCluster (tests/parallel/test_sharded_ckpt.py).
+
+    def _default_ckpt_shards(self) -> int:
+        return int(self.mesh.devices.size)
+
+    def _ckpt_spec(self) -> CheckpointSpec:
+        return CheckpointSpec(
+            engine.SimState, self.params, self._ckpt_sharded_fields()
+        )
+
+    def _ckpt_states(self):
+        # live (sharded) state: the manager/save layer makes the ONE
+        # host copy (recovery.host_copy_states) — copying here too would
+        # memcpy the full state twice per cadence save
+        return self.state
+
+    def _ckpt_sharded_fields(self) -> frozenset:
+        # every non-scalar SimState field is node-leading (_spec_for)
+        return frozenset(
+            f
+            for f in self.state._fields
+            if getattr(getattr(self.state, f), "ndim", 0) >= 1
+        )
+
+    def _ckpt_install(self, state) -> None:
+        from ringpop_tpu.models.sim.cluster import fixup_sim_state
+
+        self.state = shard_state(
+            fixup_sim_state(state, self.params, self.universe), self.mesh
+        )
+
+    def save(self, path: str, shards: Optional[int] = None) -> None:
+        """Manifest-format checkpoint directory at ``path``."""
+        from ringpop_tpu.models.sim import checkpoint as ckpt
+        from ringpop_tpu.models.sim.recovery import host_copy_states
+
+        ckpt.save_checkpoint(
+            path,
+            host_copy_states(self.state),
+            self.params,
+            shards=self._default_ckpt_shards() if shards is None else shards,
+            sharded_fields=self._ckpt_sharded_fields(),
+        )
+
+    def load(self, path: str) -> None:
+        """Resume from ``path`` — a legacy ``.npz`` file or a manifest
+        checkpoint directory (any shard count) alike."""
+        from ringpop_tpu.models.sim import checkpoint as ckpt
+
+        self._ckpt_install(
+            ckpt.load_any(path, engine.SimState, self.params)
+        )
+
 
 # ---------------------------------------------------------------------------
 # Scalable (rumor-table) engine over the mesh — the 1M-on-v5e-8 path.
@@ -310,21 +373,10 @@ class ShardedSim:
 
 # node-indexed ScalableState fields (sharded); everything else — the
 # bounded [U] rumor table, the scalar clock/base, the rng — replicates.
-# Decided by NAME, not shape: u == n would make shape checks ambiguous
-_SCALABLE_NODE_FIELDS = frozenset(
-    {
-        "proc_alive",
-        "gossip_on",
-        "partition",
-        "truth_status",
-        "truth_inc",
-        "heard",
-        "susp_subject",
-        "susp_since",
-        "defame_slot",
-        "defame_by",
-        "checksum",
-    }
+# Single source: engine_scalable.NODE_SHARDED_FIELDS (shared with the
+# sharded checkpoint split, models/sim/recovery.py)
+from ringpop_tpu.models.sim.engine_scalable import (  # noqa: E402
+    NODE_SHARDED_FIELDS as _SCALABLE_NODE_FIELDS,
 )
 
 
@@ -415,7 +467,7 @@ def _storm_scan_fn(params, mesh: Mesh, structure_key):
     )
 
 
-class ShardedStorm:
+class ShardedStorm(CheckpointableMixin):
     """ScalableCluster over a device mesh: one SPMD program per tick/scan.
 
     The driver behind the 1M churn-storm north-star's v5e-8 configuration:
@@ -474,9 +526,13 @@ class ShardedStorm:
             self.params, self.mesh, self._structure_key(inputs)
         )
         self.state, m = tick(self.state, inputs)
+        self._after_ticks(1)
         return jax.tree.map(np.asarray, m)
 
     def run(self, schedule):
+        return self._run_chunked(schedule, self._run_window)
+
+    def _run_window(self, schedule):
         inputs = schedule.as_inputs()
         scan = _storm_scan_fn(
             self.params, self.mesh, self._structure_key(inputs)
@@ -490,3 +546,56 @@ class ShardedStorm:
         if not bool(self.params.checksum_in_tick):
             return np.asarray(es.compute_checksums(self.state, self.params))
         return np.asarray(self.state.checksum)
+
+    # -- checkpoint/resume (models/sim/recovery.py) -----------------------
+    # Node-sharded fields (engine_scalable.NODE_SHARDED_FIELDS) split
+    # across per-shard files — one per mesh device by default; the rumor
+    # table/rng/base replicate into the common file.  Restores reassemble
+    # and re-place under THIS mesh's shardings, so a 8-shard save resumes
+    # on any device count (bitwise vs the single-file path — the gate in
+    # tests/parallel/test_sharded_ckpt.py).
+
+    def _default_ckpt_shards(self) -> int:
+        return int(self.mesh.devices.size)
+
+    def _ckpt_spec(self) -> CheckpointSpec:
+        from ringpop_tpu.models.sim import engine_scalable as es
+
+        return CheckpointSpec(
+            es.ScalableState, self.params, es.NODE_SHARDED_FIELDS
+        )
+
+    def _ckpt_states(self):
+        # live state; the save layer makes the one host copy
+        return self.state
+
+    def _ckpt_install(self, state) -> None:
+        from ringpop_tpu.models.sim.storm import fixup_scalable_state
+
+        self.state = jax.device_put(
+            fixup_scalable_state(state, self.params), self._st_sh
+        )
+
+    def save(self, path: str, shards: Optional[int] = None) -> None:
+        """Manifest-format checkpoint directory at ``path``."""
+        from ringpop_tpu.models.sim import checkpoint as ckpt
+        from ringpop_tpu.models.sim import engine_scalable as es
+        from ringpop_tpu.models.sim.recovery import host_copy_states
+
+        ckpt.save_checkpoint(
+            path,
+            host_copy_states(self.state),
+            self.params,
+            shards=self._default_ckpt_shards() if shards is None else shards,
+            sharded_fields=es.NODE_SHARDED_FIELDS,
+        )
+
+    def load(self, path: str) -> None:
+        """Resume from ``path`` — a legacy ``.npz`` file or a manifest
+        checkpoint directory (any shard count) alike."""
+        from ringpop_tpu.models.sim import checkpoint as ckpt
+        from ringpop_tpu.models.sim import engine_scalable as es
+
+        self._ckpt_install(
+            ckpt.load_any(path, es.ScalableState, self.params)
+        )

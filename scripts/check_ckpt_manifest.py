@@ -1,0 +1,160 @@
+#!/usr/bin/env python
+"""Validate checkpoint manifests: schema + full digest re-verify.
+
+The recovery plane's CI gate (the checkpoint twin of
+``check_metrics_schema.py``): every manifest-format checkpoint directory
+found under the given paths (default: the repo root, which covers the
+committed ``runlogs/sample_ckpt_*`` artifact so the gate is never
+vacuous) must
+
+- parse as a current-version manifest (``ringpop-tpu-ckpt`` v1, engine
+  state format v2),
+- list every array file it digests, with each file present at its exact
+  recorded size and whole-file CRC32,
+- hold per-array content digests that re-verify against the stored
+  bytes (sharded fields per shard piece).
+
+Runs standalone::
+
+    python scripts/check_ckpt_manifest.py [paths...]
+    python scripts/check_ckpt_manifest.py --repair-scan <family-dir>
+
+``--repair-scan`` is the operator's recovery preview: scan a checkpoint
+FAMILY directory (``ckpt-<tick>`` children, as the drivers'
+``enable_checkpoints`` lays out) newest-first and report which
+checkpoints are salvageable and which are corrupt (with the named
+error) — exactly the fallback order ``restore_latest()`` would take.
+Inside the tier-1 suite via tests/models/test_ckpt_validator.py, which
+calls the same entry points.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parents[1]))
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# directories never worth descending into (virtualenv-ish, caches)
+_SKIP_DIRS = {".git", "__pycache__", ".jax_cache", ".pytest_cache", "node_modules"}
+
+
+def find_checkpoints(paths=None) -> list:
+    """Every directory holding a ``manifest.json`` under ``paths``
+    (default: repo root).  A path that IS a checkpoint dir is returned
+    as itself."""
+    from ringpop_tpu.models.sim.checkpoint import MANIFEST_NAME
+
+    out = []
+    for root in paths or [REPO_ROOT]:
+        root = os.path.abspath(root)
+        if os.path.isfile(os.path.join(root, MANIFEST_NAME)):
+            out.append(root)
+            continue
+        for dirpath, dirnames, filenames in os.walk(root):
+            dirnames[:] = [d for d in dirnames if d not in _SKIP_DIRS]
+            if MANIFEST_NAME in filenames:
+                out.append(dirpath)
+    return sorted(out)
+
+
+def check(paths, verbose: bool = True) -> list:
+    """Deep-verify each checkpoint dir; returns human-readable problems
+    (empty == all valid)."""
+    from ringpop_tpu.models.sim import checkpoint as ckpt
+
+    problems = []
+    for path in paths:
+        try:
+            manifest = ckpt.verify_checkpoint(path, deep=True)
+        except ckpt.CheckpointError as e:
+            problems.append("%s: %s: %s" % (path, type(e).__name__, e))
+            continue
+        if verbose:
+            states = ",".join(
+                "%s=%s" % (k, v["class"])
+                for k, v in sorted(manifest["states"].items())
+            )
+            print(
+                "ok   %s (%s; shards=%d, %d bytes)"
+                % (path, states, manifest["shards"], manifest["nbytes"])
+            )
+    return problems
+
+
+def repair_scan(family_dir: str, verbose: bool = True) -> dict:
+    """Newest-first salvageability report over a checkpoint family.
+
+    Returns ``{"valid": [(tick, path)...], "corrupt": [(tick, path,
+    error)...], "resume_from": (tick, path) | None}`` — ``resume_from``
+    is what ``CheckpointManager.restore_latest`` would pick."""
+    from ringpop_tpu.models.sim import checkpoint as ckpt
+    from ringpop_tpu.models.sim import recovery
+
+    entries = []
+    for entry in sorted(os.listdir(family_dir)):
+        m = recovery._CKPT_RE.match(entry)
+        if m is not None:
+            entries.append((int(m.group(1)), os.path.join(family_dir, entry)))
+    valid, corrupt = [], []
+    for tick, path in reversed(entries):
+        try:
+            ckpt.verify_checkpoint(path, deep=True)
+        except ckpt.CheckpointError as e:
+            corrupt.append((tick, path, "%s: %s" % (type(e).__name__, e)))
+            if verbose:
+                print("corrupt tick=%d %s (%s)" % (tick, path, type(e).__name__))
+            continue
+        valid.append((tick, path))
+        if verbose:
+            print("valid   tick=%d %s" % (tick, path))
+    resume_from = valid[0] if valid else None
+    if verbose:
+        if resume_from:
+            print(
+                "resume_from tick=%d %s (%d valid, %d corrupt)"
+                % (resume_from[0], resume_from[1], len(valid), len(corrupt))
+            )
+        else:
+            print(
+                "resume_from NONE — clean restart (%d corrupt)" % len(corrupt)
+            )
+    return {"valid": valid, "corrupt": corrupt, "resume_from": resume_from}
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("paths", nargs="*", help="checkpoint dirs or roots to scan")
+    p.add_argument(
+        "--repair-scan",
+        metavar="FAMILY_DIR",
+        default=None,
+        help="salvageability report over a ckpt-<tick> family directory",
+    )
+    p.add_argument("-q", "--quiet", action="store_true")
+    args = p.parse_args(argv)
+
+    if args.repair_scan:
+        report = repair_scan(args.repair_scan, verbose=not args.quiet)
+        # a family with corrupt entries still exits 0 when something is
+        # salvageable — that IS the recovery contract; exit 1 only when
+        # checkpoints exist but none survive
+        if report["corrupt"] and not report["valid"]:
+            return 1
+        return 0
+
+    ckpts = find_checkpoints(args.paths or None)
+    if not args.quiet:
+        print("checking %d checkpoint dir(s)" % len(ckpts))
+    problems = check(ckpts, verbose=not args.quiet)
+    for prob in problems:
+        print("PROBLEM %s" % prob)
+    return 1 if problems else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

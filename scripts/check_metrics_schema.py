@@ -50,6 +50,12 @@ ROUTE_TICK_FIELDS = frozenset(
 ROUTE_EVENT_FIELDS = {
     "route_window": ("ring_impl", "n", "q"),
     "route_rebuild_ab": ("n", "incremental_ms", "full_sort_ms"),
+    # recovery-plane lifecycle rows (models/sim/recovery.py, round 13):
+    # every save/corrupt/resume must be attributable to a tick + artifact
+    "ckpt.saved": ("tick", "path", "nbytes", "shards", "wall_s"),
+    "ckpt.corrupt": ("tick", "path", "error"),
+    "ckpt.resumed": ("tick", "path", "skipped_corrupt"),
+    "ckpt_window": ("n", "every", "overhead_frac", "save_mbps_single"),
 }
 
 

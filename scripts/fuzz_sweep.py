@@ -17,6 +17,11 @@ Examples::
     # replay a committed fixture on the current engines
     python scripts/fuzz_sweep.py replay tests/fuzz/fixtures/*.json
 
+    # crash-and-recover gate: preempt checkpointing drivers at seed-drawn
+    # ticks (incl. mid-checkpoint-write torn files), auto-recover, and
+    # require the final state bitwise-equal to the uninterrupted run
+    python scripts/fuzz_sweep.py crash --driver routed --n 64 --seeds 0:16
+
 A sweep exits nonzero when any scenario violates an invariant, printing
 per-seed violation names — feed the failing seed to ``shrink``.
 """
@@ -127,6 +132,57 @@ def cmd_replay(args) -> int:
     return 1 if bad else 0
 
 
+def cmd_crash(args) -> int:
+    import tempfile
+
+    from ringpop_tpu.fuzz import crash
+    from ringpop_tpu.fuzz import scenarios as sc
+
+    cfg = sc.ScenarioConfig(n=args.n, ticks=args.ticks)
+    seeds = _seed_range(args.seeds)
+    workdir = args.workdir or tempfile.mkdtemp(prefix="ringpop-crash-")
+    reports = crash.sweep_crash(
+        seeds,
+        workdir,
+        driver=args.driver,
+        config=cfg,
+        every=args.every,
+        keep=args.keep,
+        shards=args.shards,
+    )
+    n_bad = 0
+    for seed, rep in sorted(reports.items()):
+        status = "ok  " if not rep.violations else "FAIL"
+        n_bad += bool(rep.violations)
+        print(
+            "%s seed=%d kill=%d corrupt=%s resumed=%s skipped=%s"
+            % (
+                status,
+                seed,
+                rep.kill_tick,
+                rep.corrupt,
+                rep.resumed_tick,
+                ",".join(rep.skipped_errors) or "-",
+            )
+        )
+        for v in rep.violations[: args.verbose_violations]:
+            print("  %s: %s" % (v.invariant, v.message))
+    print(
+        "%d/%d crash-resume exercises bit-exact (%s driver, n=%d, T=%d, "
+        "every=%d, shards=%d)"
+        % (
+            len(reports) - n_bad,
+            len(reports),
+            args.driver,
+            cfg.n,
+            cfg.ticks,
+            args.every,
+            args.shards,
+        )
+    )
+    return 1 if n_bad else 0
+
+
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     sub = p.add_subparsers(dest="cmd", required=True)
@@ -153,6 +209,22 @@ def main(argv=None) -> int:
     sp = sub.add_parser("replay", help="replay committed fixtures")
     sp.add_argument("fixtures", nargs="+")
     sp.set_defaults(fn=cmd_replay)
+
+    sp = sub.add_parser(
+        "crash", help="crash-and-recover gate (resume-bitwise invariant)"
+    )
+    sp.add_argument(
+        "--driver", choices=("full", "scalable", "routed"), default="scalable"
+    )
+    sp.add_argument("--n", type=int, default=64)
+    sp.add_argument("--ticks", type=int, default=12)
+    sp.add_argument("--seeds", default="0:8", help="lo:hi or comma list")
+    sp.add_argument("--every", type=int, default=3, help="checkpoint cadence")
+    sp.add_argument("--keep", type=int, default=3, help="keep-last-K rotation")
+    sp.add_argument("--shards", type=int, default=1)
+    sp.add_argument("--workdir", default=None, help="checkpoint family root")
+    sp.add_argument("--verbose-violations", type=int, default=2)
+    sp.set_defaults(fn=cmd_crash)
 
     args = p.parse_args(argv)
     if getattr(args, "n", None) is None and hasattr(args, "engine"):

@@ -18,6 +18,12 @@ if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+# Round-13 note: buffer donation is DISABLED on the CPU backend
+# (storm.donate_state_argnums) — executables deserialized from the
+# persistent compilation cache below mis-execute donation when other
+# dispatches interleave, silently corrupting warm-run trajectories.
+# If donation is ever re-enabled on CPU, the cadence tests in
+# tests/models/test_recovery.py flake within a few runs.
 os.environ["JAX_PLATFORMS"] = "cpu"  # for any subprocesses tests may spawn
 
 # Persistent XLA compilation cache: the tier-1 suite is dominated by

@@ -1,16 +1,33 @@
 """Checkpoint/resume: kill a run mid-storm, resume, bitwise-equal
-trajectory (SURVEY §5.4)."""
+trajectory (SURVEY §5.4); atomic-write + manifest-format integrity
+(round 13: torn files, bit-rot, missing shards each fail with their
+named error — never a silent resume)."""
 
 import functools
+import json
+import os
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 import pytest
 
+from ringpop_tpu.models.sim import checkpoint as ckpt
 from ringpop_tpu.models.sim import engine, engine_scalable as es
 from ringpop_tpu.models.sim.checkpoint import load_state, save_state
 from ringpop_tpu.ops import checksum_encode as ce
+
+
+def _assert_states_equal(a, b):
+    assert type(a) is type(b)
+    for f in a._fields:
+        x, y = getattr(a, f), getattr(b, f)
+        if x is None:
+            assert y is None, f
+            continue
+        x, y = np.asarray(x), np.asarray(y)
+        assert x.dtype == y.dtype, f
+        np.testing.assert_array_equal(x, y, f)
 
 
 def test_scalable_resume_bitwise_equal(tmp_path):
@@ -195,3 +212,297 @@ def test_scalable_perm_and_exchange_knobs_are_trajectory_neutral(tmp_path):
     data[_PARAMS_KEY] = np.array([_json.dumps(saved)])
     np.savez(path, **data)
     load_state(path, es.ScalableState, params)
+
+
+# -- round 13: atomic legacy writes ------------------------------------------
+
+
+def test_save_state_interrupted_never_shadows_good_checkpoint(
+    tmp_path, monkeypatch
+):
+    """The legacy single-file path goes through tmp + fsync + os.replace:
+    a save killed before the rename leaves the PREVIOUS checkpoint
+    intact at the final path (no torn npz shadowing it)."""
+    params = es.ScalableParams(n=8, u=128)
+    good = es.init_state(params, seed=1)
+    path = str(tmp_path / "s.npz")
+    save_state(path, good, params)
+
+    # crash mid-write: the replace never happens
+    def boom(src, dst):
+        raise OSError("killed mid-rename")
+
+    monkeypatch.setattr(ckpt.os, "replace", boom)
+    other = es.init_state(params, seed=2)
+    with pytest.raises(OSError):
+        save_state(path, other, params)
+    monkeypatch.undo()
+
+    back = load_state(path, es.ScalableState, params)
+    _assert_states_equal(good, back)
+    # the leftover tmp file is suffix-tagged, never the final path
+    stray = [f for f in os.listdir(tmp_path) if ".tmp." in f]
+    assert stray, "tmp protocol not used"
+
+
+def test_load_state_named_errors(tmp_path):
+    """Legacy loads fail with the named taxonomy (all ValueError
+    subclasses, so pre-round-13 callers keep working)."""
+    params = es.ScalableParams(n=8, u=128)
+    state = es.init_state(params)
+    path = str(tmp_path / "s.npz")
+    save_state(path, state, params)
+
+    with pytest.raises(ckpt.CheckpointNotFoundError):
+        load_state(str(tmp_path / "absent.npz"), es.ScalableState)
+    with pytest.raises(ckpt.CheckpointFieldError):
+        load_state(path, engine.SimState)
+    with pytest.raises(ckpt.CheckpointParamsError):
+        load_state(
+            path, es.ScalableState, params._replace(suspicion_ticks=99)
+        )
+    # truncated npz -> torn, not a numpy/zlib traceback
+    with open(path, "r+b") as fh:
+        fh.truncate(os.path.getsize(path) // 2)
+    with pytest.raises(ckpt.CheckpointTornError):
+        load_state(path, es.ScalableState)
+
+
+# -- round 13: manifest format ----------------------------------------------
+
+
+def _make_state(n=24, u=160, seed=3, ticks=6):
+    import jax as _jax
+
+    params = es.ScalableParams(n=n, u=u, suspicion_ticks=4)
+    state = es.init_state(params, seed=seed)
+    step = _jax.jit(functools.partial(es.tick, params=params))
+    rng = np.random.default_rng(0)
+    for t in range(ticks):
+        kill = np.zeros(n, bool)
+        kill[rng.integers(0, n, 2)] = t % 2 == 0
+        state, _ = step(
+            state, es.ChurnInputs(kill=jnp.asarray(kill), revive=jnp.zeros(n, bool))
+        )
+    return params, state
+
+
+def test_manifest_roundtrip_single_and_sharded(tmp_path):
+    params, state = _make_state()
+    p1, p3 = str(tmp_path / "ck1"), str(tmp_path / "ck3")
+    m1 = ckpt.save_checkpoint(p1, state, params)
+    m3 = ckpt.save_checkpoint(
+        p3, state, params, shards=3, sharded_fields=es.NODE_SHARDED_FIELDS
+    )
+    assert m1["shards"] == 1 and m3["shards"] == 3
+    s1 = ckpt.load_checkpoint(p1, es.ScalableState, params)
+    s3 = ckpt.load_checkpoint(p3, es.ScalableState, params)
+    _assert_states_equal(state, s1)
+    # ACCEPTANCE: sharded save -> restore bitwise-identical to the
+    # single-file path
+    _assert_states_equal(s1, s3)
+    # and a re-save at a DIFFERENT shard count still restores bitwise
+    p5 = str(tmp_path / "ck5")
+    ckpt.save_checkpoint(
+        p5, s3, params, shards=5, sharded_fields=es.NODE_SHARDED_FIELDS
+    )
+    _assert_states_equal(s1, ckpt.load_checkpoint(p5, es.ScalableState, params))
+    ckpt.verify_checkpoint(p5, deep=True)
+
+
+def test_manifest_multi_state_roundtrip(tmp_path):
+    """Named multi-state checkpoints (the RoutedStorm layout)."""
+    from ringpop_tpu.models.route.plane import RouteCarry
+
+    params, state = _make_state(n=16)
+    carry = RouteCarry(
+        mask=jnp.asarray(np.arange(16) % 3 != 0),
+        rng=jnp.asarray(np.asarray([7, 9], np.uint32)),
+    )
+    path = str(tmp_path / "ck")
+    ckpt.save_checkpoint(
+        path,
+        {"sim": state, "route": carry},
+        {"sim": params, "route": None},
+        shards=2,
+        sharded_fields={
+            "sim": es.NODE_SHARDED_FIELDS,
+            "route": frozenset({"mask"}),
+        },
+    )
+    out = ckpt.load_checkpoint(
+        path,
+        {"sim": es.ScalableState, "route": RouteCarry},
+        {"sim": params, "route": None},
+    )
+    _assert_states_equal(state, out["sim"])
+    _assert_states_equal(carry, out["route"])
+    # requesting a state name the checkpoint does not hold is a named error
+    with pytest.raises(ckpt.CheckpointFieldError):
+        ckpt.load_checkpoint(path, {"nope": es.ScalableState})
+
+
+def _saved(tmp_path, shards=2):
+    params, state = _make_state(n=16)
+    path = str(tmp_path / "ck")
+    ckpt.save_checkpoint(
+        path,
+        state,
+        params,
+        shards=shards,
+        sharded_fields=es.NODE_SHARDED_FIELDS if shards > 1 else None,
+    )
+    return params, state, path
+
+
+def test_corruption_truncated_array_file_is_torn(tmp_path):
+    params, _, path = _saved(tmp_path)
+    target = os.path.join(path, "shard-00001-of-00002.npz")
+    with open(target, "r+b") as fh:
+        fh.truncate(os.path.getsize(target) // 3)
+    with pytest.raises(ckpt.CheckpointTornError):
+        ckpt.load_checkpoint(path, es.ScalableState, params)
+    with pytest.raises(ckpt.CheckpointTornError):
+        ckpt.verify_checkpoint(path, deep=False)  # size check alone catches it
+
+
+def test_corruption_flipped_byte_is_digest_mismatch(tmp_path):
+    params, _, path = _saved(tmp_path)
+    target = os.path.join(path, "common.npz")
+    size = os.path.getsize(target)
+    with open(target, "r+b") as fh:
+        fh.seek(size // 2)
+        b = fh.read(1)
+        fh.seek(size // 2)
+        fh.write(bytes([b[0] ^ 0xFF]))
+    assert os.path.getsize(target) == size  # same length: digest, not torn
+    with pytest.raises(ckpt.CheckpointDigestError):
+        ckpt.load_checkpoint(path, es.ScalableState, params)
+    with pytest.raises(ckpt.CheckpointDigestError):
+        ckpt.verify_checkpoint(path, deep=True)
+    # the shallow probe (sizes only) cannot see bit-rot — documented
+    ckpt.verify_checkpoint(path, deep=False)
+
+
+def test_corruption_missing_shard_is_shard_error(tmp_path):
+    params, _, path = _saved(tmp_path)
+    os.remove(os.path.join(path, "shard-00000-of-00002.npz"))
+    with pytest.raises(ckpt.CheckpointShardError):
+        ckpt.load_checkpoint(path, es.ScalableState, params)
+
+
+def test_corruption_torn_manifest_and_missing_manifest(tmp_path):
+    params, _, path = _saved(tmp_path)
+    mpath = os.path.join(path, ckpt.MANIFEST_NAME)
+    with open(mpath, "r+b") as fh:
+        fh.truncate(os.path.getsize(mpath) // 2)
+    with pytest.raises(ckpt.CheckpointTornError):
+        ckpt.load_checkpoint(path, es.ScalableState, params)
+    os.remove(mpath)
+    with pytest.raises(ckpt.CheckpointNotFoundError):
+        ckpt.load_checkpoint(path, es.ScalableState, params)
+    with pytest.raises(ckpt.CheckpointNotFoundError):
+        ckpt.load_checkpoint(str(tmp_path / "never"), es.ScalableState)
+
+
+def _edit_manifest(path, fn):
+    mpath = os.path.join(path, ckpt.MANIFEST_NAME)
+    with open(mpath, encoding="utf-8") as fh:
+        doc = json.load(fh)
+    fn(doc)
+    with open(mpath, "w", encoding="utf-8") as fh:
+        json.dump(doc, fh)
+
+
+def test_version_and_field_mismatch_matrix(tmp_path):
+    """The version/field-mismatch matrix: every drift axis has a named
+    error and none of them resumes silently."""
+    params, _, path = _saved(tmp_path)
+
+    # manifest format version drift
+    _edit_manifest(path, lambda d: d.update(version=99))
+    with pytest.raises(ckpt.CheckpointVersionError):
+        ckpt.load_checkpoint(path, es.ScalableState, params)
+    _edit_manifest(path, lambda d: d.update(version=ckpt.MANIFEST_VERSION))
+
+    # engine state-format version drift (incarnation representation)
+    _edit_manifest(path, lambda d: d.update(engine_version=1))
+    with pytest.raises(ckpt.CheckpointVersionError):
+        ckpt.load_checkpoint(path, es.ScalableState, params)
+    _edit_manifest(
+        path, lambda d: d.update(engine_version=ckpt._FORMAT_VERSION)
+    )
+
+    # wrong state class
+    with pytest.raises(ckpt.CheckpointFieldError):
+        ckpt.load_checkpoint(path, engine.SimState, None)
+
+    # params drift (protocol constant changed between save and resume)
+    with pytest.raises(ckpt.CheckpointParamsError):
+        ckpt.load_checkpoint(
+            path, es.ScalableState, params._replace(piggyback_factor=1)
+        )
+    # ... but trajectory-neutral knobs may differ freely
+    ckpt.load_checkpoint(
+        path,
+        es.ScalableState,
+        params._replace(gate_phases=False, perm_impl="argsort"),
+    )
+
+    # field-set drift: a field this build does not know
+    def add_field(d):
+        d["states"]["state"]["fields"]["not_a_field"] = {
+            "dtype": "int32",
+            "shape": [1],
+            "where": "common",
+            "crc32": 0,
+        }
+
+    _edit_manifest(path, add_field)
+    with pytest.raises(ckpt.CheckpointFieldError):
+        ckpt.load_checkpoint(path, es.ScalableState, params)
+
+
+def test_shard_count_vs_file_list_drift(tmp_path):
+    params, _, path = _saved(tmp_path)
+
+    def drop_listed_shard(d):
+        d["shard_files"] = d["shard_files"][:1]
+
+    _edit_manifest(path, drop_listed_shard)
+    with pytest.raises(ckpt.CheckpointShardError):
+        ckpt.load_checkpoint(path, es.ScalableState, params)
+
+
+def test_manifest_defame_by_default_like_legacy(tmp_path):
+    """The manifest loader honors the same derived-default table as the
+    legacy path (pre-round-4 artifacts lacking defame_by)."""
+    params, state, path = _saved(tmp_path, shards=1)
+
+    def strip(d):
+        d["states"]["state"]["fields"]["defame_by"] = None
+
+    _edit_manifest(path, strip)
+    # also remove the array from the common file so available lacks it
+    import numpy as _np
+
+    common = os.path.join(path, "common.npz")
+    data = dict(_np.load(common))
+    data.pop("state.defame_by")
+    bio_arrays = {k: v for k, v in data.items()}
+    ckpt.atomic_write_bytes(common, ckpt._npz_bytes(bio_arrays))
+    # size/crc changed -> patch the manifest file entry to keep integrity
+    with open(common, "rb") as fh:
+        buf = fh.read()
+
+    def fix_files(d):
+        d["files"]["common.npz"] = {
+            "nbytes": len(buf),
+            "crc32": ckpt._crc(buf),
+        }
+
+    _edit_manifest(path, fix_files)
+    loaded = ckpt.load_checkpoint(path, es.ScalableState, params)
+    np.testing.assert_array_equal(
+        np.asarray(loaded.defame_by), np.arange(16)
+    )

@@ -1,0 +1,92 @@
+"""Checkpoint-manifest CI gate (the tier-1 twin of
+scripts/check_ckpt_manifest.py): every committed manifest-format
+checkpoint must deep-verify, the committed sample keeps the format
+readable, and --repair-scan reports the recovery order."""
+
+from __future__ import annotations
+
+import importlib.util
+import os
+
+import numpy as np
+
+from ringpop_tpu.models.sim import checkpoint as ckpt
+from ringpop_tpu.models.sim import engine_scalable as es
+
+REPO_ROOT = os.path.dirname(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+SAMPLE = os.path.join(REPO_ROOT, "runlogs", "sample_ckpt_scalable_n8")
+
+
+def _load_checker():
+    spec = importlib.util.spec_from_file_location(
+        "check_ckpt_manifest",
+        os.path.join(REPO_ROOT, "scripts", "check_ckpt_manifest.py"),
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_every_repo_checkpoint_validates():
+    checker = _load_checker()
+    ckpts = checker.find_checkpoints()
+    # the sample artifact is committed, so the gate is never vacuous —
+    # AND it pins the on-disk format: a format change that can no longer
+    # read old checkpoints fails here, not in a user's recovery path
+    assert SAMPLE in ckpts, "committed sample checkpoint missing"
+    problems = checker.check(ckpts, verbose=False)
+    assert problems == [], "\n".join(problems)
+
+
+def test_committed_sample_still_loads():
+    state = ckpt.load_checkpoint(SAMPLE, es.ScalableState)
+    assert np.asarray(state.proc_alive).shape == (8,)
+    manifest = ckpt.read_manifest(SAMPLE)
+    assert manifest["shards"] == 2
+    assert manifest["meta"]["tick"] == 6
+
+
+def test_checker_names_a_bad_checkpoint(tmp_path):
+    checker = _load_checker()
+    params = es.ScalableParams(n=8, u=128)
+    path = str(tmp_path / "ck")
+    ckpt.save_checkpoint(path, es.init_state(params, seed=0), params)
+    assert checker.check([path], verbose=False) == []
+    # bit-rot it: the checker must name the digest failure
+    target = os.path.join(path, "common.npz")
+    size = os.path.getsize(target)
+    with open(target, "r+b") as fh:
+        fh.seek(size // 2)
+        b = fh.read(1)
+        fh.seek(size // 2)
+        fh.write(bytes([b[0] ^ 0xFF]))
+    problems = checker.check([path], verbose=False)
+    assert len(problems) == 1 and "CheckpointDigestError" in problems[0]
+
+
+def test_repair_scan_reports_recovery_order(tmp_path):
+    checker = _load_checker()
+    params = es.ScalableParams(n=8, u=128)
+    fam = str(tmp_path / "fam")
+    os.makedirs(fam)
+    state = es.init_state(params, seed=0)
+    for t in (2, 4, 6):
+        ckpt.save_checkpoint(
+            os.path.join(fam, "ckpt-%010d" % t), state, params, meta={"tick": t}
+        )
+    # torn newest
+    mpath = os.path.join(fam, "ckpt-%010d" % 6, ckpt.MANIFEST_NAME)
+    with open(mpath, "r+b") as fh:
+        fh.truncate(os.path.getsize(mpath) // 2)
+    report = checker.repair_scan(fam, verbose=False)
+    assert [t for t, _ in report["valid"]] == [4, 2]  # newest-first
+    assert [t for t, _, _ in report["corrupt"]] == [6]
+    assert report["resume_from"][0] == 4
+    # CLI contract: salvageable family exits 0, hopeless family exits 1
+    assert checker.main(["--repair-scan", fam, "-q"]) == 0
+    for t in (2, 4):
+        mp = os.path.join(fam, "ckpt-%010d" % t, ckpt.MANIFEST_NAME)
+        os.remove(mp)
+    assert checker.main(["--repair-scan", fam, "-q"]) == 1

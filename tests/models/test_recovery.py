@@ -1,0 +1,238 @@
+"""Recovery plane: cadence, keep-last-K rotation, newest-valid fallback,
+obs events + statsd counters (models/sim/recovery.py, round 13)."""
+
+import os
+
+import numpy as np
+import pytest
+
+from ringpop_tpu.models.sim import checkpoint as ckpt
+from ringpop_tpu.models.sim import engine_scalable as es
+from ringpop_tpu.models.sim.recovery import (
+    CheckpointManager,
+    CheckpointSpec,
+    checkpoint_name,
+)
+from ringpop_tpu.models.sim.storm import ScalableCluster, StormSchedule
+
+N, U = 24, 160
+
+
+def _params():
+    return es.ScalableParams(n=N, u=U, suspicion_ticks=4)
+
+
+def _cluster(seed=5):
+    return ScalableCluster(n=N, params=_params(), seed=seed)
+
+
+def _sched(ticks=10, seed=1):
+    return StormSchedule.churn_storm(ticks, N, fraction=0.2, seed=seed)
+
+
+def _flip_byte(path):
+    """Bit-rot one array file of a checkpoint dir (size-preserving)."""
+    target = os.path.join(path, "common.npz")
+    size = os.path.getsize(target)
+    with open(target, "r+b") as fh:
+        fh.seek(size // 2)
+        b = fh.read(1)
+        fh.seek(size // 2)
+        fh.write(bytes([b[0] ^ 0xFF]))
+
+
+class FakeStatsd:
+    def __init__(self):
+        self.counts = {}
+
+    def increment(self, key, value=1):
+        self.counts[key] = self.counts.get(key, 0) + value
+
+    def gauge(self, key, value):
+        pass
+
+
+class FakeRecorder:
+    def __init__(self):
+        self.events = []
+
+    def record_event(self, name, **fields):
+        self.events.append((name, fields))
+
+
+def _manager(tmp_path, **kw):
+    c = _cluster()
+    return (
+        CheckpointManager(
+            str(tmp_path / "fam"),
+            CheckpointSpec(es.ScalableState, c.params, es.NODE_SHARDED_FIELDS),
+            **kw,
+        ),
+        c,
+    )
+
+
+def test_rotation_keeps_last_k(tmp_path):
+    mgr, c = _manager(tmp_path, keep=2)
+    for t in (2, 4, 6, 8):
+        mgr.save(t, c.state)
+    assert [t for t, _ in mgr.list_checkpoints()] == [6, 8]
+
+
+def test_gc_never_evicts_the_valid_fallback(tmp_path):
+    """A corrupt newest checkpoint must not count toward keep: with
+    keep=1 and a torn newest, GC keeps the older valid one (deleting it
+    would leave recovery with nothing)."""
+    mgr, c = _manager(tmp_path, keep=1)
+    # lay both checkpoints down WITHOUT intermediate gc (save() gc's and
+    # would evict tick 3 while tick 6 is still pristine)
+    ckpt.save_checkpoint(
+        mgr.path_of(3), c.state, c.params, meta={"tick": 3}
+    )
+    p6 = mgr.path_of(6)
+    ckpt.save_checkpoint(p6, c.state, c.params, meta={"tick": 6})
+    # the mid-write kill: torn manifest at the newest (shallow-visible)
+    mpath = os.path.join(p6, ckpt.MANIFEST_NAME)
+    with open(mpath, "r+b") as fh:
+        fh.truncate(os.path.getsize(mpath) // 2)
+    removed = mgr.gc()
+    assert removed == []  # tick 3 is the keep=1 survivor, not tick 6
+    assert [t for t, _ in mgr.list_checkpoints()] == [3, 6]
+    got = mgr.restore_latest()
+    assert got is not None and got[0] == 3
+    assert [type(e).__name__ for _, _, e in mgr.last_errors] == [
+        "CheckpointTornError"
+    ]
+
+
+def test_restore_falls_back_past_torn_then_resumes(tmp_path):
+    mgr, c = _manager(tmp_path, keep=3)
+    rec = FakeRecorder()
+    statsd = FakeStatsd()
+    mgr.recorder = rec
+    mgr.statsd = statsd
+    mgr.save(3, c.state)
+    mgr.save(6, c.state)
+    p9 = mgr.save(9, c.state)
+    # torn newest: truncate its manifest (kill mid-write)
+    mpath = os.path.join(p9, ckpt.MANIFEST_NAME)
+    with open(mpath, "r+b") as fh:
+        fh.truncate(os.path.getsize(mpath) // 2)
+    got = mgr.restore_latest()
+    assert got is not None
+    tick, state = got
+    assert tick == 6
+    names = [e[0] for e in rec.events]
+    assert "ckpt.corrupt" in names and "ckpt.resumed" in names
+    corrupt = [f for n, f in rec.events if n == "ckpt.corrupt"][0]
+    assert corrupt["error"] == "CheckpointTornError"
+    resumed = [f for n, f in rec.events if n == "ckpt.resumed"][0]
+    assert resumed["tick"] == 6 and resumed["skipped_corrupt"] == 1
+    assert statsd.counts["sim.ckpt.corrupt"] == 1
+    assert statsd.counts["sim.ckpt.resumed"] == 1
+    # nothing valid at all -> None (clean restart), each corrupt named
+    for _, p in mgr.list_checkpoints():
+        _flip_byte(p)
+    mpath9 = os.path.join(p9, ckpt.MANIFEST_NAME)
+    assert mgr.restore_latest() is None
+    assert len(mgr.last_errors) == len(mgr.list_checkpoints())
+
+
+def test_save_emits_saved_event_and_counter(tmp_path):
+    mgr, c = _manager(tmp_path, keep=3, shards=2)
+    rec, statsd = FakeRecorder(), FakeStatsd()
+    mgr.recorder = rec
+    mgr.statsd = statsd
+    path = mgr.save(4, c.state)
+    assert os.path.basename(path) == checkpoint_name(4)
+    name, fields = rec.events[0]
+    assert name == "ckpt.saved"
+    assert fields["tick"] == 4 and fields["shards"] == 2
+    assert fields["nbytes"] > 0 and fields["wall_s"] >= 0
+    assert statsd.counts["sim.ckpt.saved"] == 1
+
+
+def test_cadenced_run_is_bitwise_neutral(tmp_path):
+    """run() under a checkpoint cadence (scan split at cadence lines)
+    must be bitwise-identical — state AND stacked metrics — to the
+    unchunked scan, and leave checkpoints exactly on the grid."""
+    plain = _cluster()
+    m_plain = plain.run(_sched(10))
+    # snapshot with copies BEFORE the twin's donating dispatches run —
+    # comparing live device states across donating dispatches is the
+    # documented aliasing hazard (test_scalable_partition's device_get
+    # note); the crash harness snapshots the same way
+    want = {
+        f: np.array(getattr(plain.state, f), copy=True)
+        for f in es.ScalableState._fields
+        if getattr(plain.state, f) is not None
+    }
+
+    ck = _cluster()
+    ck.enable_checkpoints(str(tmp_path / "fam"), every=4, keep=3)
+    m_ck = ck.run(_sched(10))
+
+    for f in es.ScalableState._fields:
+        b = getattr(ck.state, f)
+        if f not in want:
+            assert b is None, f
+            continue
+        np.testing.assert_array_equal(want[f], np.asarray(b), f)
+    for f in m_plain._fields:
+        np.testing.assert_array_equal(
+            np.asarray(getattr(m_plain, f)), np.asarray(getattr(m_ck, f)), f
+        )
+    assert [t for t, _ in ck.checkpoint_manager.list_checkpoints()] == [4, 8]
+    assert ck.tick_count == 10
+
+
+def test_step_cadence_and_restore_roundtrip(tmp_path):
+    c = _cluster()
+    c.enable_checkpoints(str(tmp_path / "fam"), every=2, keep=2)
+    for _ in range(5):
+        c.step()
+    assert [t for t, _ in c.checkpoint_manager.list_checkpoints()] == [2, 4]
+
+    # a fresh driver resumes from the newest checkpoint and continues
+    # bitwise: drive the original to tick 7, the resumed from 4 -> 7
+    d = _cluster()
+    d.enable_checkpoints(str(tmp_path / "fam"))
+    assert d.restore_latest() == 4
+    assert d.tick_count == 4
+    # original state at tick 4 was checkpointed; re-drive both 3 quiet
+    # ticks from their respective positions: c is at 5, so step c twice
+    # and d thrice to land both at tick 7
+    for _ in range(2):
+        c.step()
+    # snapshot c BEFORE d's donating dispatches (aliasing hazard)
+    want = {
+        f: np.array(getattr(c.state, f), copy=True)
+        for f in es.ScalableState._fields
+        if getattr(c.state, f) is not None
+    }
+    for _ in range(3):
+        d.step()
+    for f, a in want.items():
+        np.testing.assert_array_equal(a, np.asarray(getattr(d.state, f)), f)
+
+
+def test_restore_without_enable_raises(tmp_path):
+    c = _cluster()
+    with pytest.raises(ValueError):
+        c.restore_latest()
+    with pytest.raises(ValueError):
+        c.checkpoint_now()
+
+
+def test_tmp_leftovers_are_ignored_by_the_scan(tmp_path):
+    """A kill between tmp-write and rename leaves *.tmp.<pid> files; the
+    inventory and the recovery scan must skip them."""
+    mgr, c = _manager(tmp_path, keep=3)
+    p = mgr.save(5, c.state)
+    open(os.path.join(p, "common.npz.tmp.12345"), "wb").write(b"partial")
+    open(
+        os.path.join(mgr.directory, "ckpt-0000000007.tmp"), "w"
+    ).write("not a checkpoint dir")
+    assert [t for t, _ in mgr.list_checkpoints()] == [5]
+    got = mgr.restore_latest()
+    assert got is not None and got[0] == 5
