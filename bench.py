@@ -351,6 +351,147 @@ def _exchange_gbps(heard, r_delta) -> tuple:
     return gbps, impl
 
 
+def _mesh_rate(
+    n_per_shard: int, ticks: int, gate_n: int, recorder=None
+) -> dict:
+    """Round-14 mesh phase: weak-scaling of the shard_map'd exchange
+    plane over the available devices (forced host CPUs now, chips on
+    the next tunnel session), plus THE bitwise invariance gate.
+
+    Weak scaling: a shard ladder (1/2/4/.. up to the device count) runs
+    the same churn-storm shape at ``n_per_shard`` nodes PER SHARD;
+    ``mesh_weak_scaling_efficiency`` = rate(S) / (S * rate(1)) at the
+    top rung.  Gate: a FIXED ``gate_n`` seeded storm must produce
+    bitwise-identical final states across every shard count, the
+    single-device engine, and the partitionable GSPMD XLA twin (the
+    fallback gate) — asserted here, not just recorded.  Each rung lands
+    a ``mesh_window`` runlog event and the summary a ``weak_scaling``
+    event (scripts/check_metrics_schema.py validates both)."""
+    import jax
+
+    from ringpop_tpu.models.sim import engine_scalable as es
+    from ringpop_tpu.models.sim.storm import ScalableCluster, StormSchedule
+    from ringpop_tpu.ops import exchange as exch
+    from ringpop_tpu.parallel import mesh as pmesh
+
+    devs = len(jax.devices())
+    ladder = [s for s in (1, 2, 4, 8, 16, 32) if s <= devs]
+    out: dict = {
+        "mesh_devices": devs,
+        "mesh_shards_ladder": ladder,
+        "mesh_n_per_shard": n_per_shard,
+        "mesh_ticks": ticks,
+    }
+    rates = {}
+    res_note = None
+    for s in ladder:
+        n = n_per_shard * s
+        storm = pmesh.ShardedStorm(
+            n=n,
+            mesh=pmesh.make_mesh(s),
+            params=es.ScalableParams(n=n),
+            seed=0,
+        )
+        res_note = storm.exchange_resolution()
+        sched = StormSchedule.churn_storm(
+            ticks, n, fraction=0.10, fail_tick=1, seed=0
+        )
+        storm.run(sched)  # compile + warm (donated state: overwritten)
+        jax.block_until_ready(storm.state)
+        t0 = time.perf_counter()
+        with _profile_ctx("mesh-%d" % s, recorder=recorder):
+            storm.run(sched)
+            jax.block_until_ready(storm.state)
+        elapsed = time.perf_counter() - t0
+        rates[s] = n * ticks / elapsed
+        if recorder is not None:
+            recorder.record_event(
+                "mesh_window",
+                n=n,
+                shards=s,
+                ticks=ticks,
+                exchange_mode=storm.exchange_mode,
+                exchange_impl=storm.exchange_impl,
+                exchange_cap=storm.exchange_cap,
+                node_ticks_per_sec=round(rates[s], 1),
+            )
+    top = ladder[-1]
+    out["mesh_node_ticks_per_sec"] = {
+        str(s): round(r, 1) for s, r in rates.items()
+    }
+    out["mesh_weak_scaling_efficiency"] = round(
+        rates[top] / (top * rates[1]), 3
+    )
+    out["mesh_exchange_mode"] = res_note["mode"]
+    out["mesh_exchange_impl"] = res_note["impl"]
+    # the shared cross-shard traffic model at the top rung (modeled
+    # interconnect vs shard-local bytes per tick — the roofline rows)
+    w = es.ScalableParams(n=n_per_shard * top).u // 32
+    out["mesh_traffic_model"] = exch.cross_shard_traffic_bytes(
+        n_per_shard * top, w, top
+    )
+
+    # ---- the bitwise invariance gate at the overlap size -------------
+    gate_sched = lambda: StormSchedule.churn_storm(  # noqa: E731
+        ticks, gate_n, fraction=0.10, fail_tick=1, seed=3
+    )
+    single = ScalableCluster(
+        n=gate_n, params=es.ScalableParams(n=gate_n), seed=3
+    )
+    single.run(gate_sched())
+    ref = {
+        f: np.asarray(getattr(single.state, f))
+        for f in ("heard", "checksum", "truth_status", "base_sum")
+    }
+
+    def _gate_one(storm):
+        storm.run(gate_sched())
+        return all(
+            (np.asarray(getattr(storm.state, f)) == ref[f]).all()
+            for f in ref
+        )
+
+    gate_ok = True
+    for s in ladder:
+        gate_ok &= _gate_one(
+            pmesh.ShardedStorm(
+                n=gate_n,
+                mesh=pmesh.make_mesh(s),
+                params=es.ScalableParams(n=gate_n),
+                seed=3,
+            )
+        )
+    # the partitionable XLA twin under GSPMD — the fallback gate
+    gate_ok &= _gate_one(
+        pmesh.ShardedStorm(
+            n=gate_n,
+            mesh=pmesh.make_mesh(top),
+            params=es.ScalableParams(n=gate_n, fused_exchange="xla"),
+            seed=3,
+        )
+    )
+    out["mesh_gate_n"] = gate_n
+    out["mesh_bitwise_equal"] = bool(gate_ok)
+    assert gate_ok, (
+        "mesh phase: sharded trajectory diverged from the single-device "
+        "engine at n=%d" % gate_n
+    )
+    if recorder is not None:
+        recorder.record_event(
+            "weak_scaling",
+            n_per_shard=n_per_shard,
+            shards=top,
+            ticks=ticks,
+            node_ticks_per_sec=round(rates[top], 1),
+            efficiency=out["mesh_weak_scaling_efficiency"],
+            bitwise_equal=bool(gate_ok),
+        )
+        recorder.record_event(
+            "mesh_exchange_resolution", **res_note
+        )
+    return out
+
+
 def _ckpt_rate(n: int, ticks: int, every: int, recorder=None) -> dict:
     """Round-13 recovery-plane numbers at the storm shape: (a) per-tick
     overhead of a ``checkpoint_every`` cadence vs the same storm
@@ -854,6 +995,31 @@ def _measure_recorded(n: int, ticks: int, platform: str, recorder) -> dict:
                 str(exc)[:300],
             )
 
+    # mesh phase (BENCH_MESH=0 opts out): the round-14 shard_map'd
+    # exchange plane — weak-scaling ladder over the available devices
+    # (BENCH_MESH_FORCE_HOST=<k> pins k virtual CPU devices BEFORE
+    # backend init, through utils.util.pin_cpu_platform) with the
+    # shard-count bitwise invariance gate ASSERTED, mesh_window /
+    # weak_scaling runlog events, and the shared cross-shard traffic
+    # model (ops.exchange.cross_shard_traffic_bytes).
+    if os.environ.get("BENCH_MESH", "1") == "1":
+        try:
+            mps = int(os.environ.get("BENCH_MESH_N_PER_SHARD", "8192"))
+            mticks = int(os.environ.get("BENCH_MESH_TICKS", "8"))
+            mgate = int(os.environ.get("BENCH_MESH_GATE_N", "1024"))
+            result.update(
+                _retry_helper_500(
+                    _mesh_rate, mps, mticks, mgate, recorder=recorder
+                )
+            )
+        except Exception as exc:
+            if _is_transient(exc):
+                raise
+            result["mesh_error"] = "%s: %s" % (
+                type(exc).__name__,
+                str(exc)[:300],
+            )
+
     # checkpoint phase (BENCH_CKPT=0 opts out): the round-13 recovery
     # plane at the storm shape — checkpoint-cadence per-tick overhead vs
     # the un-checkpointed storm (bitwise-gated), and save/restore MB/s
@@ -1139,6 +1305,19 @@ def main() -> int:
     from ringpop_tpu.utils.util import scrub_repo_pythonpath
 
     scrub_repo_pythonpath(os.path.dirname(os.path.abspath(__file__)))
+
+    # BENCH_MESH_FORCE_HOST=<k>: pin k virtual CPU devices for the mesh
+    # phase's weak-scaling ladder BEFORE any backend init (the one
+    # routed place — utils.util.pin_cpu_platform; XLA reads the count at
+    # first client creation, so this cannot move later).  Implies an
+    # intentional CPU run: the forced-host artifact must not be mistaken
+    # for a tunnel fallback nor burn the TPU re-exec budget.
+    mesh_force = os.environ.get("BENCH_MESH_FORCE_HOST")
+    if mesh_force:
+        from ringpop_tpu.utils.util import pin_cpu_platform
+
+        pin_cpu_platform(int(mesh_force))
+        os.environ.setdefault("BENCH_ALLOW_CPU", "1")
 
     n = int(os.environ.get("BENCH_N", "1024"))
     # 256-tick measurement window (was 32): the tunneled chip pays a

@@ -16,9 +16,17 @@ once and capture every chip-gated number in a single session —
   G. round-10 fused exchange + sortless permutations: 1M storm A/B
      (sortless+pallas / sortless+xla / argsort+inline) with a bitwise
      final-state gate, plus the exchange op's isolated GB/s
+  H. round-14 weak scaling: the shard_map'd exchange plane at 1M nodes
+     PER CHIP over the available device mesh — per-rung node-ticks/s +
+     weak-scaling efficiency, the <60 s 1M-storm check on a single
+     chip, and a bitwise overlap gate (the same 1M storm sharded vs
+     single-device).  CPU fallback runs a small marked ladder on
+     forced host devices (utils.util.pin_cpu_platform is the one
+     routed place for that flag) so the phase is rehearsable on
+     tunnel-less images.
 
 Each phase is independently guarded; results stream as JSON lines and the
-combined dict lands in RESULTS_TPU_r04.json (TPU_MEASURE_OUT to override).
+combined dict lands in RESULTS_TPU_r06.json (TPU_MEASURE_OUT to override).
 The tunnel is intermittently
 held by another client, so backend init retries with backoff first.
 """
@@ -33,7 +41,7 @@ import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-OUT_PATH = os.environ.get("TPU_MEASURE_OUT", "RESULTS_TPU_r05.json")
+OUT_PATH = os.environ.get("TPU_MEASURE_OUT", "RESULTS_TPU_r06.json")
 RETRIES = int(os.environ.get("TPU_MEASURE_RETRIES", "90"))
 SLEEP_S = float(os.environ.get("TPU_MEASURE_SLEEP_S", "20"))
 
@@ -619,6 +627,175 @@ def phase_fused_exchange(results: dict) -> None:
     storm_mod.clear_executable_cache()
 
 
+def phase_weak_scaling(results: dict) -> None:
+    """Round-14 weak scaling: 1M nodes per chip through the shard_map'd
+    exchange plane (ROADMAP item 2's capture path).  Three deliverables:
+
+    - a shard ladder at ``n = 1M * S`` (S up to the device count) with
+      warm node-ticks/s per rung and the weak-scaling efficiency
+      ``rate(S) / (S * rate(1))``;
+    - the single-chip <60 s check: the 60-tick 1M churn storm through
+      the PLANE (north-star row 4 — RESULTS.md round 3 measured 486 s
+      warm on CPU; the chip number decides it);
+    - the bitwise overlap gate: the SAME 1M seeded storm, sharded over
+      every device vs the single-device engine — final heard/checksum/
+      truth must match bit-for-bit (the CPU tests prove n<=64k; this is
+      the on-chip proof at the real shape).
+
+    On a CPU fallback (no tunnel) the ladder shrinks to a marked
+    rehearsal shape so the phase stays runnable end-to-end."""
+    import jax
+    import numpy as np
+
+    from ringpop_tpu.models.sim import engine_scalable as es
+    from ringpop_tpu.models.sim import storm as storm_mod
+    from ringpop_tpu.models.sim.storm import ScalableCluster, StormSchedule
+    from ringpop_tpu.ops import exchange as exch
+    from ringpop_tpu.parallel import mesh as pmesh
+
+    on_tpu = jax.default_backend() == "tpu"
+    n_per = int(
+        os.environ.get(
+            "TPU_MEASURE_WEAK_N_PER_SHARD",
+            "1000000" if on_tpu else "8192",
+        )
+    )
+    ticks = int(os.environ.get("TPU_MEASURE_WEAK_TICKS", "60"))
+    devs = len(jax.devices())
+    ladder = [s for s in (1, 2, 4, 8, 16, 32) if s <= devs]
+    rates: dict = {}
+    for s in ladder:
+        key = "weak_scaling_%dx%d" % (s, n_per)
+        if not _todo(results, key):
+            prev = results[key]
+            if isinstance(prev, dict) and "node_ticks_per_sec" in prev:
+                rates[s] = prev["node_ticks_per_sec"]
+            continue
+        try:
+            n = n_per * s
+            params = es.ScalableParams(n=n, u=512)
+            sched = StormSchedule.churn_storm(
+                ticks, n, fraction=0.10, fail_tick=2, seed=0
+            )
+            storm = pmesh.ShardedStorm(
+                n=n, mesh=pmesh.make_mesh(s), params=params, seed=0
+            )
+            t0 = time.perf_counter()
+            storm.run(sched)
+            jax.block_until_ready(storm.state)
+            cold = time.perf_counter() - t0
+            # warm wall-clock: min of 2, distinct seeds (the tunnel
+            # memoizes identical (executable, inputs) pairs)
+            warms = []
+            for r in range(2):
+                s2 = pmesh.ShardedStorm(
+                    n=n, mesh=pmesh.make_mesh(s), params=params, seed=r + 1
+                )
+                t0 = time.perf_counter()
+                s2.run(sched)
+                jax.block_until_ready(s2.state)
+                warms.append(time.perf_counter() - t0)
+            rate = n * ticks / min(warms)
+            rates[s] = round(rate, 1)
+            results[key] = {
+                "n": n,
+                "shards": s,
+                "ticks": ticks,
+                "cold_s": round(cold, 2),
+                "warm_s": round(min(warms), 2),
+                "warm_runs_s": [round(w2, 2) for w2 in warms],
+                "node_ticks_per_sec": rates[s],
+                "exchange_mode": storm.exchange_mode,
+                "exchange_impl": storm.exchange_impl,
+                "exchange_cap": storm.exchange_cap,
+                "cpu_rehearsal": not on_tpu,  # NOT a chip number
+            }
+            if s == 1 and n_per == 1_000_000:
+                # the north-star check rides the single-chip rung
+                results[key]["under_60s"] = bool(min(warms) < 60.0)
+        except Exception as e:
+            results[key] = {"error": str(e)[:300]}
+        print(json.dumps({key: results.get(key)}), flush=True)
+
+    # 1 must be present: a failed first rung (e.g. a transient tunnel
+    # error) must not KeyError the summary and skip the bitwise gate +
+    # the executable-cache clears below
+    if len(rates) > 1 and 1 in rates and _todo(
+        results, "weak_scaling_efficiency"
+    ):
+        top = max(rates)
+        results["weak_scaling_efficiency"] = {
+            "shards": top,
+            "n_per_shard": n_per,
+            "efficiency": round(rates[top] / (top * rates[1]), 3),
+            "traffic_model": exch.cross_shard_traffic_bytes(
+                n_per * top, 512 // 32, top
+            ),
+            "cpu_rehearsal": not on_tpu,
+        }
+        print(
+            json.dumps(
+                {"weak_scaling_efficiency": results["weak_scaling_efficiency"]}
+            ),
+            flush=True,
+        )
+
+    # bitwise overlap gate at n = n_per: sharded over every device vs
+    # the single-device engine, same seed + schedule
+    if devs > 1 and _todo(results, "weak_scaling_bitwise_equal"):
+        try:
+            n = n_per
+            params = es.ScalableParams(n=n, u=512)
+            sched = StormSchedule.churn_storm(
+                ticks, n, fraction=0.10, fail_tick=2, seed=0
+            )
+            single = ScalableCluster(n=n, params=params, seed=0)
+            single.run(sched)
+            # largest power-of-two shard count (n = 1M divides cleanly)
+            gate_shards = 1 << (devs.bit_length() - 1)
+            sharded = pmesh.ShardedStorm(
+                n=n,
+                mesh=pmesh.make_mesh(gate_shards),
+                params=params,
+                seed=0,
+            )
+            sharded.run(
+                StormSchedule.churn_storm(
+                    ticks, n, fraction=0.10, fail_tick=2, seed=0
+                )
+            )
+            mismatches = [
+                f
+                for f in ("heard", "checksum", "truth_status")
+                if not (
+                    np.asarray(getattr(single.state, f))
+                    == np.asarray(getattr(sharded.state, f))
+                ).all()
+            ]
+            results["weak_scaling_bitwise_equal"] = {
+                "n": n,
+                "shards": int(sharded.mesh.devices.size),
+                "equal": not mismatches,
+                "mismatches": mismatches,
+            }
+        except Exception as e:
+            results["weak_scaling_bitwise_equal"] = {"error": str(e)[:300]}
+        print(
+            json.dumps(
+                {
+                    "weak_scaling_bitwise_equal": results[
+                        "weak_scaling_bitwise_equal"
+                    ]
+                }
+            ),
+            flush=True,
+        )
+
+    # several distinct 1M+ storm programs were compiled — release them
+    storm_mod.clear_executable_cache()
+    pmesh.clear_executable_cache()
+
+
 def phase_route(results: dict) -> None:
     """Round-11 routing plane on-chip: the coupled membership+routing
     scan at n=1M under sparse churn — batched Zipf queries/s with the
@@ -977,8 +1154,21 @@ def main() -> int:
 
     import ringpop_tpu  # noqa: F401  (x64 config before backend init)
 
+    # TPU_MEASURE_FORCE_HOST=<k>: rehearse the sweep (notably the
+    # weak_scaling ladder) on k forced virtual CPU devices — routed
+    # through utils.util.pin_cpu_platform, the ONE place the device-
+    # count flag is spelled (round-14 satellite; the multichip dryrun
+    # and bench.py's mesh phase share it).  Skips the tunnel wait: a
+    # forced-host run is an intentional CPU run, and every phase marks
+    # its numbers with the platform.
+    force_host = os.environ.get("TPU_MEASURE_FORCE_HOST")
+    if force_host:
+        from ringpop_tpu.utils.util import pin_cpu_platform
+
+        pin_cpu_platform(int(force_host))
+        plat = "cpu"
     try:
-        plat = wait_for_tpu()
+        plat = plat if force_host else wait_for_tpu()
     except RuntimeError as e:
         # keep the artifact alive like bench.py: an exhausted tunnel-retry
         # budget must still leave an error-bearing RESULTS_TPU file (the
@@ -1022,6 +1212,7 @@ def main() -> int:
         ("encode_impls", phase_encode_impls),
         ("fused_parity", phase_fused_parity),
         ("fused_exchange", phase_fused_exchange),
+        ("weak_scaling", phase_weak_scaling),
         ("route", phase_route),
         ("ckpt", phase_ckpt),
         ("epidemic_100k", phase_epidemic_100k),

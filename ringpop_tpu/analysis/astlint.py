@@ -57,7 +57,11 @@ TRACED_ENTRIES: Dict[str, Set[str]] = {
         "farmhash_truth_checksum",
     },
     "ops/jax_farmhash.py": {"hash32_rows"},
-    "ops/exchange.py": {"exchange", "exchange_xla"},
+    "ops/exchange.py": {"exchange", "exchange_xla", "exchange_local"},
+    # the round-14 shard_map'd exchange plane: the plane body and its
+    # row-routing helper are the repo's first explicitly-collective
+    # traced code (all_to_all / all_gather / ppermute-class primitives)
+    "parallel/mesh.py": {"make_exchange_plane", "_route_rows"},
     "ops/fused_checksum.py": {"membership_checksums", "fused_hash_rows"},
     "ops/checksum_encode.py": {"membership_rows", "ring_rows"},
     "ops/pallas_farmhash.py": {

@@ -411,6 +411,69 @@ def _entry_exchange(impl: str) -> Tuple[Callable, Tuple]:
     return fused, _exchange_args()
 
 
+def _plane_fixture(n: int = 8):
+    """1-device mesh + exchange plane at toy shapes — the mesh axis is
+    logical (shard_map traces identically at any device count), so the
+    entries run under both the 1-device CLI env and the 8-device test
+    conftest."""
+    from ringpop_tpu.parallel import mesh as pmesh
+
+    mesh = pmesh.make_mesh(1)
+    return pmesh.make_exchange_plane(mesh, "xla", n=n)
+
+
+def _plane_args(n: int = 8, w: int = 4, seed: int = 3):
+    import jax.numpy as jnp
+    import numpy as np
+
+    rng = np.random.default_rng(seed)
+    heard, _pull, _push, r_delta = _exchange_args(n, w, seed)
+    perm = rng.permutation(n).astype(np.int32)
+    return (
+        heard,
+        r_delta,
+        jnp.asarray(
+            rng.integers(0, 2**32, size=w, dtype=np.uint32)
+        ),  # active_words
+        jnp.asarray(rng.random(n) < 0.7),  # direct_ok
+        jnp.asarray(perm),  # partner0
+        jnp.asarray(np.argsort(perm).astype(np.int32)),  # inv_base
+    )
+
+
+def _entry_exchange_plane() -> Tuple[Callable, Tuple]:
+    """The round-14 shard_map'd exchange plane: explicit all_to_all /
+    all-gather partner-row routing + the fused kernel on shard-local
+    tiles.  The collectives are device primitives, not callbacks, and
+    the delta path must stay in uint32 lanes through the routing."""
+    plane = _plane_fixture()
+
+    def fn(heard, r_delta, active_words, ok, fwd, inv):
+        return plane(heard, r_delta, active_words, ok, fwd, inv)
+
+    return fn, _plane_args()
+
+
+def _entry_engine_scalable_tick_shardmap() -> Tuple[Callable, Tuple]:
+    """The sharded storm tick with the exchange seam filled by the
+    shard_map plane — the program ShardedStorm compiles under a mesh
+    (ISSUE 10 acceptance: the sharded tick holds the same callback-free
+    / uint32 discipline as every single-device shape)."""
+    from ringpop_tpu.models.sim import engine_scalable as es
+
+    params = es.ScalableParams(
+        n=8, u=128, perm_impl="sortless", fused_exchange="xla"
+    )
+    plane = _plane_fixture()
+    state = es.init_state(params, seed=0)
+    inputs = es.ChurnInputs.quiet(8)
+
+    def one(state, inputs):
+        return es.tick(state, inputs, params, exchange_plane=plane)
+
+    return one, (state, inputs)
+
+
 def _fused_args(n: int = 8, b: int = 4, seed: int = 0):
     import jax.numpy as jnp
     import numpy as np
@@ -638,6 +701,14 @@ DEFAULT_ENTRIES: List[EntryPoint] = [
     ),
     EntryPoint("exchange-xla", lambda: _entry_exchange("xla")),
     EntryPoint("exchange-pallas", lambda: _entry_exchange("pallas")),
+    # the round-14 explicitly-collective programs: the shard_map'd
+    # exchange plane and the sharded storm tick built on it — the first
+    # collective entry points in the repo, held to the same gates
+    EntryPoint("exchange-plane", _entry_exchange_plane),
+    EntryPoint(
+        "engine-scalable-tick-shardmap",
+        _entry_engine_scalable_tick_shardmap,
+    ),
     EntryPoint("fused-checksum-xla", lambda: _entry_fused_checksum("xla")),
     EntryPoint(
         "fused-checksum-pallas", lambda: _entry_fused_checksum("pallas")

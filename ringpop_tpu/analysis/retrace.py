@@ -340,6 +340,27 @@ def _probe_exchange_xla() -> Tuple[Callable, List[Tuple[str, Tuple]]]:
     ]
 
 
+def _probe_exchange_plane() -> Tuple[Callable, List[Tuple[str, Tuple]]]:
+    import jax
+
+    from ringpop_tpu.analysis import jaxpr_audit as ja
+
+    # the round-14 shard_map'd exchange plane (1-device mesh — the
+    # routing program is identical at any shard count, and the probe
+    # must run under both the 1-device CLI env and the 8-device test
+    # conftest).  Cache discipline: new mask values under the same
+    # shapes must cache-hit — the plane runs once per storm tick; a
+    # wider rumor mask (same n: the plane instance is built per n) is
+    # the one budgeted recompile.
+    plane = ja._plane_fixture()
+    fn = jax.jit(plane)
+    return fn, [
+        ("[8,4] values A", ja._plane_args(8, 4, 0)),
+        ("[8,4] values B (expect cache hit)", ja._plane_args(8, 4, 1)),
+        ("[8,8] wider mask (expect recompile)", ja._plane_args(8, 8, 2)),
+    ]
+
+
 def _probe_engine_scalable_tick_fused() -> (
     "Tuple[Callable, List[Tuple[str, Tuple]]]"
 ):
@@ -446,6 +467,7 @@ DEFAULT_PROBES: List[Probe] = [
     Probe("engine-tick", _probe_engine_tick),
     Probe("engine-scalable-tick", _probe_engine_scalable_tick),
     Probe("exchange-xla", _probe_exchange_xla),
+    Probe("exchange-plane", _probe_exchange_plane),
     Probe(
         "engine-scalable-tick-fused", _probe_engine_scalable_tick_fused
     ),

@@ -448,6 +448,57 @@ def resolve_fused_exchange(params: "ScalableParams", backend: str) -> str:
     return "pallas" if backend == "tpu" else "off"
 
 
+def resolve_sharded_exchange(
+    params: "ScalableParams", backend: str, shards: int
+) -> tuple:
+    """Resolve ``fused_exchange`` for a MESH-sharded engine (round 14):
+    ``(mode, kernel_impl)`` where ``mode`` is "shard_map" (the explicit
+    collective exchange plane — parallel.mesh.make_exchange_plane —
+    with ``kernel_impl`` running per shard) or "gspmd" (the classic
+    whole-program partitioning with ``kernel_impl`` as the engine's
+    fused_exchange value).  The FULL resolution table, pinned by
+    tests/parallel/test_shard_exchange.py::test_resolution_table:
+
+    ==============  =======  ==========================================
+    fused_exchange  backend  resolves to
+    ==============  =======  ==========================================
+    auto            tpu      ("shard_map", "pallas") — the megakernel,
+                             shard-local, one VMEM pass per shard
+    auto            other    ("shard_map", "xla") — same plane, the
+                             bit-exact twin per shard (interpret-mode
+                             Pallas would be a slowdown off-TPU)
+    pallas          any      ("shard_map", "pallas") — an explicit
+                             pallas is honored; under the plane it
+                             runs shard-local, so it partitions now
+                             (pre-round-14 it meant a replicated kernel)
+    xla             any      ("gspmd", "xla") — the partitionable XLA
+                             twin under whole-program GSPMD: the
+                             fallback GATE the plane is bitwise-
+                             compared against
+    off             any      ("gspmd", "off") — classic inline phases
+                             under GSPMD
+    ==============  =======  ==========================================
+
+    Contrast with the single-device :func:`resolve_fused_exchange`
+    ("pallas" on TPU, "off" elsewhere): "auto" under a mesh now picks
+    the shard_map plane instead of the PR-5 silent drop-to-XLA.  The
+    driver surfaces any auto divergence from the single-device pick as
+    a runlog note + statsd field (ShardedStorm.attach_recorder).
+    """
+    if shards < 1:
+        raise ValueError("shards must be >= 1, got %d" % (shards,))
+    fe = params.fused_exchange
+    if fe == "auto":
+        return ("shard_map", "pallas" if backend == "tpu" else "xla")
+    if fe == "pallas":
+        return ("shard_map", "pallas")
+    if fe in ("xla", "off"):
+        return ("gspmd", fe)
+    raise ValueError(
+        "fused_exchange must be auto|pallas|xla|off, got %r" % (fe,)
+    )
+
+
 def resolve_scalable_params(
     params: "ScalableParams", backend: str
 ) -> "ScalableParams":
@@ -767,8 +818,23 @@ def farmhash_truth_checksum(
 
 
 def tick(
-    state: ScalableState, inputs: ChurnInputs, params: ScalableParams
+    state: ScalableState,
+    inputs: ChurnInputs,
+    params: ScalableParams,
+    exchange_plane=None,
 ) -> tuple[ScalableState, ScalableMetrics]:
+    """One protocol period.  ``exchange_plane`` is the round-14 seam for
+    the direct push-pull round: when given, it is called as
+    ``plane(heard, r_delta, active_words, direct_ok, partner0,
+    inv_base) -> (new_heard, d_direct)`` and OWNS the partner-row
+    delivery + fused exchange (the mesh driver passes the shard_map'd
+    collective plane, which gathers cross-shard partner rows explicitly
+    and runs the megakernel on pre-gathered, purely shard-local data).
+    ``None`` keeps the inline path: whole-array gathers + the
+    ``fused_exchange``-resolved op, exactly as before.  Both paths are
+    bit-identical — the plane's contract is exact mod-2^32 delivery of
+    the same pulled/pushed row sets (tests/parallel/
+    test_shard_exchange.py pins whole trajectories)."""
     n, u = params.n, params.u
     gate = params.gate_phases  # static: cond-gated vs straight-line phases
     t = state.tick_index + 1
@@ -935,32 +1001,53 @@ def tick(
     # push scatter i -> partner[i] is a gather by the inverse
     # permutation (partner is a permutation: no write conflicts).
     fused_ex = resolve_fused_exchange(params, jax.default_backend())
-    pulled = (
-        jnp.where(direct_ok[:, None], state.heard[partner0], 0)
-        & active_words[None, :]
-    )
-    pushed = (
-        jnp.where(direct_ok[inv_base][:, None], state.heard[inv_base], 0)
-        & active_words[None, :]
-    )
-    if fused_ex == "off":
-        new_heard = state.heard | pulled | pushed
-        d_direct = None
-    else:
-        # fused megakernel (ops.exchange): OR + new-bit diff + popcount
-        # + checksum delta-sum in one pass over the mask — the direct
-        # round's [N, U/32] temporaries never reach HBM.  Exact mod-2^32
-        # arithmetic, so csum stays bit-identical to the inline path.
-        # want_counts=False: the tick consumes only the mask + delta —
-        # the per-row popcount and its [N] output drop out of the program
-        new_heard, d_direct, _nb = _exchange.exchange(
+    if exchange_plane is not None:
+        # round-14 seam: the plane owns partner-row delivery (explicit
+        # collectives under a mesh) AND the fused exchange on the
+        # pre-gathered rows; it applies the direct_ok/active_words
+        # masking internally with the same semantics as the inline path
+        # below.  Delta accounting follows the fused shape (d_direct
+        # from the plane, indirect diff summed separately) — exact mod
+        # 2^32 either way.
+        new_heard, d_direct = exchange_plane(
             state.heard,
-            pulled,
-            pushed,
             state.r_delta,
-            impl=fused_ex,
-            want_counts=False,
+            active_words,
+            direct_ok,
+            partner0,
+            inv_base,
         )
+        fused_ex = "plane"
+    else:
+        pulled = (
+            jnp.where(direct_ok[:, None], state.heard[partner0], 0)
+            & active_words[None, :]
+        )
+        pushed = (
+            jnp.where(
+                direct_ok[inv_base][:, None], state.heard[inv_base], 0
+            )
+            & active_words[None, :]
+        )
+        if fused_ex == "off":
+            new_heard = state.heard | pulled | pushed
+            d_direct = None
+        else:
+            # fused megakernel (ops.exchange): OR + new-bit diff +
+            # popcount + checksum delta-sum in one pass over the mask —
+            # the direct round's [N, U/32] temporaries never reach HBM.
+            # Exact mod-2^32 arithmetic, so csum stays bit-identical to
+            # the inline path.  want_counts=False: the tick consumes
+            # only the mask + delta — the per-row popcount and its [N]
+            # output drop out of the program
+            new_heard, d_direct, _nb = _exchange.exchange(
+                state.heard,
+                pulled,
+                pushed,
+                state.r_delta,
+                impl=fused_ex,
+                want_counts=False,
+            )
     heard_after_direct = new_heard
 
     # indirect rounds (the ping-req fanout) + probe evidence: only nodes

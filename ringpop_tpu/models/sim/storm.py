@@ -205,6 +205,10 @@ class ScalableCluster(CheckpointableMixin):
         self.params = params or es.ScalableParams(n=n)
         if self.params.n != n:
             self.params = self.params._replace(n=n)
+        # the pre-resolution request, kept for the observability note
+        # (exchange_resolution's "requested" field — same shape as the
+        # mesh driver's)
+        self._requested_fused_exchange = self.params.fused_exchange
         # pin the trace-time "auto" knobs (perm_impl, fused_exchange) to
         # concrete values: the shared executable caches below key on
         # params, so two clusters built under different default backends
@@ -227,6 +231,23 @@ class ScalableCluster(CheckpointableMixin):
         )
         # optional telemetry sink (obs.RunRecorder via attach_recorder)
         self.recorder = None
+
+    def exchange_resolution(self) -> dict:
+        """The single-device fused-exchange resolution as a runlog-ready
+        dict — the mesh driver's ShardedStorm.exchange_resolution()
+        twin, so the satellite observability note can always compare
+        "what a mesh resolved" against "what this backend resolves
+        single-device" (round 14; the values were pinned concrete at
+        construction by resolve_scalable_params)."""
+        return {
+            "requested": self._requested_fused_exchange,
+            "mode": "inline",
+            "impl": self.params.fused_exchange,
+            "shards": 1,
+            "cap": None,
+            "single_device_resolution": self.params.fused_exchange,
+            "differs_from_single_device": False,
+        }
 
     def attach_recorder(self, recorder) -> None:
         """Attach an obs.RunRecorder; step()/run() metrics fold into it."""

@@ -129,6 +129,13 @@ class StatsdBridge:
 
             self._stat = _stat
 
+    def gauge(self, key: str, value) -> None:
+        """Emit one gauge under the bridge's fq-key scheme — the public
+        seam for driver-level one-shot stats (e.g. the mesh driver's
+        ``sharded.exchange.*`` resolution note, round 14) so callers
+        never reach into the internal ``_stat`` dispatch."""
+        self._stat("gauge", key, value)
+
     def emit_tick(self, row: Any) -> int:
         """One tick's metrics (NamedTuple or dict).  Counters emit only
         when nonzero (statsd increments are deltas); gauges always emit.

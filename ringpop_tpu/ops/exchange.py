@@ -43,9 +43,22 @@ The partner-row gathers stay OUTSIDE this op: a dynamic cross-row gather
 cannot live inside a row-tiled kernel (row i's partner may sit in any
 other tile), and XLA's gather is already a single optimized read of the
 mask.  What the op removes is every pass AFTER the gathers.
+
+Sharded use (round 14): a ``pallas_call`` does not partition under GSPMD
+— the sharded engine used to silently drop to the XLA twin.  The
+shard_map'd exchange plane (:mod:`ringpop_tpu.parallel.mesh`) now
+delivers the partner rows with explicit collectives and calls
+:func:`exchange_local` on purely shard-local ``[N/S, U/32]`` tiles, so
+the megakernel runs one VMEM pass per shard.  :func:`exchange_xla`
+doubles as the PARTITIONABLE twin — identical exact mod-2^32 arithmetic
+whose vector ops GSPMD shards by rows — and is the fallback gate every
+sharded configuration is bitwise-compared against
+(tests/parallel/test_shard_exchange.py).
 """
 
 from __future__ import annotations
+
+import math
 
 import jax
 import jax.numpy as jnp
@@ -211,7 +224,13 @@ def exchange_xla(
     mod-2^32 integer arithmetic throughout), chunked over rows so the
     32x bit expansion of the diff never materializes at full [N, U].
     ``want_counts=False`` drops the per-row popcount reduction from the
-    program (the engine's hot path consumes only the delta)."""
+    program (the engine's hot path consumes only the delta).
+
+    This is also the PARTITIONABLE twin: every op here is a vector op
+    GSPMD shards by rows (the exactness of wrapping uint32 adds makes
+    any partitioning bit-identical), so ``fused_exchange="xla"`` under
+    a mesh is the fallback gate the shard_map'd exchange plane is
+    bitwise-compared against."""
     n, w = heard.shape
     new = heard | pulled | pushed
     diff = new ^ heard
@@ -236,6 +255,49 @@ def exchange_xla(
     return new, acc.reshape(-1)[:n], cnt.reshape(-1)[:n]
 
 
+def exchange_local(
+    heard,
+    pulled,
+    pushed,
+    r_delta,
+    *,
+    impl: str,
+    interpret: "bool | None" = None,
+    vmem_budget: int = 8 * 1024 * 1024,
+):
+    """Shard-local entry for the fused exchange: the megakernel on one
+    shard's ``[N/S, U/32]`` row tile, inside a ``shard_map`` body.
+
+    Identical arithmetic to :func:`exchange` (exact mod-2^32 — the
+    bitwise-equality contract across impls and shard counts rests on
+    it); the differences are contractual, not computational:
+
+    - the caller has ALREADY delivered the cross-shard partner rows
+      (``pulled``/``pushed`` are shard-local dense planes produced by
+      the mesh plane's all_to_all / all-gather routing), so no global
+      row index appears here and the kernel's row tiling sees only
+      local rows — one VMEM pass per shard;
+    - ``impl`` is required ("pallas" or "xla"): inside ``shard_map``
+      there is no auto resolution — the driver pinned the kernel at
+      plane construction (ScalableParams.fused_exchange);
+    - counts are never requested (the engine's hot path consumes only
+      the mask + delta).
+
+    Returns ``(new_heard [N/S, U/32] uint32, row_delta [N/S] uint32)``.
+    """
+    new_heard, delta, _ = exchange(
+        heard,
+        pulled,
+        pushed,
+        r_delta,
+        impl=impl,
+        interpret=interpret,
+        vmem_budget=vmem_budget,
+        want_counts=False,
+    )
+    return new_heard, delta
+
+
 def step_traffic_bytes(n: int, w: int) -> int:
     """Modeled HBM bytes per exchange step — the op's one-pass contract:
     3 mask reads (heard + the two partner-row planes the engine
@@ -247,6 +309,76 @@ def step_traffic_bytes(n: int, w: int) -> int:
     scripts/prof_exchange_roofline.py — so a change to the op's traffic
     contract lands in all three at once."""
     return (3 + 1) * n * w * 4 + 2 * n * 4
+
+
+def exchange_cap(local_rows: int, shards: int) -> int:
+    """Static per-(src shard, dst shard) row cap for the mesh exchange
+    plane's all_to_all buckets — the ONE definition (parallel/mesh.py
+    imports it; it lives here, next to the traffic model that charges
+    the capped buffers, because ops never imports upward while mesh
+    already imports ops).
+
+    The PRP base permutation spreads each shard's ``local_rows`` sends
+    ~Binomial(local_rows, 1/shards) per destination — mean ``L/S``, std
+    ``sqrt(L/S)``.  The cap pads to mean + 6·sqrt + 8: statically sized
+    buffers (no data-dependent shapes inside the compiled tick),
+    overflow probability astronomically small, and the rare overflow
+    falls back — under ``lax.cond``, all shards together — to the
+    bit-identical all-gather route (the route plane's dirty-bucket
+    fallback scheme).  Never exceeds ``local_rows`` (a bucket cannot
+    receive more rows than a shard owns), which also makes the
+    single-shard mesh exact."""
+    if shards <= 1:
+        return local_rows
+    mean = -(-local_rows // shards)
+    return min(local_rows, mean + 6 * math.isqrt(mean) + 8)
+
+
+def cross_shard_traffic_bytes(
+    n: int, w: int, shards: int, cap: "int | None" = None
+) -> dict:
+    """Modeled per-tick interconnect vs shard-local bytes for the
+    shard_map'd exchange plane — the ONE copy of the cross-shard model
+    (scripts/prof_exchange_roofline.py, bench.py's mesh phase, and
+    tpu_measure.py's weak_scaling phase all read this), the sharded
+    companion of :func:`step_traffic_bytes`.
+
+    The plane routes rows by DESTINATION with one all_to_all per
+    direction (pull + push).  Each shard contributes ``cap`` row slots
+    per peer shard and direction; of those, the ``(shards-1)/shards``
+    fraction addressed to OTHER shards actually crosses the interconnect
+    (ICI within a slice, DCN across hosts — the self-addressed block
+    stays local), plus a [shards, cap] int32 position plane per
+    direction.  For a PRP permutation the expected occupancy per
+    (src, dst) bucket is ``L/S`` rows, so the cap (default:
+    :func:`exchange_cap`'s mean + 6·sqrt slack) bounds the
+    wire bytes statically — padding slots ride the wire too, which is
+    why the model charges ``cap``, not the mean.  Shard-local bytes are
+    the fused megakernel's one-pass contract on the local tile
+    (:func:`step_traffic_bytes` at N/S rows).  Returns the itemized
+    dict; ``interconnect_total`` is per TICK across all shards.
+    """
+    local_rows = n // shards
+    if cap is None:
+        cap = exchange_cap(local_rows, shards)
+    cross_frac = (shards - 1) / shards
+    row_slots = shards * cap * shards  # per direction, all shards
+    out = {
+        "shards": shards,
+        "local_rows": local_rows,
+        "cap": cap,
+        # two directions (pull + push): routed row payloads that cross
+        # shard boundaries, padded slots included
+        "interconnect_rows": int(2 * row_slots * w * 4 * cross_frac),
+        # the [S, cap] int32 destination-position planes, both directions
+        "interconnect_pos": int(2 * row_slots * 4 * cross_frac),
+        # per-shard fused kernel pass over the local tile, all shards
+        "local_fused_total": shards * step_traffic_bytes(local_rows, w),
+    }
+    out["interconnect_total"] = (
+        out["interconnect_rows"] + out["interconnect_pos"]
+    )
+    return out
 
 
 def measure_bandwidth(  # jaxgate: host — wall-clock probe, never traced

@@ -36,6 +36,7 @@ from typing import Optional, Sequence
 import jax
 import jax.numpy as jnp
 import numpy as np
+from jax.experimental.shard_map import shard_map
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ringpop_tpu.models.sim import engine
@@ -194,6 +195,7 @@ def clear_executable_cache() -> None:
     make_sharded_scan.cache_clear()
     _storm_tick_fn.cache_clear()
     _storm_scan_fn.cache_clear()
+    make_exchange_plane.cache_clear()
 
 
 class ShardedSim(CheckpointableMixin):
@@ -365,10 +367,155 @@ class ShardedSim(CheckpointableMixin):
 # ---------------------------------------------------------------------------
 # Scalable (rumor-table) engine over the mesh — the 1M-on-v5e-8 path.
 # Node-indexed arrays shard over the mesh; the bounded rumor table, rng,
-# and base_sum are tiny and replicate.  The gossip exchange's permutation
-# gathers become all-to-alls over ICI; the limb-matmul checksum shards by
-# rows with the [U, 4] limb table replicated.
+# and base_sum are tiny and replicate.  Since round 14 the gossip
+# exchange's partner-row delivery is an EXPLICIT shard_map'd collective
+# program (make_exchange_plane below) instead of GSPMD-inferred gathers;
+# the limb-matmul checksum shards by rows with the [U, 4] limb table
+# replicated.
 # ---------------------------------------------------------------------------
+
+
+# the ONE cap definition lives in ops/exchange.py next to the traffic
+# model that charges the capped buffers; re-exported here because the
+# cap is an attribute of the plane this module builds
+from ringpop_tpu.ops.exchange import exchange_cap  # noqa: E402
+
+
+def _route_rows(rows, dest_l, src_l, axis: str, cap: int):
+    """Deliver row ``g`` of the sharded array to global row ``dest[g]``
+    (``dest`` a permutation), inside a shard_map body.
+
+    Fast path: bucket local rows by destination shard, pad each bucket
+    to the static ``cap``, one ``all_to_all`` for the row payloads plus
+    one for the [S, cap] destination-position plane, then scatter the
+    received rows into place (a permutation: no write conflicts, every
+    local position filled exactly once).  Overflow — any bucket fuller
+    than ``cap``, pmax'd so every shard agrees — falls back to the
+    bit-identical all-gather route: gather the full array and read row
+    ``src_l[i]`` (``src`` = the analytic inverse of ``dest``, evaluated
+    by the caller from the PRP).  Both paths deliver exactly
+    ``rows[src_l]``; bitwise equality is pinned with a forced cap=1 in
+    tests/parallel/test_shard_exchange.py."""
+    n_shards = jax.lax.psum(1, axis)
+    local = rows.shape[0]
+    dshard = dest_l // jnp.int32(local)
+    dpos = dest_l - dshard * jnp.int32(local)
+    onehot = (
+        dshard[:, None] == jnp.arange(n_shards, dtype=jnp.int32)[None, :]
+    ).astype(jnp.int32)
+    counts = jnp.sum(onehot, axis=0)
+    slot = jnp.cumsum(onehot, axis=0) - 1  # rank within my dest bucket
+    myslot = jnp.take_along_axis(slot, dshard[:, None], axis=1)[:, 0]
+    overflow = jax.lax.pmax(jnp.any(counts > jnp.int32(cap)), axis)
+
+    def a2a(_):
+        buf = jnp.zeros((n_shards, cap, rows.shape[1]), rows.dtype)
+        pos = jnp.full((n_shards, cap), -1, jnp.int32)
+        # mode="drop": a slot past the cap is only reachable when the
+        # overflow cond picked the other branch — this branch's scatter
+        # must still trace to a safe program
+        in_cap = myslot < jnp.int32(cap)
+        row_sh = jnp.where(in_cap, dshard, n_shards)
+        buf = buf.at[row_sh, myslot].set(rows, mode="drop")
+        pos = pos.at[row_sh, myslot].set(dpos, mode="drop")
+        rbuf = jax.lax.all_to_all(buf, axis, 0, 0)
+        rpos = jax.lax.all_to_all(pos, axis, 0, 0)
+        flat = rpos.reshape(-1)
+        out = jnp.zeros_like(rows)
+        return out.at[jnp.where(flat >= 0, flat, local)].set(
+            rbuf.reshape(-1, rows.shape[1]), mode="drop"
+        )
+
+    def gather_fallback(_):
+        full = jax.lax.all_gather(rows, axis, axis=0, tiled=True)
+        return full[src_l]
+
+    return jax.lax.cond(overflow, gather_fallback, a2a, None)
+
+
+@functools.lru_cache(maxsize=None)
+def make_exchange_plane(
+    mesh: Mesh,
+    impl: str,
+    cap: Optional[int] = None,
+    n: Optional[int] = None,
+):
+    """The shard_map'd direct-round exchange plane for the scalable
+    engine (the round-14 tentpole), matching the engine seam
+    ``plane(heard, r_delta, active_words, direct_ok, partner0,
+    inv_base) -> (new_heard, d_direct)``.
+
+    Inside the body each shard holds its local ``[N/S, U/32]`` heard
+    tile plus the LOCAL slices of the analytic PRP permutation
+    (``partner0``/``inv_base`` are elementwise Feistel evaluations, so
+    GSPMD keeps them shard-local; shard_map's in_specs hand each shard
+    its rows' global partner ids).  The plane then:
+
+    1. routes the pull rows — row ``p`` to ``inv_base[p]`` — and the
+       direct_ok-masked push rows — row ``j`` to ``partner0[j]`` — with
+       one explicit :func:`_route_rows` each (all_to_all, statically
+       capped, all-gather overflow fallback);
+    2. applies the receiver-side direct_ok mask to the pulls and the
+       active-rumor word mask to both planes (same semantics, same
+       order of exact bitwise ops as the inline engine path);
+    3. runs the fused megakernel on the purely shard-local tiles
+       (:func:`ringpop_tpu.ops.exchange.exchange_local`, ``impl`` =
+       "pallas" on TPU / the "xla" twin elsewhere) — one VMEM pass per
+       shard, no GSPMD drop-to-XLA.
+
+    ``cap=None`` sizes the all_to_all buckets with :func:`exchange_cap`
+    (then ``n`` must be given); an explicit cap is the overflow-fallback
+    test lever.  lru_cached on the (hashable) arguments so storm tick
+    and scan programs share one plane per configuration."""
+    if impl not in ("pallas", "xla"):
+        raise ValueError("plane impl must be pallas|xla, got %r" % (impl,))
+    axis = _node_axis(mesh)
+    shards = int(mesh.devices.size)
+    if cap is None:
+        if n is None:
+            raise ValueError("make_exchange_plane needs cap= or n=")
+        if n % shards:
+            raise ValueError(
+                "n=%d not divisible by %d shards" % (n, shards)
+            )
+        cap = exchange_cap(n // shards, shards)
+    # tuple axis names (2-D meshes) collapse to one logical axis for the
+    # collectives: shard_map over all axes with the node dim split
+    # across them in order, so a single flat axis list is equivalent
+    axes = axis if isinstance(axis, tuple) else (axis,)
+
+    from ringpop_tpu.ops import exchange as _exch
+
+    @functools.partial(
+        shard_map,
+        mesh=mesh,
+        in_specs=(
+            P(axis, None),  # heard
+            P(),  # r_delta (replicated rumor table)
+            P(),  # active_words
+            P(axis),  # direct_ok
+            P(axis),  # partner0
+            P(axis),  # inv_base
+        ),
+        out_specs=(P(axis, None), P(axis)),
+        check_rep=False,
+    )
+    def plane(h_l, r_delta, active_words, ok_l, fwd_l, inv_l):
+        # pull: row p -> inv[p]; receiver gates on its own direct_ok
+        pulled = _route_rows(h_l, inv_l, fwd_l, axes, cap)
+        pulled = (
+            jnp.where(ok_l[:, None], pulled, 0) & active_words[None, :]
+        )
+        # push: sender gates on its own direct_ok; row j -> partner0[j]
+        pushed = _route_rows(
+            jnp.where(ok_l[:, None], h_l, 0), fwd_l, inv_l, axes, cap
+        )
+        pushed = pushed & active_words[None, :]
+        return _exch.exchange_local(
+            h_l, pulled, pushed, r_delta, impl=impl
+        )
+
+    return plane
 
 
 # node-indexed ScalableState fields (sharded); everything else — the
@@ -430,33 +577,53 @@ def _storm_sample_inputs(n: int, structure_key):
     return inputs
 
 
+def _storm_plane(mesh: Mesh, params, plane_key):
+    """Resolve a ShardedStorm plane_key — None (gspmd modes) or
+    ``(kernel_impl, cap-or-None)`` — to the shared compiled plane."""
+    if plane_key is None:
+        return None
+    impl, cap = plane_key
+    return make_exchange_plane(mesh, impl, cap=cap, n=params.n)
+
+
 @functools.lru_cache(maxsize=None)
-def _storm_tick_fn(params, mesh: Mesh, structure_key):
+def _storm_tick_fn(params, mesh: Mesh, structure_key, plane_key=None):
     from ringpop_tpu.models.sim import engine_scalable as es
+    from ringpop_tpu.models.sim.storm import donate_state_argnums
 
     st_sh = scalable_state_shardings(mesh, params)
     in_sh = _storm_input_shardings(
         mesh, _storm_sample_inputs(params.n, structure_key), False
     )
     return jax.jit(
-        functools.partial(es.tick, params=params),
+        functools.partial(
+            es.tick,
+            params=params,
+            exchange_plane=_storm_plane(mesh, params, plane_key),
+        ),
         in_shardings=(st_sh, in_sh),
         out_shardings=(st_sh, _storm_metrics_shardings(mesh)),
+        # the round-10 in-place heard-mask update, kept intact under the
+        # collective plane (backend-gated: CPU stays copy-safe — see
+        # storm.donate_state_argnums)
+        donate_argnums=donate_state_argnums(),
     )
 
 
 @functools.lru_cache(maxsize=None)
-def _storm_scan_fn(params, mesh: Mesh, structure_key):
+def _storm_scan_fn(params, mesh: Mesh, structure_key, plane_key=None):
     from ringpop_tpu.models.sim import engine_scalable as es
+    from ringpop_tpu.models.sim.storm import donate_state_argnums
 
     st_sh = scalable_state_shardings(mesh, params)
     in_sh = _storm_input_shardings(
         mesh, _storm_sample_inputs(params.n, structure_key), True
     )
+    plane = _storm_plane(mesh, params, plane_key)
 
     def scanned(state, inp):
         def body(st, i):
-            return es.tick(st, i, params)
+            return es.tick(st, i, params, exchange_plane=plane)
 
         return jax.lax.scan(body, state, inp)
 
@@ -464,6 +631,7 @@ def _storm_scan_fn(params, mesh: Mesh, structure_key):
         scanned,
         in_shardings=(st_sh, in_sh),
         out_shardings=(st_sh, _storm_metrics_shardings(mesh)),
+        donate_argnums=donate_state_argnums(),
     )
 
 
@@ -476,43 +644,125 @@ class ShardedStorm(CheckpointableMixin):
     node-indexed array ``P("nodes")``-sharded and the trajectory bitwise
     equal to the single-device engine (tests/parallel/test_mesh.py)."""
 
-    def __init__(self, n, mesh=None, params=None, seed: int = 0):
+    def __init__(
+        self,
+        n,
+        mesh=None,
+        params=None,
+        seed: int = 0,
+        exchange_cap_override: Optional[int] = None,
+    ):
         from ringpop_tpu.models.sim import engine_scalable as es
 
         self.mesh = mesh if mesh is not None else make_mesh()
         self.params = params or es.ScalableParams(n=n)
         if self.params.n != n:
             self.params = self.params._replace(n=n)
+        backend = jax.default_backend()
+        shards = int(self.mesh.devices.size)
         # pin trace-time "auto" knobs exactly like ScalableCluster: the
         # module-level executable caches key on params, and the SPMD
         # trajectory must stay bitwise equal to the single-device engine
-        # regardless of which backend resolved first.  One mesh-specific
-        # override: an auto-resolved "pallas" exchange drops to the
-        # bit-exact XLA twin — a pallas_call does not partition under
-        # the sharded pjit (GSPMD can't see inside the kernel), while
-        # the twin's vector ops shard by rows like the rest of the tick.
-        # An EXPLICIT "pallas" is honored (replicated kernel: correct,
-        # measurably slower — the A/B knob for the chip session).
-        self.params = es.resolve_scalable_params(
-            self.params, jax.default_backend()
+        # regardless of which backend resolved first.  The exchange is
+        # MESH-AWARE since round 14 (es.resolve_sharded_exchange, full
+        # table pinned in tests/parallel/test_shard_exchange.py):
+        # "auto"/"pallas" resolve to the shard_map'd collective plane —
+        # explicit all_to_all partner-row delivery + the fused
+        # megakernel on shard-local tiles — instead of the PR-5 silent
+        # drop to the XLA twin; "xla" keeps the partitionable GSPMD twin
+        # as the fallback gate, "off" the classic inline phases.
+        requested = self.params.fused_exchange
+        self._single_device_resolution = es.resolve_fused_exchange(
+            self.params, backend
         )
-        if (
-            (params is None or params.fused_exchange == "auto")
-            and self.params.fused_exchange == "pallas"
-        ):
-            self.params = self.params._replace(fused_exchange="xla")
-        if n % self.mesh.devices.size:
+        mode, impl = es.resolve_sharded_exchange(
+            self.params, backend, shards
+        )
+        self.exchange_mode = mode  # "shard_map" | "gspmd"
+        self.exchange_impl = impl  # kernel impl (plane) / engine value
+        self.exchange_cap = (
+            (
+                exchange_cap(n // shards, shards)
+                if exchange_cap_override is None
+                else exchange_cap_override
+            )
+            if mode == "shard_map"
+            else None
+        )
+        self._plane_key = (
+            (impl, exchange_cap_override) if mode == "shard_map" else None
+        )
+        # the params the ENGINE traces with: under the plane the seam
+        # bypasses fused_exchange, but pin it to the per-shard kernel so
+        # artifacts/checkpoints record what actually ran (the field is
+        # trajectory-neutral — checkpoint._TRAJECTORY_NEUTRAL_PARAMS)
+        self.params = es.resolve_scalable_params(self.params, backend)
+        if mode == "shard_map":
+            self.params = self.params._replace(fused_exchange=impl)
+        # the satellite-1 observability note: what "auto" would have
+        # done on a single device vs what the mesh resolution picked —
+        # surfaced through attach_recorder instead of the old silent
+        # drop.  ``differs_from_single_device`` compares the KERNEL
+        # (impl vs the single-device pick), not the routing mode: the
+        # PR-5 problem was the computation silently changing lowering,
+        # and the plane itself is not a divergence — on TPU auto runs
+        # the same pallas megakernel under the plane, flag 0; on CPU
+        # auto swaps the inline phases for the xla twin, flag 1.  The
+        # routing mode rides the note separately as ``mode``.
+        self._resolution_note = {
+            "requested": requested,
+            "mode": mode,
+            "impl": impl,
+            "shards": shards,
+            "cap": self.exchange_cap,
+            "single_device_resolution": self._single_device_resolution,
+            "differs_from_single_device": (
+                requested == "auto"
+                and impl != self._single_device_resolution
+            ),
+        }
+        if n % shards:
             raise ValueError(
-                "n=%d not divisible by mesh size %d"
-                % (n, self.mesh.devices.size)
+                "n=%d not divisible by mesh size %d" % (n, shards)
             )
         self._st_sh = scalable_state_shardings(self.mesh, self.params)
         self.state = jax.device_put(
             es.init_state(self.params, seed=seed), self._st_sh
         )
+        # optional telemetry sink (obs.RunRecorder via attach_recorder)
+        self.recorder = None
         # jitted fns are resolved per input-pytree structure (ChurnInputs'
         # optional partition/leave change the arg tree) from MODULE-LEVEL
         # caches shared across instances, like the single-device drivers
+
+    def exchange_resolution(self) -> dict:
+        """The mesh-aware fused-exchange resolution, as a runlog-ready
+        dict (mode/impl/cap/shards + the single-device comparison)."""
+        return dict(self._resolution_note)
+
+    def attach_recorder(self, recorder) -> None:
+        """Attach an obs.RunRecorder: step()/run() metrics fold into it,
+        and the mesh exchange resolution lands as a
+        ``mesh_exchange_resolution`` event row immediately — the
+        observable replacement for the PR-5 silent drop-to-XLA."""
+        recorder.describe(
+            "sim.engine_scalable[mesh]", self.params.n, self.params
+        )
+        recorder.record_event(
+            "mesh_exchange_resolution", **self._resolution_note
+        )
+        self.recorder = recorder
+
+    def emit_resolution_stat(self, bridge) -> None:
+        """Publish the resolution to a statsd bridge (gauges under
+        ``sharded.exchange.*``): 1/0 flags a mesh-vs-single-device
+        divergence of the "auto" pick, plus the static all_to_all cap."""
+        bridge.gauge(
+            "sharded.exchange.resolution_differs",
+            int(self._resolution_note["differs_from_single_device"]),
+        )
+        if self.exchange_cap is not None:
+            bridge.gauge("sharded.exchange.cap", int(self.exchange_cap))
 
     def _structure_key(self, inputs):
         return (inputs.partition is None, inputs.leave is None)
@@ -523,11 +773,17 @@ class ShardedStorm(CheckpointableMixin):
         if inputs is None:
             inputs = es.ChurnInputs.quiet(self.params.n)
         tick = _storm_tick_fn(
-            self.params, self.mesh, self._structure_key(inputs)
+            self.params,
+            self.mesh,
+            self._structure_key(inputs),
+            self._plane_key,
         )
         self.state, m = tick(self.state, inputs)
+        m = jax.tree.map(np.asarray, m)
+        if self.recorder is not None:
+            self.recorder.record_ticks(m)
         self._after_ticks(1)
-        return jax.tree.map(np.asarray, m)
+        return m
 
     def run(self, schedule):
         return self._run_chunked(schedule, self._run_window)
@@ -535,10 +791,16 @@ class ShardedStorm(CheckpointableMixin):
     def _run_window(self, schedule):
         inputs = schedule.as_inputs()
         scan = _storm_scan_fn(
-            self.params, self.mesh, self._structure_key(inputs)
+            self.params,
+            self.mesh,
+            self._structure_key(inputs),
+            self._plane_key,
         )
         self.state, ms = scan(self.state, inputs)
-        return jax.tree.map(np.asarray, ms)
+        ms = jax.tree.map(np.asarray, ms)
+        if self.recorder is not None:
+            self.recorder.record_ticks(ms)
+        return ms
 
     def checksums(self) -> np.ndarray:
         from ringpop_tpu.models.sim import engine_scalable as es

@@ -221,6 +221,41 @@ def retry_compile_helper(fn, *args, backoffs=(0.0, 10.0, 25.0), **kwargs):
     raise exc
 
 
+_HOST_COUNT_PREFIX = "--xla_force_host_platform_device_count"
+
+
+def force_host_device_count(n_devices: int, env=None) -> None:
+    """Set the forced-host-device-count env flags — the ONE place the
+    flag string is spelled (round 14, ISSUE 10 satellite: bench.py's
+    mesh phase, tpu_measure.py's weak-scaling CPU fallback, the
+    multichip dryrun via :func:`pin_cpu_platform`, and tests/conftest.py
+    all route through here, so the device-count flag cannot drift
+    between drivers).
+
+    ENV-ONLY by design: XLA reads XLA_FLAGS at backend-init time, so
+    this works from conftest-style pre-import hooks and for spawned
+    subprocesses alike; it performs no jax import and no backend
+    (re)initialization — callers that need a live re-pin use
+    :func:`pin_cpu_platform`, which builds on this.  Idempotent:
+    an existing count flag is replaced, other XLA_FLAGS preserved.
+    """
+    import os
+
+    if env is None:
+        env = os.environ
+    if n_devices < 1:
+        raise ValueError("n_devices must be >= 1, got %r" % (n_devices,))
+    kept = [
+        f
+        for f in env.get("XLA_FLAGS", "").split()
+        if not f.startswith(_HOST_COUNT_PREFIX)
+    ]
+    kept.append("%s=%d" % (_HOST_COUNT_PREFIX, n_devices))
+    env["XLA_FLAGS"] = " ".join(kept)
+    # jax >= 0.5 reads the env var instead of XLA_FLAGS; harmless before
+    env["JAX_NUM_CPU_DEVICES"] = str(n_devices)
+
+
 def pin_cpu_platform(n_devices=None) -> None:
     """Clear any live JAX backends and force the CPU platform (optionally
     with ``n_devices`` virtual devices).
@@ -248,7 +283,7 @@ def pin_cpu_platform(n_devices=None) -> None:
     marker = "RINGPOP_PINNED_CPU_DEVICES"
     stash_flag = "RINGPOP_AMBIENT_CPU_DEVICES"  # ambient XLA_FLAGS count
     stash_env = "RINGPOP_AMBIENT_JAX_NUM_CPU_DEVICES"  # ambient env count
-    prefix = "--xla_force_host_platform_device_count"
+    prefix = _HOST_COUNT_PREFIX
     flags = os.environ.get("XLA_FLAGS", "").split()
     ambient = next((f for f in flags if f.startswith(prefix)), None)
     kept = [f for f in flags if not f.startswith(prefix)]
@@ -262,9 +297,8 @@ def pin_cpu_platform(n_devices=None) -> None:
             if "JAX_NUM_CPU_DEVICES" in os.environ:
                 os.environ[stash_env] = os.environ["JAX_NUM_CPU_DEVICES"]
         os.environ[marker] = str(n_devices)
-        os.environ["JAX_NUM_CPU_DEVICES"] = str(n_devices)
-        kept.append(f"{prefix}={n_devices}")
-        os.environ["XLA_FLAGS"] = " ".join(kept)
+        # the ONE spelling of the device-count flags (round 14)
+        force_host_device_count(n_devices)
     elif os.environ.pop(marker, None) is not None:
         restored_flag = os.environ.pop(stash_flag, None)
         restored_env = os.environ.pop(stash_env, None)

@@ -56,6 +56,33 @@ ROUTE_EVENT_FIELDS = {
     "ckpt.corrupt": ("tick", "path", "error"),
     "ckpt.resumed": ("tick", "path", "skipped_corrupt"),
     "ckpt_window": ("n", "every", "overhead_frac", "save_mbps_single"),
+    # round-14 mesh plane events: every weak-scaling rung names its
+    # shard count + resolved exchange mode, the summary row carries the
+    # efficiency AND the bitwise gate verdict, and the resolution note
+    # (the observable replacement for the PR-5 silent drop-to-XLA) is
+    # attributable to a requested mode + shard count
+    "mesh_window": (
+        "n",
+        "shards",
+        "ticks",
+        "exchange_mode",
+        "node_ticks_per_sec",
+    ),
+    "weak_scaling": (
+        "n_per_shard",
+        "shards",
+        "node_ticks_per_sec",
+        "efficiency",
+        "bitwise_equal",
+    ),
+    "mesh_exchange_resolution": (
+        "requested",
+        "mode",
+        "impl",
+        "shards",
+        "single_device_resolution",
+        "differs_from_single_device",
+    ),
 }
 
 

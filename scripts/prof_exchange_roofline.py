@@ -22,6 +22,16 @@ artifact records:
    = unfused bytes / fused bytes: the per-tick traffic multiple the
    fused op removes at identical arithmetic.
 
+Round 14 adds the CROSS-SHARD traffic model (item 4 per shape): for the
+shard_map'd exchange plane (parallel/mesh.py), the modeled bytes per
+tick that cross the interconnect — ICI within a slice, DCN across hosts
+— versus the bytes that stay shard-local in the fused kernel pass, from
+the ONE shared model (ops.exchange.cross_shard_traffic_bytes: two
+all_to_all directions at the static cap, the (S-1)/S cross fraction,
+plus the position planes).  ``cross_to_local_ratio`` < 1 means the plane
+is local-bandwidth-bound (the kernel still dominates); >> 1 means
+interconnect-bound and the cap/slack sizing is the lever.
+
 Writes PROF_EXCHANGE_ROOFLINE.json; CPU runs are explicitly marked
 (platform + peak_gbps null, interpret flag on the pallas rows) so nobody
 mistakes them for chip numbers.  PROF_ROOFLINE_FORCE_CPU=1 skips the TPU
@@ -95,6 +105,19 @@ def measure_shape(res: dict, n: int, u: int) -> None:
     models = _bytes_models(n, w)
 
     shape_res: dict = {"n": n, "u": u, "bytes_model": models}
+    # cross-shard model rows (round 14): per-tick interconnect vs
+    # shard-local bytes for the shard_map'd plane at the storm's mesh
+    # shapes — from the ONE shared model so bench.py's mesh phase and
+    # tpu_measure.py's weak_scaling phase report the same bytes
+    shape_res["cross_shard_model"] = {}
+    for shards in (2, 4, 8):
+        if n % shards:
+            continue
+        m = exch.cross_shard_traffic_bytes(n, w, shards)
+        m["cross_to_local_ratio"] = round(
+            m["interconnect_total"] / m["local_fused_total"], 3
+        )
+        shape_res["cross_shard_model"]["shards_%d" % shards] = m
     on_tpu = jax.default_backend() == "tpu"
     for impl in ("pallas", "xla"):
         try:

@@ -56,6 +56,11 @@ def test_jaxpr_prong_covers_required_entry_points():
         # (per-instance schedules) hold the same purity / uint32 gates
         "fuzz-scenario-scan-full",
         "fuzz-scenario-scan-scalable",
+        # ISSUE 10 acceptance: the shard_map'd exchange plane and the
+        # sharded storm tick built on it — the repo's first explicitly
+        # collective programs hold the same purity / uint32 gates
+        "exchange-plane",
+        "engine-scalable-tick-shardmap",
     } <= names
     assert len(names) >= 5
 

@@ -19,6 +19,9 @@ CHEAP_PROBES = (
     "fused-checksum-xla",
     "ring-device-lookup",
     "exchange-xla",  # [8,4] op jit — seconds, not an engine-tick compile
+    # the shard_map'd exchange plane at [8,4] on a 1-device mesh —
+    # small collective graphs, cheap (round 14)
+    "exchange-plane",
     "route-tick",  # n=8 routing tick — small searchsorted graphs, cheap
     # n=8 B=2/4 scalable fuzz scan — the shrinker's cache discipline;
     # ~11 s cold, warm via the persistent XLA cache

@@ -11,13 +11,31 @@ initialized yet at conftest time).  XLA_FLAGS, by contrast, is read by XLA at
 backend-init time, so the env mutation works for the device count.
 """
 
+import importlib.util as _ilu
 import os
 
-flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in flags:
-    os.environ["XLA_FLAGS"] = (
-        flags + " --xla_force_host_platform_device_count=8"
-    ).strip()
+# single source for the forced-host-device flag spelling (round 14):
+# ringpop_tpu.utils.util.force_host_device_count.  Loaded by FILE PATH,
+# not package import: `import ringpop_tpu` pulls in jax (the x64
+# enable), and jax snapshots JAX_NUM_CPU_DEVICES at import — the env
+# pin must land before any jax import to stay meaningful on jax >= 0.5
+# (today's 0.4.37 reads the count from XLA_FLAGS at backend init, but
+# the ordering must not silently rot under an upgrade).  An ambient
+# count (a user's own XLA_FLAGS) wins.
+_spec = _ilu.spec_from_file_location(
+    "_ringpop_util_boot",
+    os.path.join(
+        os.path.dirname(__file__), "..", "ringpop_tpu", "utils", "util.py"
+    ),
+)
+_util_boot = _ilu.module_from_spec(_spec)
+_spec.loader.exec_module(_util_boot)
+if (
+    "xla_force_host_platform_device_count"
+    not in os.environ.get("XLA_FLAGS", "")
+    and "JAX_NUM_CPU_DEVICES" not in os.environ
+):
+    _util_boot.force_host_device_count(8)
 # Round-13 note: buffer donation is DISABLED on the CPU backend
 # (storm.donate_state_argnums) — executables deserialized from the
 # persistent compilation cache below mis-execute donation when other

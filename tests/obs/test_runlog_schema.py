@@ -133,3 +133,52 @@ def test_route_event_rows_validated(tmp_path):
         + "\n"
     )
     assert checker.check([str(log)], verbose=False) == []
+
+
+def test_mesh_event_rows_validated(tmp_path):
+    """Round-14 mesh-plane events: a weak_scaling row without its gate
+    verdict (or a mesh_window without its shard count) is a drifted
+    recorder, not a valid artifact."""
+    import json
+
+    checker = _load_checker()
+    log = tmp_path / "meshev.runlog.jsonl"
+    good_window = {
+        "kind": "event",
+        "name": "mesh_window",
+        "n": 2048,
+        "shards": 8,
+        "ticks": 4,
+        "exchange_mode": "shard_map",
+        "node_ticks_per_sec": 1.0,
+    }
+    log.write_text(
+        "\n".join(
+            [
+                _header_line(),
+                json.dumps(good_window),
+                json.dumps({"kind": "event", "name": "mesh_window"}),
+                json.dumps({"kind": "event", "name": "weak_scaling"}),
+                json.dumps(
+                    {
+                        "kind": "event",
+                        "name": "mesh_exchange_resolution",
+                        "requested": "auto",
+                    }
+                ),
+            ]
+        )
+        + "\n"
+    )
+    problems = checker.check([str(log)], verbose=False)
+    assert any("mesh_window event missing 'shards'" in p for p in problems)
+    assert any(
+        "weak_scaling event missing 'bitwise_equal'" in p for p in problems
+    )
+    assert any(
+        "mesh_exchange_resolution event missing 'mode'" in p
+        for p in problems
+    )
+    # a complete row alone passes
+    log.write_text(_header_line() + "\n" + json.dumps(good_window) + "\n")
+    assert checker.check([str(log)], verbose=False) == []

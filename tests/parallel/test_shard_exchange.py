@@ -1,0 +1,391 @@
+"""The shard_map'd fused cross-shard exchange plane (round 14).
+
+The tentpole gates: the explicit-collective exchange plane must be
+bit-identical to the single-device sortless path at every shard count
+(1/2/4/8), to the partitionable GSPMD XLA twin (the fallback gate), and
+through the forced overflow fallback; the mesh-aware resolution table is
+pinned; the observability note replaces the PR-5 silent drop-to-XLA; and
+a mid-storm restore across shard counts (the PR-8 manifest loader)
+resumes the identical trajectory.  Runs on the virtual 8-device CPU mesh
+(conftest forces xla_force_host_platform_device_count=8).
+"""
+
+import json
+
+import jax
+import numpy as np
+import pytest
+
+from ringpop_tpu.models.sim import engine_scalable as es
+from ringpop_tpu.models.sim.storm import ScalableCluster, StormSchedule
+from ringpop_tpu.parallel import mesh as pmesh
+
+
+@pytest.fixture(scope="module")
+def eight_devices():
+    if len(jax.devices()) < 8:
+        pytest.skip("needs 8 virtual devices")
+    return jax.devices()[:8]
+
+
+def _params(n, **kw):
+    kw.setdefault("u", 192)
+    kw.setdefault("suspicion_ticks", 5)
+    return es.ScalableParams(n=n, **kw)
+
+
+def _storm_sched(ticks, n, seed=4):
+    # kill + rejoin + a partition split/heal: every exchange-adjacent
+    # phase (indirect rounds, publishes, refutes) fires inside the window
+    sched = StormSchedule.churn_storm(
+        ticks, n, fraction=0.1, fail_tick=2, seed=seed
+    )
+    part = np.full((ticks, n), -1, np.int32)
+    part[ticks // 3] = (np.arange(n) < n // 4).astype(np.int32)
+    part[2 * ticks // 3] = 0
+    sched.partition = part
+    return sched
+
+
+def _assert_states_equal(a, b, ctx=""):
+    for f in es.ScalableState._fields:
+        x, y = getattr(a, f), getattr(b, f)
+        if x is None:
+            assert y is None, f
+            continue
+        np.testing.assert_array_equal(
+            np.asarray(x), np.asarray(y), "%s%s" % (ctx, f)
+        )
+
+
+def _run_single(n, ticks, seed=4, **pkw):
+    single = ScalableCluster(n=n, params=_params(n, **pkw), seed=seed)
+    single.run(_storm_sched(ticks, n, seed))
+    return single
+
+
+def test_shard_count_invariance_n64(eight_devices):
+    """ACCEPTANCE: the same seeded storm is bitwise-equal across
+    1/2/4/8 shards under the shard_map plane, and equal to the
+    single-device sortless path — every state field and the checksums."""
+    n, ticks = 64, 24
+    single = _run_single(n, ticks, packet_loss=0.02)
+    for shards in (1, 2, 4, 8):
+        storm = pmesh.ShardedStorm(
+            n=n,
+            mesh=pmesh.make_mesh(shards),
+            params=_params(n, packet_loss=0.02),
+            seed=4,
+        )
+        assert storm.exchange_mode == "shard_map"
+        storm.run(_storm_sched(ticks, n))
+        _assert_states_equal(
+            single.state, storm.state, "shards=%d: " % shards
+        )
+        np.testing.assert_array_equal(single.checksums(), storm.checksums())
+
+
+def test_plane_matches_partitionable_xla_twin(eight_devices):
+    """The fallback gate: the shard_map plane vs fused_exchange="xla"
+    under whole-program GSPMD (the partitionable twin) — bit-identical
+    states on the same mesh."""
+    n, ticks = 64, 16
+    mesh = pmesh.make_mesh(8)
+    plane = pmesh.ShardedStorm(n=n, mesh=mesh, params=_params(n), seed=4)
+    twin = pmesh.ShardedStorm(
+        n=n, mesh=mesh, params=_params(n, fused_exchange="xla"), seed=4
+    )
+    assert plane.exchange_mode == "shard_map"
+    assert twin.exchange_mode == "gspmd" and twin.exchange_impl == "xla"
+    plane.run(_storm_sched(ticks, n))
+    twin.run(_storm_sched(ticks, n))
+    _assert_states_equal(plane.state, twin.state)
+
+
+def test_overflow_fallback_bitwise_equal(eight_devices):
+    """cap=1 overflows every tick's all_to_all buckets, forcing the
+    all-gather fallback under lax.cond — the trajectory must not move."""
+    n, ticks = 64, 12
+    single = _run_single(n, ticks)
+    storm = pmesh.ShardedStorm(
+        n=n,
+        mesh=pmesh.make_mesh(8),
+        params=_params(n),
+        seed=4,
+        exchange_cap_override=1,
+    )
+    assert storm.exchange_cap == 1
+    storm.run(_storm_sched(ticks, n))
+    _assert_states_equal(single.state, storm.state)
+
+
+def test_step_and_scan_agree_under_plane(eight_devices):
+    """The plane inside lax.scan (the storm window program) and as
+    per-tick dispatches produce the same trajectory."""
+    n, ticks = 32, 8
+    params = _params(n, u=160)
+    mesh = pmesh.make_mesh(4)
+    a = pmesh.ShardedStorm(n=n, mesh=mesh, params=params, seed=7)
+    b = pmesh.ShardedStorm(n=n, mesh=mesh, params=params, seed=7)
+    sched = _storm_sched(ticks, n, seed=7)
+    a.run(sched)
+    inputs = _storm_sched(ticks, n, seed=7)
+    for t in range(ticks):
+        b.step(
+            es.ChurnInputs(
+                kill=np.asarray(inputs.kill[t]),
+                revive=np.asarray(inputs.revive[t]),
+                partition=np.asarray(inputs.partition[t]),
+            )
+        )
+    _assert_states_equal(a.state, b.state)
+
+
+def test_exchange_cap_matches_shared_traffic_model():
+    """parallel.mesh.exchange_cap and the ops-side cross-shard traffic
+    model (ops.exchange.cross_shard_traffic_bytes) must agree on the
+    default cap — the model's wire-byte claim is about the buffers the
+    plane actually sends."""
+    from ringpop_tpu.ops import exchange as exch
+
+    for n, shards in ((64, 8), (64, 1), (1024, 4), (1_000_000, 8)):
+        local = n // shards
+        assert (
+            exch.cross_shard_traffic_bytes(n, 16, shards)["cap"]
+            == pmesh.exchange_cap(local, shards)
+        )
+    # single shard: everything is local, cap = L, nothing crosses
+    m = exch.cross_shard_traffic_bytes(64, 16, 1)
+    assert m["interconnect_total"] == 0
+    # the cap never exceeds the local row count
+    assert pmesh.exchange_cap(8, 8) <= 8
+    assert pmesh.exchange_cap(125_000, 8) < 125_000
+
+
+def test_resolution_table_pinned():
+    """The FULL mesh-aware resolution table
+    (es.resolve_sharded_exchange) — the PR-5 silent drop-to-XLA is gone:
+    auto under a mesh picks the shard_map plane on every backend."""
+    table = {
+        ("auto", "tpu"): ("shard_map", "pallas"),
+        ("auto", "cpu"): ("shard_map", "xla"),
+        ("auto", "gpu"): ("shard_map", "xla"),
+        ("pallas", "tpu"): ("shard_map", "pallas"),
+        ("pallas", "cpu"): ("shard_map", "pallas"),
+        ("xla", "tpu"): ("gspmd", "xla"),
+        ("xla", "cpu"): ("gspmd", "xla"),
+        ("off", "tpu"): ("gspmd", "off"),
+        ("off", "cpu"): ("gspmd", "off"),
+    }
+    for (fe, backend), want in table.items():
+        params = es.ScalableParams(n=16, fused_exchange=fe)
+        for shards in (1, 8):
+            assert (
+                es.resolve_sharded_exchange(params, backend, shards)
+                == want
+            ), (fe, backend, shards)
+    with pytest.raises(ValueError):
+        es.resolve_sharded_exchange(
+            es.ScalableParams(n=16, fused_exchange="bogus"), "cpu", 8
+        )
+    with pytest.raises(ValueError):
+        es.resolve_sharded_exchange(es.ScalableParams(n=16), "cpu", 0)
+
+
+def test_resolution_observable_not_silent(eight_devices, tmp_path):
+    """Satellite 1: when "auto" resolves differently under a mesh than
+    single-device, the divergence lands as a mesh_exchange_resolution
+    runlog event + statsd gauge instead of the old silent drop."""
+    from ringpop_tpu.obs import RunRecorder
+    from ringpop_tpu.obs.statsd_bridge import StatsdBridge
+    from ringpop_tpu.utils.util import NullStatsd
+
+    n = 16
+    storm = pmesh.ShardedStorm(
+        n=n, mesh=pmesh.make_mesh(8), params=_params(n, u=160), seed=0
+    )
+    note = storm.exchange_resolution()
+    # the flag compares the KERNEL, not the routing mode: on CPU the
+    # single-device auto pick is "off" and the plane runs the xla twin
+    # — a real lowering change, flagged; on TPU both run the pallas
+    # megakernel — no divergence, flag 0 (the plane itself is not a
+    # drop).  Pinned backend-independently against the resolver.
+    assert note["mode"] == "shard_map"
+    single_pick = es.resolve_fused_exchange(
+        es.ScalableParams(n=n), jax.default_backend()
+    )
+    assert note["single_device_resolution"] == single_pick
+    assert note["differs_from_single_device"] == (
+        note["impl"] != single_pick
+    )
+    if jax.default_backend() != "tpu":
+        assert note["differs_from_single_device"] is True
+    rec = RunRecorder(str(tmp_path) + "/", run_id="meshres")
+    storm.attach_recorder(rec)
+    storm.step()
+    rec.finish()
+    rows = [
+        json.loads(line)
+        for line in open(rec.path, encoding="utf-8")
+        if line.strip()
+    ]
+    events = [
+        r
+        for r in rows
+        if r.get("kind") == "event"
+        and r.get("name") == "mesh_exchange_resolution"
+    ]
+    assert len(events) == 1
+    ev = events[0]
+    for field in (
+        "requested",
+        "mode",
+        "impl",
+        "shards",
+        "cap",
+        "single_device_resolution",
+        "differs_from_single_device",
+    ):
+        assert field in ev, field
+    assert ev["shards"] == 8 and ev["mode"] == "shard_map"
+
+    # the statsd face of the same note
+    sent = []
+
+    class _Capture(NullStatsd):
+        def gauge(self, key, value):
+            sent.append((key, value))
+
+    storm.emit_resolution_stat(
+        StatsdBridge(statsd=_Capture(), host_port="127.0.0.1:3000")
+    )
+    keys = dict(sent)
+    assert (
+        "ringpop.127_0_0_1_3000.sharded.exchange.resolution_differs"
+        in keys
+    )
+
+    # an explicit non-auto request never flags a divergence
+    twin = pmesh.ShardedStorm(
+        n=n,
+        mesh=pmesh.make_mesh(8),
+        params=_params(n, u=160, fused_exchange="xla"),
+        seed=0,
+    )
+    assert (
+        twin.exchange_resolution()["differs_from_single_device"] is False
+    )
+
+    # ...and the single-device driver reports its own (never-differing)
+    # resolution through the same shape
+    single = ScalableCluster(n=n, params=_params(n, u=160), seed=0)
+    snote = single.exchange_resolution()
+    assert snote["mode"] == "inline"
+    assert snote["differs_from_single_device"] is False
+
+
+def test_restore_across_shard_counts_mid_storm(eight_devices, tmp_path):
+    """Satellite 3: a PR-8 manifest checkpoint taken MID-STORM on a
+    4-shard mesh restores onto an 8-shard mesh (and the single-device
+    driver) and finishes the identical trajectory bitwise."""
+    n, ticks, cut = 64, 20, 10
+    params = _params(n)
+    sched = _storm_sched(ticks, n)
+
+    # uninterrupted single-device reference
+    ref = ScalableCluster(n=n, params=params, seed=4)
+    ref.run(_storm_sched(ticks, n))
+
+    # 4-shard run to the cut, manifest save (one file per shard)
+    a = pmesh.ShardedStorm(
+        n=n, mesh=pmesh.make_mesh(4), params=params, seed=4
+    )
+    a.run(_storm_sched(ticks, n).window(0, cut))
+    path = str(tmp_path / "midstorm")
+    a.save(path)
+
+    # restore at DIFFERENT shard counts, finish the storm
+    b = pmesh.ShardedStorm(
+        n=n, mesh=pmesh.make_mesh(8), params=params, seed=99
+    )
+    b.load(path)
+    b.run(_storm_sched(ticks, n).window(cut, ticks))
+    _assert_states_equal(ref.state, b.state, "8-shard resume: ")
+
+    c = ScalableCluster(n=n, params=params, seed=99)
+    c.load(path)
+    c.run(sched.window(cut, ticks))
+    _assert_states_equal(ref.state, c.state, "single resume: ")
+
+
+@pytest.mark.slow
+def test_explicit_pallas_plane_bitwise(eight_devices):
+    """An explicit fused_exchange="pallas" under a mesh runs the real
+    megakernel INSIDE the shard_map body (interpret mode off-TPU) —
+    bitwise-equal to the single-device engine.  Slow-marked only for the
+    interpret-mode kernel cost; on TPU this is the production path."""
+    n, ticks = 64, 8
+    single = _run_single(n, ticks)
+    storm = pmesh.ShardedStorm(
+        n=n,
+        mesh=pmesh.make_mesh(4),
+        params=_params(n, fused_exchange="pallas"),
+        seed=4,
+    )
+    assert (storm.exchange_mode, storm.exchange_impl) == (
+        "shard_map",
+        "pallas",
+    )
+    storm.run(_storm_sched(ticks, n))
+    _assert_states_equal(single.state, storm.state)
+
+
+@pytest.mark.slow
+def test_shard_count_invariance_n64k_slow(eight_devices):
+    """The at-scale version of the invariance gate: n=64k storm across
+    1/8 shards + the single-device engine, bitwise, including a restore
+    from a different shard count mid-storm."""
+    n, ticks, cut = 65536, 12, 6
+    params = es.ScalableParams(n=n, suspicion_ticks=5)
+    single = ScalableCluster(n=n, params=params, seed=4)
+    single.run(StormSchedule.churn_storm(ticks, n, fraction=0.1, seed=4))
+    for shards in (1, 8):
+        storm = pmesh.ShardedStorm(
+            n=n, mesh=pmesh.make_mesh(shards), params=params, seed=4
+        )
+        storm.run(
+            StormSchedule.churn_storm(ticks, n, fraction=0.1, seed=4)
+        )
+        _assert_states_equal(
+            single.state, storm.state, "shards=%d: " % shards
+        )
+
+
+@pytest.mark.slow
+def test_restore_across_shard_counts_mid_storm_n64k(
+    eight_devices, tmp_path
+):
+    n, ticks, cut = 65536, 12, 6
+    params = es.ScalableParams(n=n, suspicion_ticks=5)
+    ref = ScalableCluster(n=n, params=params, seed=4)
+    ref.run(StormSchedule.churn_storm(ticks, n, fraction=0.1, seed=4))
+    a = pmesh.ShardedStorm(
+        n=n, mesh=pmesh.make_mesh(8), params=params, seed=4
+    )
+    a.run(
+        StormSchedule.churn_storm(ticks, n, fraction=0.1, seed=4).window(
+            0, cut
+        )
+    )
+    path = str(tmp_path / "midstorm64k")
+    a.save(path)
+    b = pmesh.ShardedStorm(
+        n=n, mesh=pmesh.make_mesh(2), params=params, seed=9
+    )
+    b.load(path)
+    b.run(
+        StormSchedule.churn_storm(ticks, n, fraction=0.1, seed=4).window(
+            cut, ticks
+        )
+    )
+    _assert_states_equal(ref.state, b.state)

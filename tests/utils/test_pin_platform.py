@@ -1,0 +1,92 @@
+"""Forced-host-device setup goes through ONE place (round 14, ISSUE 10
+satellite): utils.util.force_host_device_count spells the device-count
+flag; pin_cpu_platform, tests/conftest.py, bench.py's mesh phase and
+tpu_measure.py's weak-scaling fallback all route through it."""
+
+import os
+import subprocess
+import sys
+from pathlib import Path
+
+import pytest
+
+from ringpop_tpu.utils import util
+
+REPO_ROOT = Path(__file__).resolve().parents[2]
+
+
+def test_force_host_device_count_env_only():
+    env = {"XLA_FLAGS": "--xla_foo=1 --xla_force_host_platform_device_count=2"}
+    util.force_host_device_count(8, env=env)
+    flags = env["XLA_FLAGS"].split()
+    # replaced, not appended — exactly one count flag, others preserved
+    assert flags.count("--xla_force_host_platform_device_count=8") == 1
+    assert "--xla_foo=1" in flags
+    assert not any(
+        f.startswith("--xla_force_host_platform_device_count=2")
+        for f in flags
+    )
+    assert env["JAX_NUM_CPU_DEVICES"] == "8"
+    # idempotent
+    util.force_host_device_count(8, env=env)
+    assert env["XLA_FLAGS"].split().count(
+        "--xla_force_host_platform_device_count=8"
+    ) == 1
+    with pytest.raises(ValueError):
+        util.force_host_device_count(0, env=env)
+
+
+def test_flag_spelled_in_exactly_one_place():
+    """The regression the satellite asks for: no driver hand-rolls the
+    flag assignment — the ``--...=N`` spelling lives in utils/util.py
+    alone (read-only containment checks, like conftest's, don't spell
+    the assignment)."""
+    needle = "--xla_force_host_platform_device_count"
+    offenders = []
+    for base in ("ringpop_tpu", "benchmarks", "scripts", "tests", "."):
+        root = REPO_ROOT / base
+        files = (
+            root.glob("*.py") if base == "." else root.rglob("*.py")
+        )
+        for path in files:
+            if path.name == "test_pin_platform.py":
+                continue
+            try:
+                text = path.read_text(encoding="utf-8")
+            except OSError:
+                continue
+            if needle in text and path != REPO_ROOT / "ringpop_tpu" / "utils" / "util.py":
+                offenders.append(str(path.relative_to(REPO_ROOT)))
+    assert offenders == [], (
+        "forced-host-device flag hand-rolled outside utils/util.py: %s"
+        % offenders
+    )
+
+
+def test_pin_cpu_platform_subprocess_regression():
+    """pin_cpu_platform(n) in a FRESH interpreter yields >= n virtual
+    CPU devices — the path the multichip dryrun and the tpu_measure /
+    bench forced-host fallbacks depend on."""
+    code = (
+        "import sys; sys.path.insert(0, %r)\n"
+        "from ringpop_tpu.utils.util import pin_cpu_platform\n"
+        "pin_cpu_platform(5)\n"
+        "import jax\n"
+        "assert jax.devices()[0].platform == 'cpu'\n"
+        "assert len(jax.devices()) >= 5, jax.devices()\n"
+        "print('OK', len(jax.devices()))\n" % str(REPO_ROOT)
+    )
+    env = {
+        k: v
+        for k, v in os.environ.items()
+        if k not in ("XLA_FLAGS", "JAX_NUM_CPU_DEVICES", "JAX_PLATFORMS")
+    }
+    out = subprocess.run(
+        [sys.executable, "-c", code],
+        env=env,
+        capture_output=True,
+        text=True,
+        timeout=120,
+    )
+    assert out.returncode == 0, out.stderr[-2000:]
+    assert out.stdout.startswith("OK")
