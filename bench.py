@@ -183,20 +183,29 @@ def _mode_rate(
             "measurement window (n=%d, mode=%s)" % (n, mode)
         )
 
+    from ringpop_tpu.obs import perf as obs_perf
+
     sched = (
         make_schedule(ticks, n)
         if make_schedule is not None
         else EventSchedule(ticks=ticks, n=n)
     )
-    sim.run(sched)  # compile + warm (a churn window ends reconverged)
+    obs_perf.fence(sim.run(sched))  # compile + warm (ends reconverged)
     jax.block_until_ready(sim.state)
 
     warm_replays = sim.parity_replays
-    t0 = time.perf_counter()
     with _profile_ctx(mode, recorder=recorder):
-        metrics = sim.run(sched)
+        # the shared warm-then-measure helper (obs.perf): fenced wall +
+        # a perf.phase runlog row stamped after the clock stops
+        metrics, elapsed = obs_perf.timed_window(
+            lambda: sim.run(sched),
+            warmup=0,
+            recorder=recorder,
+            phase="measure[%s]" % mode,
+            window=window,
+            n=n,
+        )
         jax.block_until_ready(sim.state)
-    elapsed = time.perf_counter() - t0
     if recorder is not None:
         # record AFTER the clock stops: the JSONL fold is host-side
         # Python and must not ride inside the measured window (the rate
@@ -303,18 +312,24 @@ def _scalable_rate(
     from ringpop_tpu.models.sim import engine_scalable as es
     from ringpop_tpu.models.sim.storm import ScalableCluster, StormSchedule
 
+    from ringpop_tpu.obs import perf as obs_perf
+
     params = es.ScalableParams(n=n, perm_impl=perm_impl)
     sc = ScalableCluster(n=n, params=params, seed=0)
     sched = StormSchedule.churn_storm(
         ticks, n, fraction=0.10, fail_tick=1, seed=0
     )
-    sc.run(sched)  # compile + warm (donated state: run overwrites it)
+    obs_perf.fence(sc.run(sched))  # compile + warm (donated state)
     jax.block_until_ready(sc.state)
-    t0 = time.perf_counter()
     with _profile_ctx("scalable-%s" % perm_impl, recorder=recorder):
-        ms = sc.run(sched)
+        ms, elapsed = obs_perf.timed_window(
+            lambda: sc.run(sched),
+            warmup=0,
+            recorder=recorder,
+            phase="measure[scalable:%s]" % sc.params.perm_impl,
+            n=n,
+        )
         jax.block_until_ready(sc.state)
-    elapsed = time.perf_counter() - t0
     if recorder is not None:
         # after the clock stops, like every other window
         recorder.record_event(
@@ -637,17 +652,24 @@ def _route_rate(
     from ringpop_tpu.models.route.plane import RoutedStorm, RouteParams
     from ringpop_tpu.models.sim import engine_scalable as es
 
+    from ringpop_tpu.obs import perf as obs_perf
+
     params = es.ScalableParams(n=n)
     route = RouteParams(n=n, queries_per_tick=q, ring_impl=ring_impl)
     rs = RoutedStorm(n, params=params, route=route, seed=0)
     sched = _sparse_churn_schedule(n, ticks, churn)
-    rs.run(sched)  # compile + warm (donated state: run overwrites it)
+    obs_perf.fence(rs.run(sched))  # compile + warm (donated state)
     jax.block_until_ready(rs.cluster.state)
-    t0 = time.perf_counter()
     with _profile_ctx("route-%s" % ring_impl, recorder=recorder):
-        em, rm = rs.run(sched)
+        (em, rm), elapsed = obs_perf.timed_window(
+            lambda: rs.run(sched),
+            warmup=0,
+            recorder=recorder,
+            phase="measure[route:%s]" % rs.route_params.ring_impl,
+            n=n,
+            q=q,
+        )
         jax.block_until_ready(rs.cluster.state)
-    elapsed = time.perf_counter() - t0
     if recorder is not None:
         recorder.record_event(
             "route_window",
@@ -663,6 +685,72 @@ def _route_rate(
         recorder.record_ticks(rows)
         recorder.record_phase("measure[route:%s]" % ring_impl, elapsed)
     return q * ticks / elapsed, elapsed, rs, rm
+
+
+def _hist_capture(
+    n: int, ticks: int, q: int, churn: int, recorder=None
+) -> dict:
+    """Round-15 performance-observatory capture: ONE histogram-enabled
+    routed storm (RouteParams.histograms + ScalableParams.histograms)
+    whose device-side log2-bucket counters are drained through
+    obs.histograms with exact p50/p95/p99 extraction — routing retry
+    depth / reroute hops / dirty-bucket sizes plus rumor propagation
+    latency and suspicion durations, logged as ``hist.drain`` runlog
+    events AND emitted as statsd TIMER keys (the emitted key list lands
+    in the artifact as proof).  A separate window from the measured
+    A/Bs: recording costs ride here, never inside a published rate."""
+    from ringpop_tpu.models.route.plane import RoutedStorm, RouteParams
+    from ringpop_tpu.models.sim import engine_scalable as es
+    from ringpop_tpu.obs.statsd_bridge import StatsdBridge
+
+    rs = RoutedStorm(
+        n,
+        params=es.ScalableParams(n=n, histograms=True),
+        route=RouteParams(n=n, queries_per_tick=q, histograms=True),
+        seed=0,
+    )
+    rs.run(_sparse_churn_schedule(n, ticks, churn))
+    # recorder attached AFTER the run: this window contributes ONLY its
+    # hist.drain events to the shared bench runlog — its per-tick rows
+    # (a different n than the measured A/B windows) must not mix into
+    # the A/Bs' counter stream
+    rs.recorder = recorder
+
+    class _Capture:  # in-memory statsd sink: the emitted-key proof
+        def __init__(self):
+            self.timings = []
+
+        def timing(self, key, value):
+            self.timings.append((key, value))
+
+        def increment(self, key, value=1):
+            pass
+
+        def gauge(self, key, value):
+            pass
+
+    cap = _Capture()
+    bridge = StatsdBridge(statsd=cap, host_port="127.0.0.1:3000")
+    summaries = rs.drain_histograms(statsd=bridge)
+    out = {"hist_n": n, "hist_ticks": ticks}
+    route_s = summaries.get("route", {})
+    sim_s = summaries.get("sim", {})
+    for track, prefix in (
+        ("retry_depth", "route_retry_depth"),
+        ("reroute_hops", "route_reroute_hops"),
+    ):
+        st = route_s.get(track, {})
+        for qq in ("p50", "p95", "p99"):
+            out["%s_%s" % (prefix, qq)] = st.get(qq)
+    for track, prefix in (
+        ("rumor_age", "scalable_rumor_age_ticks"),
+        ("suspicion_duration", "scalable_suspicion_ticks"),
+    ):
+        st = sim_s.get(track, {})
+        for qq in ("p50", "p95", "p99"):
+            out["%s_%s" % (prefix, qq)] = st.get(qq)
+    out["hist_statsd_timer_keys"] = sorted({k for k, _ in cap.timings})
+    return out
 
 
 def _ring_rebuild_ab(n: int, r: int, ticks: int, churn: int) -> dict:
@@ -739,16 +827,16 @@ def _ring_rebuild_ab(n: int, r: int, ticks: int, churn: int) -> dict:
 
     state0 = rk.full_rebuild(buckets, jnp.ones(n, bool))
 
-    def timed(fn, *args):
-        out = fn(*args)
-        jax.block_until_ready(out)
-        t0 = time.perf_counter()
-        out = fn(*args)
-        jax.block_until_ready(out)
-        return out, (time.perf_counter() - t0)
+    from ringpop_tpu.obs import perf as obs_perf
 
-    (st_inc, acc_inc), inc_s = timed(run_incremental, state0, jmasks)
-    (ring_full, acc_full), full_s = timed(run_full_sort, jmasks)
+    # the shared warm-then-measure loop (obs.perf.timed_window replaces
+    # this phase's hand-rolled warm/fence/measure sequence)
+    (st_inc, acc_inc), inc_s = obs_perf.timed_window(
+        lambda: run_incremental(state0, jmasks), warmup=1
+    )
+    (ring_full, acc_full), full_s = obs_perf.timed_window(
+        lambda: run_full_sort(jmasks), warmup=1
+    )
     flat_inc = np.asarray(rk.materialize(st_inc, n * r))
     return {
         "n": n,
@@ -812,15 +900,13 @@ def _batched_rate(b: int, n: int, ticks: int) -> tuple:
     from ringpop_tpu.models.sim.batched import BatchedSimClusters
     from ringpop_tpu.models.sim.cluster import EventSchedule
 
+    from ringpop_tpu.obs import perf as obs_perf
+
     bat = BatchedSimClusters(b=b, n=n, seed=0)
     bat.bootstrap()
     sched = EventSchedule(ticks=ticks, n=n)
-    bat.run(sched)  # compile + warm
+    ms, elapsed = obs_perf.timed_window(lambda: bat.run(sched), warmup=1)
     jax.block_until_ready(bat.state)
-    t0 = time.perf_counter()
-    ms = bat.run(sched)
-    jax.block_until_ready(bat.state)
-    elapsed = time.perf_counter() - t0
     return b * n * ticks / elapsed, elapsed, bool(
         np.asarray(ms.converged)[-1].all()
     )
@@ -1123,6 +1209,25 @@ def _measure_recorded(n: int, ticks: int, platform: str, recorder) -> dict:
                     bitwise_equal=ab["bitwise_equal"],
                     churn_per_tick=ab["churn_per_tick"],
                     bucket_bits=ab["bucket_bits"],
+                )
+            # round-15 histogram capture (BENCH_HIST=0 opts out): its
+            # own window, so the recording cost never rides inside a
+            # published rate; p50/p95/p99 for routing retry depth and
+            # rumor propagation latency land in the artifact, the
+            # runlog (hist.drain) and the statsd timer-key list
+            if os.environ.get("BENCH_HIST", "1") == "1":
+                hn = int(
+                    os.environ.get("BENCH_HIST_N", str(min(rn, 20000)))
+                )
+                result.update(
+                    _retry_helper_500(
+                        _hist_capture,
+                        hn,
+                        rticks,
+                        rq,
+                        rchurn,
+                        recorder=recorder,
+                    )
                 )
         except Exception as exc:
             if _is_transient(exc):

@@ -896,6 +896,77 @@ def phase_route(results: dict) -> None:
         )
 
 
+def phase_observatory(results: dict) -> None:
+    """Round-15 performance observatory on-chip: (a) device-side
+    latency-histogram capture at 1M — a hist-enabled routed storm whose
+    drained p50/p95/p99 (routing retry depth / reroute hops, rumor
+    propagation latency, suspicion durations) are banked for the chip
+    session, and (b) host dispatch-timer phase breakdowns of the 1M
+    scalable storm (compile-vs-warm split via the jit-cache probe, warm
+    wall percentiles per phase) — the per-phase attribution ROADMAP
+    item 5 asks this session to bank."""
+    import sys
+
+    import jax
+
+    sys.path.insert(
+        0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    )
+    import bench as bench_mod
+
+    from ringpop_tpu.models.sim import engine_scalable as es
+    from ringpop_tpu.models.sim.storm import ScalableCluster, StormSchedule
+    from ringpop_tpu.obs import perf as obs_perf
+
+    if _todo(results, "observatory_hist_1m"):
+        try:
+            results["observatory_hist_1m"] = bench_mod._hist_capture(
+                1_000_000, 16, 1 << 18, 32
+            )
+        except Exception as e:
+            results["observatory_hist_1m"] = {"error": str(e)[:300]}
+        print(
+            json.dumps(
+                {"observatory_hist_1m": results["observatory_hist_1m"]}
+            ),
+            flush=True,
+        )
+
+    if _todo(results, "observatory_phase_timing_1m"):
+        try:
+            n, ticks = 1_000_000, 16
+            sc = ScalableCluster(
+                n=n, params=es.ScalableParams(n=n, u=512), seed=0
+            )
+            timer = obs_perf.wrap_cluster(sc)
+            sched = StormSchedule.churn_storm(
+                ticks, n, fraction=0.10, fail_tick=1, seed=0
+            )
+            for _ in range(4):  # 1 compile-carrying + 3 warm scans
+                sc.run(sched)
+            jax.block_until_ready(sc.state)
+            results["observatory_phase_timing_1m"] = {
+                "n": n,
+                "ticks": ticks,
+                "phases": timer.summary(),
+                "protocol_delay_ms": timer.protocol_delay_ms("scan"),
+            }
+        except Exception as e:
+            results["observatory_phase_timing_1m"] = {
+                "error": str(e)[:300]
+            }
+        print(
+            json.dumps(
+                {
+                    "observatory_phase_timing_1m": results[
+                        "observatory_phase_timing_1m"
+                    ]
+                }
+            ),
+            flush=True,
+        )
+
+
 def phase_ckpt(results: dict) -> None:
     """Round-13 recovery plane on-chip: checkpoint-cadence overhead and
     save/restore MB/s at n=1M (device->host gather + atomic manifest
@@ -1214,6 +1285,7 @@ def main() -> int:
         ("fused_exchange", phase_fused_exchange),
         ("weak_scaling", phase_weak_scaling),
         ("route", phase_route),
+        ("observatory", phase_observatory),
         ("ckpt", phase_ckpt),
         ("epidemic_100k", phase_epidemic_100k),
         ("batched", phase_batched),

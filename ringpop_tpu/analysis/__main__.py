@@ -79,8 +79,10 @@ def main(argv: Optional[List[str]] = None) -> int:
         "--prong",
         default="ast,jaxpr",
         help=(
-            "comma list of prongs to run: ast, jaxpr, retrace "
-            "(or 'all'; default ast,jaxpr)"
+            "comma list of prongs to run: ast, jaxpr, retrace, cost "
+            "(or 'all'; default ast,jaxpr — retrace/cost compile real "
+            "entry points and are opt-in; CI runs them via "
+            "scripts/check_retrace_budget.py / check_cost_budget.py)"
         ),
     )
     parser.add_argument(
@@ -105,6 +107,7 @@ def main(argv: Optional[List[str]] = None) -> int:
         print(
             "\njaxpr prong: callback-primitive, wide-dtype-on-hash-path, "
             "trace-failure\nretrace prong: retrace-budget"
+            "\ncost prong: cost-budget, cost-failure"
         )
         print(
             "\nsuppress per line with  # jaxgate: ignore[rule-a,rule-b]  "
@@ -114,11 +117,11 @@ def main(argv: Optional[List[str]] = None) -> int:
         return 0
 
     prongs = (
-        {"ast", "jaxpr", "retrace"}
+        {"ast", "jaxpr", "retrace", "cost"}
         if args.prong.strip() == "all"
         else {p.strip() for p in args.prong.split(",") if p.strip()}
     )
-    unknown = prongs - {"ast", "jaxpr", "retrace"}
+    unknown = prongs - {"ast", "jaxpr", "retrace", "cost"}
     if unknown:
         parser.error(f"unknown prong(s): {sorted(unknown)}")
 
@@ -180,6 +183,14 @@ def main(argv: Optional[List[str]] = None) -> int:
 
         path = Path(args.budget) if args.budget else None
         all_findings.extend(retrace.check_against_manifest(path=path))
+
+    if "cost" in prongs:
+        from ringpop_tpu.analysis import cost
+
+        # --budget names the RETRACE manifest; the cost prong always
+        # reads the repo-root COST_BUDGET.json here (the script exposes
+        # its own --budget for alternate paths)
+        all_findings.extend(cost.check_against_manifest())
 
     if args.format == "json":
         print(fmod.render_json(all_findings))

@@ -71,6 +71,9 @@ TRACED_ENTRIES: Dict[str, Set[str]] = {
         "fused_stream_xla",
     },
     "ops/record_mix.py": {"record_mix"},
+    # the round-15 device histogram primitives: called from every
+    # histogram-enabled tick (both engines + the routing plane)
+    "ops/histogram.py": {"init", "bucket_index", "record", "record_count"},
     "models/ring/device.py": {
         "build_ring",
         "lookup",

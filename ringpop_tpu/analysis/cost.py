@@ -1,0 +1,322 @@
+"""jaxgate prong C: static cost manifest of the compiled entry points.
+
+The retrace prong (retrace.py) pins COMPILE COUNTS; this prong pins what
+those compiles COST.  Every auditable entry point (the jaxpr prong's
+registry, jaxpr_audit.DEFAULT_ENTRIES) is lowered AND compiled at its
+toy shape and XLA's own static cost model is extracted:
+
+- ``compiled.cost_analysis()`` — flops and bytes accessed,
+- ``compiled.memory_analysis()`` — argument/output/temp/code sizes
+  (peak device memory = args + outputs + temps).
+
+The numbers go into a committed ``COST_BUDGET.json`` diffed in tier-1
+exactly like ANALYSIS_BUDGET.json: an accidental O(N^2) blowup, a
+widened dtype doubling HBM traffic, or a new temp buffer shows up as a
+manifest drift and fails CI — with no chip and no wall-clock
+measurement.  Regenerate with ``scripts/check_cost_budget.py --write``
+after an INTENTIONAL cost change (a reviewed diff of the manifest IS
+the perf review).
+
+Backend scope: XLA's cost model is backend-specific, so the manifest
+records the backend it was generated on and entries are only compared
+on a matching backend (the tier-1 gate runs on CPU; a chip session can
+bank a TPU manifest side by side via ``--budget``).  Pallas-lowered
+entries are excluded off-TPU (they trace but do not compile there).
+
+Tolerance: compilation is deterministic for a fixed jax/XLA build, but
+the gate compares with a small relative tolerance (``DEFAULT_RTOL``) so
+byte-level scheduler jitter between environments never flakes CI —
+the regressions this gate exists for (dtype widenings = 2x, O(N) ->
+O(N^2) = 8x at the n=8 toys... ) are far outside it.
+"""
+
+from __future__ import annotations
+
+import json
+from pathlib import Path
+from typing import Dict, Iterable, List, Optional
+
+from ringpop_tpu.analysis.findings import Finding
+
+MANIFEST_NAME = "COST_BUDGET.json"
+DEFAULT_RTOL = 0.1
+
+# cost_analysis keys we pin (stable across jax 0.4.x CPU/TPU); the
+# per-operand "bytes accessedN{}" breakdown is backend-noise and skipped
+_COST_KEYS = {"flops": "flops", "bytes accessed": "bytes_accessed"}
+_MEM_ATTRS = (
+    "argument_size_in_bytes",
+    "output_size_in_bytes",
+    "temp_size_in_bytes",
+)
+
+
+def _entry_names_for_backend(backend: str) -> List[str]:
+    from ringpop_tpu.analysis import jaxpr_audit as ja
+
+    names = []
+    for ep in ja.DEFAULT_ENTRIES:
+        if backend != "tpu" and "pallas" in ep.name:
+            continue  # traces everywhere, compiles only on TPU
+        names.append(ep.name)
+    return names
+
+
+def collect_costs(
+    entry_names: Optional[Iterable[str]] = None,
+) -> Dict[str, dict]:
+    """Compile each named entry point and extract its static costs.
+
+    Returns ``name -> {flops, bytes_accessed, argument_size_in_bytes,
+    output_size_in_bytes, temp_size_in_bytes, peak_bytes}`` — or
+    ``name -> {"error": ...}`` for an entry that failed to build or
+    compile (compare_to_manifest turns that into a finding;
+    write_manifest refuses it)."""
+    import jax
+
+    from ringpop_tpu.analysis import jaxpr_audit as ja
+
+    backend = jax.default_backend()
+    wanted = (
+        set(entry_names)
+        if entry_names is not None
+        else set(_entry_names_for_backend(backend))
+    )
+    by_name = {ep.name: ep for ep in ja.DEFAULT_ENTRIES}
+    out: Dict[str, dict] = {}
+    for name in sorted(wanted):
+        ep = by_name.get(name)
+        if ep is None:
+            out[name] = {"error": "unknown entry point"}
+            continue
+        try:
+            fn, args = ep.build()
+            compiled = jax.jit(fn).lower(*args).compile()
+            out[name] = _extract(compiled)
+        except Exception as e:
+            out[name] = {"error": "%s: %s" % (type(e).__name__, e)}
+    return out
+
+
+def _extract(compiled) -> dict:
+    entry: Dict[str, float] = {}
+    ca = compiled.cost_analysis()
+    if isinstance(ca, (list, tuple)):  # jax <= 0.4.x returns [dict]
+        ca = ca[0] if ca else {}
+    if isinstance(ca, dict):
+        for src, dst in _COST_KEYS.items():
+            v = ca.get(src)
+            if v is not None:
+                entry[dst] = int(round(float(v)))
+    try:
+        ma = compiled.memory_analysis()
+    except Exception:
+        ma = None
+    if ma is not None:
+        peak = 0
+        for attr in _MEM_ATTRS:
+            v = getattr(ma, attr, None)
+            if v is not None:
+                entry[attr] = int(v)
+                peak += int(v)
+        entry["peak_bytes"] = peak
+    if not entry:
+        return {"error": "backend exposed no cost/memory analysis"}
+    return entry
+
+
+def _drifted(actual: float, expected: float, rtol: float) -> bool:
+    if actual == expected:
+        return False
+    scale = max(abs(expected), 1.0)
+    return abs(actual - expected) > rtol * scale
+
+
+def compare_to_manifest(
+    actual: Dict[str, dict], manifest: dict, rtol: float = DEFAULT_RTOL
+) -> List[Finding]:
+    """Findings for every drift/failure between collected costs and the
+    committed manifest.  Entries present in only one side are findings
+    too (a new entry point must be banked; a removed one must be
+    retired intentionally) — callers comparing a SUBSET pass only the
+    matching manifest slice (scripts/check_cost_budget.py --entries,
+    tests/analysis/test_cost_budget.py's cheap-probe gate)."""
+    findings: List[Finding] = []
+    expected = manifest.get("entries", {})
+    for name, exp in sorted(expected.items()):
+        act = actual.get(name)
+        if act is None:
+            findings.append(
+                Finding(
+                    rule="cost-budget",
+                    path="<entry:%s>" % name,
+                    line=0,
+                    message="entry in manifest but not measured",
+                    prong="cost",
+                )
+            )
+            continue
+        if "error" in act:
+            findings.append(
+                Finding(
+                    rule="cost-failure",
+                    path="<entry:%s>" % name,
+                    line=0,
+                    message="entry failed to compile: %s" % act["error"],
+                    prong="cost",
+                )
+            )
+            continue
+        for key in sorted(set(exp) | set(act)):
+            ev, av = exp.get(key), act.get(key)
+            if ev is None or av is None:
+                findings.append(
+                    Finding(
+                        rule="cost-budget",
+                        path="<entry:%s>" % name,
+                        line=0,
+                        message=(
+                            "metric %r present on only one side "
+                            "(manifest %r, measured %r)" % (key, ev, av)
+                        ),
+                        prong="cost",
+                    )
+                )
+            elif _drifted(av, ev, rtol):
+                direction = (
+                    "cost regression" if av > ev else "stale manifest"
+                )
+                findings.append(
+                    Finding(
+                        rule="cost-budget",
+                        path="<entry:%s>" % name,
+                        line=0,
+                        message=(
+                            "%s: measured %d vs manifest %d "
+                            "(%+.1f%%) — %s; regenerate with "
+                            "scripts/check_cost_budget.py --write if "
+                            "intentional"
+                            % (
+                                key,
+                                av,
+                                ev,
+                                100.0 * (av - ev) / max(ev, 1),
+                                direction,
+                            )
+                        ),
+                        prong="cost",
+                    )
+                )
+    for name in sorted(set(actual) - set(expected)):
+        act = actual[name]
+        findings.append(
+            Finding(
+                rule="cost-failure" if "error" in act else "cost-budget",
+                path="<entry:%s>" % name,
+                line=0,
+                message=(
+                    "entry failed to compile: %s" % act["error"]
+                    if "error" in act
+                    else (
+                        "entry has no manifest entry — regenerate with "
+                        "scripts/check_cost_budget.py --write"
+                    )
+                ),
+                prong="cost",
+            )
+        )
+    return findings
+
+
+def manifest_path(root: Optional[Path] = None) -> Path:
+    if root is None:
+        root = Path(__file__).resolve().parents[2]
+    return root / MANIFEST_NAME
+
+
+def load_manifest(path: Optional[Path] = None) -> dict:
+    with open(path or manifest_path()) as f:
+        return json.load(f)
+
+
+def write_manifest(
+    actual: Dict[str, dict], path: Optional[Path] = None
+) -> Path:
+    """Commit collected costs.  REFUSES entries that failed to compile —
+    a manifest must never paper over a broken entry point."""
+    import jax
+
+    broken = {
+        name: e["error"] for name, e in actual.items() if "error" in e
+    }
+    if broken:
+        raise ValueError(
+            "refusing to write a manifest with failed entries: %r"
+            % (broken,)
+        )
+    p = path or manifest_path()
+    doc = {
+        "version": 1,
+        "backend": jax.default_backend(),
+        "jax_version": jax.__version__,
+        "note": (
+            "jaxgate static cost budget: XLA cost_analysis/"
+            "memory_analysis of every auditable entry point at its toy "
+            "shape (see ringpop_tpu/analysis/cost.py).  Regenerate with "
+            "scripts/check_cost_budget.py --write after an INTENTIONAL "
+            "cost change; the diff of this file is the perf review."
+        ),
+        "entries": actual,
+    }
+    with open(p, "w") as f:
+        json.dump(doc, f, indent=2, sort_keys=True)
+        f.write("\n")
+    return p
+
+
+def check_against_manifest(
+    entry_names: Optional[Iterable[str]] = None,
+    path: Optional[Path] = None,
+    rtol: float = DEFAULT_RTOL,
+) -> List[Finding]:
+    """The gate: collect + diff.  A manifest generated on a different
+    backend is skipped (finding-free) — cost models do not transfer
+    across backends; each banks its own manifest."""
+    import jax
+
+    try:
+        manifest = load_manifest(path)
+    except FileNotFoundError:
+        return [
+            Finding(
+                rule="cost-budget",
+                path=MANIFEST_NAME,
+                line=0,
+                message=(
+                    "manifest missing — generate with "
+                    "scripts/check_cost_budget.py --write"
+                ),
+                prong="cost",
+            )
+        ]
+    if manifest.get("backend") != jax.default_backend():
+        return []
+    explicit_subset = entry_names is not None
+    if entry_names is None:
+        entry_names = _entry_names_for_backend(jax.default_backend())
+    names = list(entry_names)
+    actual = collect_costs(names)
+    if explicit_subset:
+        # a caller-chosen subset (tier-1 cheap probes, --entries) diffs
+        # only the matching manifest slice
+        sliced = dict(manifest)
+        sliced["entries"] = {
+            k: v
+            for k, v in manifest.get("entries", {}).items()
+            if k in names
+        }
+        return compare_to_manifest(actual, sliced, rtol=rtol)
+    # full run: the WHOLE manifest is in scope, so a stale entry for a
+    # removed entry point is a finding ("in manifest but not measured")
+    # instead of being silently sliced away
+    return compare_to_manifest(actual, manifest, rtol=rtol)

@@ -318,7 +318,9 @@ def _toy_universe(n: int = 8):
     )
 
 
-def _sim_setup(n: int = 8, flight_recorder: bool = False):
+def _sim_setup(
+    n: int = 8, flight_recorder: bool = False, histograms: bool = False
+):
     import jax
 
     from ringpop_tpu.models.sim import engine
@@ -329,6 +331,7 @@ def _sim_setup(n: int = 8, flight_recorder: bool = False):
         hash_impl="scan",
         flight_recorder=flight_recorder,
         event_capacity=256 if flight_recorder else 65536,
+        histograms=histograms,
     )
     params = engine.resolve_auto_parity(params, jax.default_backend())
     state = engine.init_state(params, seed=0, universe=universe)
@@ -336,13 +339,13 @@ def _sim_setup(n: int = 8, flight_recorder: bool = False):
 
 
 def _entry_engine_tick_scan(
-    flight_recorder: bool = False,
+    flight_recorder: bool = False, histograms: bool = False
 ) -> Tuple[Callable, Tuple]:
     import jax
     import jax.numpy as jnp
 
     engine, params, universe, state = _sim_setup(
-        8, flight_recorder=flight_recorder
+        8, flight_recorder=flight_recorder, histograms=histograms
     )
     n, t = 8, 2
     inputs = engine.TickInputs(
@@ -365,6 +368,7 @@ def _entry_engine_scalable_tick(
     wavefront: bool = False,
     perm_impl: str = "auto",
     fused_exchange: str = "auto",
+    histograms: bool = False,
 ) -> Tuple[Callable, Tuple]:
     from ringpop_tpu.models.sim import engine_scalable as es
 
@@ -374,6 +378,7 @@ def _entry_engine_scalable_tick(
         wavefront=wavefront,
         perm_impl=perm_impl,
         fused_exchange=fused_exchange,
+        histograms=histograms,
     )
     state = es.init_state(params, seed=0)
     inputs = es.ChurnInputs.quiet(8)
@@ -560,7 +565,13 @@ def _entry_ring_device() -> Tuple[Callable, Tuple]:
     return _ring_fn(), _ring_args()
 
 
-def _route_fixture(impl: str, n: int = 8, r: int = 4, seed: int = 4):
+def _route_fixture(
+    impl: str,
+    n: int = 8,
+    r: int = 4,
+    seed: int = 4,
+    histograms: bool = False,
+):
     """Small routing-plane fixture shared by the route-tick entries and
     the retrace probe: buckets/reps/cdf constants + one RouteState."""
     import jax
@@ -579,6 +590,7 @@ def _route_fixture(impl: str, n: int = 8, r: int = 4, seed: int = 4):
         ring_impl=impl,
         max_changed=4,
         max_dirty=4,
+        histograms=histograms,
     )
     reps_np = np.asarray(ringdev.device_replica_hashes(n, r))
     buckets = ring_kernel.build_buckets(reps_np, params.bucket_bits)
@@ -597,12 +609,16 @@ def _route_fixture(impl: str, n: int = 8, r: int = 4, seed: int = 4):
     )
 
 
-def _entry_route_tick(impl: str) -> Tuple[Callable, Tuple]:
+def _entry_route_tick(
+    impl: str, histograms: bool = False
+) -> Tuple[Callable, Tuple]:
     """The routing plane's scanned tick (ISSUE 6): Zipf traffic draw,
     bucketed/sort-twin ring refresh, batched lookups and the misroute/
     keys-diverged/checksum-reject counters must all stay callback-free
     with the ring-key dataflow in integer lanes."""
-    plane, params, buckets, reps, cdf, state, dyn = _route_fixture(impl)
+    plane, params, buckets, reps, cdf, state, dyn = _route_fixture(
+        impl, histograms=histograms
+    )
 
     def one(state, in_ring, proc_alive, checksums):
         return plane.route_tick(
@@ -686,10 +702,22 @@ DEFAULT_ENTRIES: List[EntryPoint] = [
         "engine-tick-scan-flight-recorder",
         lambda: _entry_engine_tick_scan(flight_recorder=True),
     ),
+    # the round-15 performance observatory: the latency-histogram-
+    # enabled scanned ticks must stay callback-free (the whole point of
+    # device-side histograms is percentile telemetry without host
+    # round-trips) with the hash dataflow in uint32 lanes
+    EntryPoint(
+        "engine-tick-scan-histograms",
+        lambda: _entry_engine_tick_scan(histograms=True),
+    ),
     EntryPoint("engine-scalable-tick", _entry_engine_scalable_tick),
     EntryPoint(
         "engine-scalable-tick-wavefront",
         lambda: _entry_engine_scalable_tick(wavefront=True),
+    ),
+    EntryPoint(
+        "engine-scalable-tick-histograms",
+        lambda: _entry_engine_scalable_tick(histograms=True),
     ),
     # the round-10 hot-path rewrite: the sortless-PRP + fused-exchange
     # tick must hold the same purity/uint32 gates as the classic shape
@@ -727,6 +755,10 @@ DEFAULT_ENTRIES: List[EntryPoint] = [
         lambda: _entry_route_tick("incremental"),
     ),
     EntryPoint("route-tick-full", lambda: _entry_route_tick("full")),
+    EntryPoint(
+        "route-tick-histograms",
+        lambda: _entry_route_tick("incremental", histograms=True),
+    ),
     EntryPoint(
         "route-ring-incremental", _entry_route_ring_incremental
     ),

@@ -65,6 +65,20 @@ from ringpop_tpu.models.sim import engine_scalable as es
 from ringpop_tpu.models.sim.recovery import CheckpointableMixin, CheckpointSpec
 
 
+# Latency-histogram track layout (RouteParams.histograms;
+# RouteState.hist rows, in order):
+# - retry_depth: per routed request, retry rounds taken — 0 (stale
+#   owner == truth owner and no checksum reject) or 1 (the modeled
+#   single stale->truth retry fired: misroute or consistency reject).
+# - reroute_hops: per routed request, forwarding hops — 1 for a direct
+#   hit or a local reroute (the retry lands on the sender itself,
+#   send.js:190-198), 2 when the retry re-forwarded to a new remote
+#   owner (send.js:181-189).
+# - dirty_buckets: per tick, the incremental ring update's dirty-bucket
+#   count (the re-merge work size) — one observation per tick.
+ROUTE_HIST_TRACKS = ("retry_depth", "reroute_hops", "dirty_buckets")
+
+
 class RouteParams(NamedTuple):
     n: int
     replica_points: int = 16
@@ -88,6 +102,12 @@ class RouteParams(NamedTuple):
     max_changed: int = 128
     max_dirty: int = 512
     salt: int = 0x520337
+    # Device-side latency histograms (ops/histogram.py; see
+    # ROUTE_HIST_TRACKS): per-request retry depth and forwarding hop
+    # counts + per-tick dirty-bucket sizes, recorded under the same
+    # masks that drive the counters — identical across ring impls (the
+    # masks are), write-only (RouteState.hist), off by default.
+    histograms: bool = False
 
 
 class RouteState(NamedTuple):
@@ -101,6 +121,10 @@ class RouteState(NamedTuple):
     flat_ring: Optional[jax.Array]  # [N*R] uint64
     mask: Optional[jax.Array]  # [N] bool (full impl only)
     rng: jax.Array  # threefry key
+    # latency-histogram plane (RouteParams.histograms only, else None):
+    # [len(ROUTE_HIST_TRACKS), NBUCKETS] uint32, write-only — NOT part
+    # of the checkpointed RouteCarry (telemetry resets on restore)
+    hist: Optional[jax.Array] = None
 
 
 class RouteCarry(NamedTuple):
@@ -170,18 +194,25 @@ def init_route_state(
 ) -> RouteState:
     impl = resolve_ring_impl(params, jax.default_backend())
     rng = jax.random.PRNGKey(np.uint32(seed) ^ np.uint32(params.salt))
+    hist = None
+    if params.histograms:
+        from ringpop_tpu.ops import histogram as hg
+
+        hist = hg.init(len(ROUTE_HIST_TRACKS))
     if impl == "incremental":
         return RouteState(
             ring=rk.full_rebuild(buckets, in_ring),
             flat_ring=None,
             mask=None,
             rng=rng,
+            hist=hist,
         )
     return RouteState(
         ring=None,
         flat_ring=ringdev.build_ring(reps, in_ring),
         mask=in_ring,
         rng=rng,
+        hist=hist,
     )
 
 
@@ -227,7 +258,11 @@ def route_tick(
 
         ring_points = truth_ring.n_points
         new_state = RouteState(
-            ring=truth_ring, flat_ring=None, mask=None, rng=rng_next
+            ring=truth_ring,
+            flat_ring=None,
+            mask=None,
+            rng=rng_next,
+            hist=state.hist,
         )
     else:  # "full": the per-tick jnp.sort twin
         # same stats the incremental path WOULD report (shared helper,
@@ -249,7 +284,11 @@ def route_tick(
             return ringdev.lookup(truth_flat, ring_points, kh)
 
         new_state = RouteState(
-            ring=None, flat_ring=truth_flat, mask=in_ring, rng=rng_next
+            ring=None,
+            flat_ring=truth_flat,
+            mask=in_ring,
+            rng=rng_next,
+            hist=state.hist,
         )
 
     # -- traffic ---------------------------------------------------------
@@ -286,6 +325,25 @@ def route_tick(
 
     def cnt(mask):
         return jnp.sum(mask, dtype=jnp.int32)
+
+    # -- latency histograms (opt-in; write-only; identical across ring
+    # impls because every mask above is) --------------------------------
+    if params.histograms and state.hist is not None:
+        from ringpop_tpu.ops import histogram as hg
+
+        hist = state.hist
+        depth = retried.astype(jnp.int32)
+        hist = hg.record(
+            hist, ROUTE_HIST_TRACKS.index("retry_depth"), depth, sendable
+        )
+        hops = jnp.int32(1) + reroute_remote.astype(jnp.int32)
+        hist = hg.record(
+            hist, ROUTE_HIST_TRACKS.index("reroute_hops"), hops, sendable
+        )
+        hist = hg.record_count(
+            hist, ROUTE_HIST_TRACKS.index("dirty_buckets"), n_dirty
+        )
+        new_state = new_state._replace(hist=hist)
 
     return new_state, RouteMetrics(
         route_queries=cnt(sendable),
@@ -473,6 +531,55 @@ class RoutedStorm(CheckpointableMixin):
         rows.update(rm._asdict())
         self.recorder.record_ticks(rows)
 
+    def drain_histograms(self, reset: bool = True, statsd=None):
+        """Drain BOTH histogram planes — the routing plane's
+        (RouteState.hist: retry depth / hops / dirty buckets) and the
+        membership engine's (ScalableState.hist, when on) — into
+        ``{"route": ..., "sim": ...}`` summaries.  One ``hist.drain``
+        event row per present source on the attached recorder; ``statsd``
+        (a StatsdBridge) additionally emits the percentiles as timer
+        keys (requestProxy.retry.depth / requestProxy.hops / ...)."""
+        from ringpop_tpu.obs import histograms as oh
+
+        if self.rstate.hist is None and self.cluster.state.hist is None:
+            raise ValueError(
+                "histograms are off — construct with "
+                "RouteParams(histograms=True) and/or "
+                "ScalableParams(histograms=True)"
+            )
+        out = {}
+        if self.rstate.hist is not None:
+            out["route"] = oh.drain(
+                self.rstate.hist,
+                ROUTE_HIST_TRACKS,
+                "route",
+                recorder=self.recorder,
+                statsd=statsd,
+            )
+            if reset:
+                from ringpop_tpu.ops import histogram as hg
+
+                self.rstate = self.rstate._replace(
+                    hist=hg.init(len(ROUTE_HIST_TRACKS))
+                )
+        if self.cluster.state.hist is not None:
+            # the engine half emits against the STORM recorder (the
+            # inner cluster's is usually unset — RoutedStorm owns the log)
+            out["sim"] = oh.drain(
+                self.cluster.state.hist,
+                es.SCALABLE_HIST_TRACKS,
+                "sim.engine_scalable",
+                recorder=self.recorder,
+                statsd=statsd,
+            )
+            if reset:
+                from ringpop_tpu.ops import histogram as hg
+
+                self.cluster.state = self.cluster.state._replace(
+                    hist=hg.init(len(es.SCALABLE_HIST_TRACKS))
+                )
+        return out
+
     # -- inspection -------------------------------------------------------
 
     def truth_ring(self) -> jax.Array:
@@ -507,18 +614,26 @@ class RoutedStorm(CheckpointableMixin):
     def _rebuild_route_state(self, carry: RouteCarry) -> RouteState:
         mask = jnp.asarray(carry.mask)
         rng = jnp.asarray(carry.rng)
+        hist = None
+        if self.route_params.histograms:
+            # telemetry, not trajectory: a restore starts fresh counters
+            from ringpop_tpu.ops import histogram as hg
+
+            hist = hg.init(len(ROUTE_HIST_TRACKS))
         if self.route_params.ring_impl == "incremental":
             return RouteState(
                 ring=rk.full_rebuild(self.buckets, mask),
                 flat_ring=None,
                 mask=None,
                 rng=rng,
+                hist=hist,
             )
         return RouteState(
             ring=None,
             flat_ring=ringdev.build_ring(self.reps, mask),
             mask=mask,
             rng=rng,
+            hist=hist,
         )
 
     def _ckpt_spec(self) -> CheckpointSpec:

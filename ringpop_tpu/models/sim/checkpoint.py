@@ -71,6 +71,11 @@ _TRAJECTORY_NEUTRAL_PARAMS = frozenset(
         "flight_recorder",
         "event_capacity",
         "wavefront",
+        # latency-histogram plane (round 15): same write-only telemetry
+        # contract as the flight recorder — counters start fresh on a
+        # toggled resume (fixup_sim_state / fixup_scalable_state /
+        # RoutedStorm._rebuild_route_state)
+        "histograms",
         # round-10 scalable hot path: both knobs are bit-identical by
         # the gate-equivalence tests (tests/models/test_scalable_perm.py),
         # and drivers pin backend-resolved values at construction — a

@@ -205,6 +205,14 @@ def fixup_sim_state(
         state = state._replace(
             ev_buf=None, ev_head=None, ev_drops=None, first_heard=None
         )
+    # latency-histogram plane: telemetry like the flight recorder — a
+    # resume may toggle it; counters start fresh either way
+    if params.histograms and state.hist is None:
+        from ringpop_tpu.ops import histogram as hg
+
+        state = state._replace(hist=hg.init(len(engine.HIST_TRACKS)))
+    elif not params.histograms and state.hist is not None:
+        state = state._replace(hist=None)
     return state
 
 
@@ -431,6 +439,38 @@ class SimCluster(CheckpointableMixin):
                 ev_head=jnp.int32(0), ev_drops=jnp.int32(0)
             )
         return decoded
+
+    # -- latency histograms (SimParams.histograms) ------------------------
+
+    def drain_histograms(self, reset: bool = True, statsd=None):
+        """Drain the device-side latency histograms (SimState.hist) into
+        per-track summaries with exact p50/p95/p99 extraction
+        (obs.histograms).  Logs a ``hist.drain`` event row on the
+        attached RunRecorder; ``statsd`` (a StatsdBridge) additionally
+        emits the percentiles as timer keys.  ``reset`` zeroes the
+        counters for the next window AFTER the sinks ran — protocol
+        state is untouched, so draining mid-run is trajectory-neutral."""
+        if self.state.hist is None:
+            raise ValueError(
+                "histograms are off — construct with "
+                "SimParams(histograms=True)"
+            )
+        from ringpop_tpu.obs import histograms as oh
+
+        summary = oh.drain(
+            self.state.hist,
+            engine.HIST_TRACKS,
+            "sim.engine",
+            recorder=self.recorder,
+            statsd=statsd,
+        )
+        if reset:
+            from ringpop_tpu.ops import histogram as hg
+
+            self.state = self.state._replace(
+                hist=hg.init(len(engine.HIST_TRACKS))
+            )
+        return summary
 
     def event_drops(self) -> int:
         """Overflow honesty: events dropped since the last drain."""

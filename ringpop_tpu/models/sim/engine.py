@@ -90,6 +90,30 @@ ALIVE, SUSPECT, FAULTY, LEAVE = 0, 1, 2, 3
 # broken/held; device init belongs to callers)
 NO_TARGET = np.int32(-1)
 
+# Latency-histogram track layout (SimParams.histograms; SimState.hist
+# rows, in order).  Observation units are TICKS (one tick == one
+# protocol period):
+# - rumor_age: age of an adopted alive-assertion rumor at first-heard —
+#   gossip-apply cells landing status ALIVE, age = adoption tick + 1 -
+#   incarnation stamp.  Exact for alive-class rumors because a fresh
+#   incarnation's stamp IS its mint tick (the discrete-clock identity in
+#   the module docstring); suspect/faulty rumors reuse the member's
+#   older incarnation, so they are deliberately excluded rather than
+#   recorded with overstated ages.
+# - retired_age: same stamp-age of a change at piggyback retirement
+#   (the dissemination.js:41 drop) — cells active before the
+#   dissemination phases and inactive after them.  Envelope: a change
+#   both recorded AND retired within one tick's phases 5-7 is counted;
+#   one re-activated after an earlier drop in the same tick is not (no
+#   net retirement).
+# - suspicion_duration: ticks a (observer, subject) suspicion timer ran
+#   when it stopped — refuted/overridden (member alive again) or
+#   expired to faulty.  Revive view-resets are excluded (the timer
+#   didn't resolve; the observer forgot it).
+# - dirty_rows: per-tick dirty-row recompute batch size (the checksum
+#   pipeline's work distribution) — one observation per tick.
+HIST_TRACKS = ("rumor_age", "retired_age", "suspicion_duration", "dirty_rows")
+
 
 class SimParams(NamedTuple):
     """Static protocol constants (compile-time)."""
@@ -186,6 +210,17 @@ class SimParams(NamedTuple):
     # counts them (SimState.ev_drops) instead of overwriting — a
     # truncated stream is an honest prefix.  65536 records = 2 MB.
     event_capacity: int = 65536
+    # Device-side latency histograms (ops/histogram.py + the
+    # performance observatory's host half, obs/histograms.py): when True
+    # the tick bumps log2-bucketed counters — rumor age at adoption and
+    # at piggyback retirement (in ticks, measured against the
+    # incarnation stamp-as-mint-time identity; see HIST_TRACKS),
+    # suspicion duration at timer stop, per-tick dirty-row recompute
+    # sizes — under the same masks that drive the trajectory.
+    # Write-only within the tick (SimState.hist), trajectory-neutral
+    # (gate-equivalence-tested) and callback-free (jaxpr-audited).
+    # Off by default: zero cost.
+    histograms: bool = False
 
 
 class SimState(NamedTuple):
@@ -239,6 +274,11 @@ class SimState(NamedTuple):
     # first-heard wavefront matrix: tick at which observer i first
     # adopted j's current rumor (-1 = only the born-with view)
     first_heard: Optional[jax.Array] = None  # [N, N] int32
+    # latency-histogram plane (SimParams.histograms only, else None):
+    # [len(HIST_TRACKS), ops.histogram.NBUCKETS] uint32 log2-bucket
+    # counters.  Write-only within the tick — trajectory-neutral by
+    # construction; drained/reset host-side (SimCluster.drain_histograms)
+    hist: Optional[jax.Array] = None
 
 
 class TickInputs(NamedTuple):
@@ -489,6 +529,10 @@ def init_state(
             ev_drops=ev_drops,
             first_heard=first_heard,
         )
+    if params.histograms:
+        from ringpop_tpu.ops import histogram as hg
+
+        state = state._replace(hist=hg.init(len(HIST_TRACKS)))
     # Fast mode never touches the universe in compute_checksums, so the
     # cache can (and must) be seeded even without one — a fast-mode caller
     # omitting universe would otherwise see stale zero checksums for rows
@@ -1043,6 +1087,9 @@ def tick(
     # tick-start views: the flight recorder's old_status baseline (and
     # nothing else — the protocol phases read live state as before)
     prev_known, prev_status = state.known, state.status
+    # tick-start suspicion deadlines: the histogram plane's duration
+    # baseline (a stopped timer's start tick = deadline - suspicion_ticks)
+    prev_susp = state.susp_deadline
     # this tick's incarnation stamp: epoch_ms + tick_next*period_ms
     now = state.tick_index + 2
     node = jnp.arange(n, dtype=jnp.int32)[:, None]
@@ -1266,6 +1313,12 @@ def tick(
         )
         if inputs.leave is not None:
             changed_mid = changed_mid | (lv[:, None] & is_self)
+
+    # change-table occupancy before the dissemination phases: the
+    # histogram plane's retirement baseline (phases 3/5.5/7 only CLEAR
+    # ch_active at the piggyback bound; applies only SET it — so
+    # pre & ~post is exactly the net-retired cell set)
+    pre_pb_active = state.ch_active if params.histograms else None
 
     # checksum each sender advertises in its ping body this tick — its value
     # as of the end of the previous tick (ping-sender.js:70-76 reads it at
@@ -2057,6 +2110,50 @@ def tick(
                 rejoined=rejoin,
             ),
         )
+
+    # ---- latency histograms (opt-in, trajectory-neutral) --------------
+    # bumped AFTER every protocol phase from the same masks that drove
+    # them; write-only (nothing below touches protocol state), so the
+    # plane is trajectory-neutral by construction — pinned by the
+    # gate-equivalence tests in tests/models/test_hist_neutral.py.
+    # Track semantics: HIST_TRACKS at the top of this module.
+    if params.histograms:
+        from ringpop_tpu.ops import histogram as hg
+
+        hist = state.hist
+        # rumor age at first-heard: gossip adoptions landing ALIVE —
+        # stamp-as-mint-time makes the age exact for alive-class rumors
+        adopted = (
+            (applied_ping | applied_resp | applied_pr)
+            & ~is_self
+            & (state.status == ALIVE)
+            & (state.inc > 0)
+        )
+        age = tick_next + 1 - state.inc
+        hist = hg.record(
+            hist, HIST_TRACKS.index("rumor_age"), age, adopted
+        )
+        # rumor age at retirement: the piggyback drop (dissemination.js:41)
+        retired_cells = pre_pb_active & ~state.ch_active
+        ret_age = tick_next + 1 - state.ch_inc
+        hist = hg.record(
+            hist, HIST_TRACKS.index("retired_age"), ret_age, retired_cells
+        )
+        # suspicion duration at timer stop (refute/override or expiry);
+        # revive view-resets forget timers rather than resolving them
+        stopped = (
+            (prev_susp >= 0)
+            & (state.susp_deadline == -1)
+            & ~rv[:, None]
+        )
+        dur = tick_next - prev_susp + params.suspicion_ticks
+        hist = hg.record(
+            hist, HIST_TRACKS.index("suspicion_duration"), dur, stopped
+        )
+        hist = hg.record_count(
+            hist, HIST_TRACKS.index("dirty_rows"), metrics.dirty_rows
+        )
+        state = state._replace(hist=hist)
 
     state = state._replace(rng=_fold(state.rng, 0x5EED))
     return state, metrics

@@ -102,6 +102,17 @@ ALIVE, SUSPECT, FAULTY, LEAVE = 0, 1, 2, 3
 WORD = 32
 SLOTS_PER_TICK = 3  # suspect, faulty, alive (revive/refute/rejoin)
 
+# Latency-histogram track layout (ScalableParams.histograms;
+# ScalableState.hist rows, in order; observations in TICKS):
+# - rumor_age: per newly-set heard bit, tick - r_birth of its rumor —
+#   the dissemination wavefront's latency distribution (the histogram
+#   twin of the wavefront matrix, without the [N, U] int32 state).
+# - retired_age: per retired rumor slot, tick - r_birth at the aging
+#   drop / recycle (the batched dissemination.js:41 analog).
+# - suspicion_duration: per stopped suspicion clock, tick - susp_since
+#   at refute-cancel or faulty expiry.
+SCALABLE_HIST_TRACKS = ("rumor_age", "retired_age", "suspicion_duration")
+
 
 def slots_per_tick(params: "ScalableParams") -> int:
     """3 rumor classes per tick, +1 (leave) when the feature is enabled —
@@ -163,6 +174,16 @@ class ScalableParams(NamedTuple):
     # [N, U] int32 matrix and the per-tick bit expansion are real
     # memory/bandwidth at 1M nodes.
     wavefront: bool = False
+    # Device-side latency histograms (ops/histogram.py; host half
+    # obs/histograms.py): log2-bucketed counters for rumor age at
+    # first-heard (per newly-set heard bit, vs r_birth), rumor age at
+    # retirement (the batched dissemination.js:41 analog), and suspicion
+    # duration at clock stop (refute-cancel or faulty expiry) — see
+    # SCALABLE_HIST_TRACKS.  Write-only within the tick
+    # (ScalableState.hist), trajectory-neutral and gate-equivalence-safe;
+    # off by default (the per-tick [N, U] bit expansion is real
+    # bandwidth at 1M nodes, same cost class as wavefront).
+    histograms: bool = False
 
 
 class ScalableState(NamedTuple):
@@ -208,6 +229,11 @@ class ScalableState(NamedTuple):
     # first-heard tick per (node, rumor slot); -1 = never heard.
     # Write-only within the tick — trajectory-neutral by construction.
     first_heard: Optional[jax.Array] = None  # [N, U] int32
+    # latency-histogram plane (ScalableParams.histograms only, else
+    # None): [len(SCALABLE_HIST_TRACKS), NBUCKETS] uint32 counters,
+    # write-only within the tick (drained by
+    # ScalableCluster.drain_histograms)
+    hist: Optional[jax.Array] = None
 
 
 # ScalableState fields indexed by NODE along axis 0 — the single source
@@ -559,8 +585,14 @@ def init_state(params: ScalableParams, seed: int = 0) -> ScalableState:
     first_heard = (
         jnp.full((n, u), -1, jnp.int32) if params.wavefront else None
     )
+    hist = None
+    if params.histograms:
+        from ringpop_tpu.ops import histogram as hg
+
+        hist = hg.init(len(SCALABLE_HIST_TRACKS))
     return ScalableState(
         first_heard=first_heard,
+        hist=hist,
         tick_index=jnp.int32(0),
         proc_alive=jnp.ones(n, bool),
         gossip_on=jnp.ones(n, bool),
@@ -841,6 +873,14 @@ def tick(
     now = t + 1  # int32 stamp == epoch + t*200 ms
     rng = state.rng
     ids = jnp.arange(n, dtype=jnp.int32)
+    # latency-histogram plane (SCALABLE_HIST_TRACKS): recorded inline at
+    # the sites below into this local, attached once at the end.  Every
+    # bump is straight-line (never inside a _phase cond) from masks that
+    # are identical across gate_phases settings — trajectory-neutral and
+    # gate-equivalence-safe by construction.
+    hist = state.hist if params.histograms else None
+    if hist is not None:
+        from ringpop_tpu.ops import histogram as hg
 
     # ---- fault plane ---------------------------------------------------
     revived = inputs.revive & ~state.proc_alive
@@ -897,6 +937,14 @@ def tick(
     ).astype(jnp.int32)
     recycled = jnp.zeros(u, bool).at[slots].set(True)
     retired = aged | (state.r_active & recycled)
+    if hist is not None:
+        # rumor age at retirement (r_birth still pre-publish here)
+        hist = hg.record(
+            hist,
+            SCALABLE_HIST_TRACKS.index("retired_age"),
+            t - state.r_birth,
+            retired,
+        )
     # a defame_slot pointer whose slot is recycled this tick would, after
     # the slot's reuse, read an unrelated rumor's heard bit — demote it
     # to the -2 "aged into base while still defamed" sentinel.  The
@@ -1126,14 +1174,28 @@ def tick(
     # Straight-line (not gated): the stamp is a masked no-op when no
     # bits turned on, so gatings stay bit-identical.
     fh = state.first_heard
-    if fh is not None:
+    # the [N, U] bit expansion is shared by the wavefront stamp and the
+    # rumor-age histogram — computed once when either plane is on
+    new_bits = None
+    if fh is not None or hist is not None:
         if diff_all is None:
             diff_all = new_heard ^ state.heard
         bit_ids = jnp.arange(WORD, dtype=jnp.uint32)[None, None, :]
         new_bits = (
             ((diff_all[:, :, None] >> bit_ids) & jnp.uint32(1)) != 0
         ).reshape(n, u)
+    if fh is not None:
         fh = jnp.where(new_bits, t, fh)
+    if hist is not None:
+        # rumor age at first-heard: every newly-set heard bit is an
+        # adoption of rumor r at age t - r_birth[r] (new bits only turn
+        # on for active slots, whose r_birth is their publish tick)
+        hist = hg.record(
+            hist,
+            SCALABLE_HIST_TRACKS.index("rumor_age"),
+            jnp.broadcast_to(t - state.r_birth[None, :], (n, u)),
+            new_bits,
+        )
     state = state._replace(heard=new_heard, first_heard=fh)
 
     # ---- failure detection: suspect batch ------------------------------
@@ -1144,6 +1206,14 @@ def tick(
     cancel = (state.susp_subject >= 0) & (
         state.truth_status[csubj] != SUSPECT
     )
+    if hist is not None:
+        # suspicion duration at refute-cancel (clock read pre-reset)
+        hist = hg.record(
+            hist,
+            SCALABLE_HIST_TRACKS.index("suspicion_duration"),
+            t - state.susp_since,
+            cancel,
+        )
     state = state._replace(
         susp_subject=jnp.where(cancel, -1, state.susp_subject),
         susp_since=jnp.where(cancel, -1, state.susp_since),
@@ -1195,6 +1265,15 @@ def tick(
         & (t - state.susp_since >= params.suspicion_ticks)
         & proc_alive
     )
+    if hist is not None:
+        # suspicion duration at expiry (a cancelled clock reset its
+        # susp_since above, so no double count within the tick)
+        hist = hg.record(
+            hist,
+            SCALABLE_HIST_TRACKS.index("suspicion_duration"),
+            t - state.susp_since,
+            expire,
+        )
     esubj = jnp.clip(state.susp_subject, 0, n - 1)
     still_suspect = state.truth_status[esubj] == SUSPECT
     expirer = expire & still_suspect & (state.susp_subject >= 0)
@@ -1329,6 +1408,8 @@ def tick(
         m ^= m >> 15
         view_sig = jnp.sum(m * jnp.uint32(0x2C1B3C6D), axis=1, dtype=jnp.uint32)
     state = state._replace(checksum=checksum, rng=_fold(rng, 0x5CA1E))
+    if hist is not None:
+        state = state._replace(hist=hist)
 
     active_words2 = _pack_mask(state.r_active)
     n_active = jnp.sum(state.r_active.astype(jnp.int32))

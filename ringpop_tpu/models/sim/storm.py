@@ -179,6 +179,15 @@ def fixup_scalable_state(
         )
     elif not params.wavefront and state.first_heard is not None:
         state = state._replace(first_heard=None)
+    # latency-histogram plane: same telemetry contract as the wavefront
+    if params.histograms and state.hist is None:
+        from ringpop_tpu.ops import histogram as hg
+
+        state = state._replace(
+            hist=hg.init(len(es.SCALABLE_HIST_TRACKS))
+        )
+    elif not params.histograms and state.hist is not None:
+        state = state._replace(hist=None)
     return state
 
 
@@ -287,6 +296,36 @@ class ScalableCluster(CheckpointableMixin):
     def ring_checksum(self) -> int:
         """Rebuild the ring from current truth, return its digest."""
         return int(self._ring_checksum(self.state.truth_status, self.state.proc_alive))
+
+    # -- latency histograms (ScalableParams.histograms) -------------------
+
+    def drain_histograms(self, reset: bool = True, statsd=None):
+        """Drain the device latency histograms (ScalableState.hist) into
+        per-track summaries (exact p50/p95/p99, obs.histograms); logs a
+        ``hist.drain`` event on the attached recorder and optionally
+        emits timer keys through ``statsd`` (a StatsdBridge).  ``reset``
+        zeroes the counters AFTER the sinks ran."""
+        if self.state.hist is None:
+            raise ValueError(
+                "histograms are off — construct with "
+                "ScalableParams(histograms=True)"
+            )
+        from ringpop_tpu.obs import histograms as oh
+
+        summary = oh.drain(
+            self.state.hist,
+            es.SCALABLE_HIST_TRACKS,
+            "sim.engine_scalable",
+            recorder=self.recorder,
+            statsd=statsd,
+        )
+        if reset:
+            from ringpop_tpu.ops import histogram as hg
+
+            self.state = self.state._replace(
+                hist=hg.init(len(es.SCALABLE_HIST_TRACKS))
+            )
+        return summary
 
     # -- rumor wavefront tracing (ScalableParams.wavefront) ---------------
 

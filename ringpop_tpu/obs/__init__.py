@@ -27,7 +27,16 @@ become durable and queryable:
   (device half: models/sim/flight.py).
 - :mod:`ringpop_tpu.obs.chrome_trace` — Chrome-trace/Perfetto JSON
   export of decoded flight-recorder streams (per-node tracks,
-  status-transition spans, rumor flow arrows) + schema validation.
+  status-transition spans, rumor flow arrows) + schema validation,
+  plus the round-15 host-timeline track (``add_host_timeline``).
+- :mod:`ringpop_tpu.obs.histograms` — host half of the device latency
+  histograms (ops.histogram): exact p50/p95/p99 extraction,
+  ``hist.drain`` rows, and the ``computeProtocolDelay``-style adaptive
+  period consumer.
+- :mod:`ringpop_tpu.obs.perf` — dispatch timers around the compiled
+  entry points (fenced, donation-safe, compile/execute split via the
+  jit-cache probe), ``perf.phase`` rows and the shared bench
+  warm-then-measure loop (``timed_window``).
 """
 
 from ringpop_tpu.obs.recorder import (  # noqa: F401
@@ -50,7 +59,18 @@ from ringpop_tpu.obs.events import (  # noqa: F401
     validate_event_stream,
 )
 from ringpop_tpu.obs.chrome_trace import (  # noqa: F401
+    add_host_timeline,
     export_chrome_trace,
     validate_chrome_trace,
     write_chrome_trace,
+)
+from ringpop_tpu.obs.histograms import (  # noqa: F401
+    AdaptiveProtocolPeriod,
+    compute_protocol_delay,
+    summarize as summarize_histograms,
+)
+from ringpop_tpu.obs.perf import (  # noqa: F401
+    DispatchTimer,
+    timed_window,
+    wrap_cluster,
 )

@@ -1,0 +1,350 @@
+"""Host-side phase timing: dispatch timers around the jitted entry points.
+
+The performance observatory's wall-clock plane (device-side latency
+distributions live in ops.histogram / obs.histograms).  Three pieces:
+
+- :class:`DispatchTimer` — wraps compiled callables (a driver's
+  ``_tick``/``_scanned`` executables) with a properly-fenced timer:
+  every call is timed to ``block_until_ready`` on its OUTPUTS (donation-
+  safe — the fence never touches the possibly-donated inputs), and the
+  jit cache size (the retrace prong's ``_cache_size`` machinery) is
+  probed around the call so compile-carrying calls are split from warm
+  executes and silent retraces are visible per phase.  The exact
+  per-call warm walls are retained (ring-bounded), so the reported
+  p50/p95/p99 are true nearest-rank order statistics of the measured
+  dispatches — not bucket bounds.
+- ``perf.phase`` runlog rows (:meth:`DispatchTimer.emit`) and a host-
+  timeline Chrome-trace track (:meth:`DispatchTimer.chrome_trace_events`)
+  that merges into the existing Perfetto export.
+- :func:`timed_window` — the ONE warmup/measure loop shared by bench.py's
+  phases (each previously hand-rolled warm-run/`perf_counter`/fence
+  sequences) and benchmarks/tpu_measure.py.
+
+``wrap_cluster`` instruments a driver (SimCluster / ScalableCluster /
+RoutedStorm) non-invasively by rebinding its ``_tick``/``_scanned``
+attributes — the underlying shared executables (module-level lru caches)
+are untouched, so other instances keep their unwrapped handles.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ringpop_tpu.obs.histograms import (
+    DEFAULT_QS,
+    compute_protocol_delay,
+)
+
+
+def fence(value: Any) -> Any:
+    """Block until every array in a pytree is ready; returns the value.
+    The output-side fence is the donation-safe synchronization point —
+    blocking on inputs that were donated to the call would read deleted
+    buffers."""
+    import jax
+
+    return jax.block_until_ready(value)
+
+
+def _cache_size(fn: Any) -> Optional[int]:
+    probe = getattr(fn, "_cache_size", None)
+    if probe is None:
+        return None
+    try:
+        return int(probe())
+    except Exception:
+        return None
+
+
+class PhaseStats:
+    """Accumulated timing for one named phase.  The exact per-call warm
+    walls are retained (ring-bounded) so the reported percentiles are
+    true nearest-rank order statistics of the measured dispatches —
+    not bucket bounds."""
+
+    def __init__(self, name: str, keep_walls: int = 4096):
+        self.name = name
+        self.calls = 0
+        self.total_s = 0.0
+        self.compile_calls = 0  # calls that grew the jit cache
+        self.compile_s = 0.0  # wall spent in those calls (trace+compile+run)
+        self.cache_hits = 0  # calls OBSERVED warm via the cache probe
+        self.warm_walls: List[float] = []  # exact walls, non-compile calls
+        self._keep_walls = keep_walls
+        self.last_s: Optional[float] = None
+
+    def observe(self, wall_s: float, compiled: Optional[bool]) -> None:
+        """``compiled`` is tri-state: True = the call grew the jit
+        cache, False = the probe confirmed a cache hit, None = no probe
+        (plain callables, host spans) — counted warm but never as a
+        cache hit."""
+        self.calls += 1
+        self.total_s += wall_s
+        self.last_s = wall_s
+        if compiled:
+            self.compile_calls += 1
+            self.compile_s += wall_s
+        else:
+            if compiled is False:
+                self.cache_hits += 1
+            self.warm_walls.append(wall_s)
+            if len(self.warm_walls) > self._keep_walls:
+                del self.warm_walls[: -self._keep_walls]
+
+    def warm_s(self) -> float:
+        return self.total_s - self.compile_s
+
+    def warm_calls(self) -> int:
+        return self.calls - self.compile_calls
+
+    def summary(self, qs: Sequence[float] = DEFAULT_QS) -> Dict[str, Any]:
+        out: Dict[str, Any] = {
+            "phase": self.name,
+            "calls": self.calls,
+            "wall_s": self.total_s,
+            "compile_calls": self.compile_calls,
+            "compile_s": self.compile_s,
+            "cache_hits": self.cache_hits,
+            "warm_calls": self.warm_calls(),
+            "warm_s": self.warm_s(),
+        }
+        out.update(percentiles_exact(self.warm_walls, qs))
+        return out
+
+
+class DispatchTimer:
+    """Per-phase dispatch timing with compile/execute split and a host
+    timeline."""
+
+    def __init__(
+        self,
+        clock: Callable[[], float] = time.perf_counter,
+        keep_spans: int = 4096,
+    ):
+        self._clock = clock
+        self._t0 = clock()
+        self.phases: Dict[str, PhaseStats] = {}
+        # (name, start_s, end_s, compiled) relative to timer birth; ring-
+        # bounded so a long storm cannot grow the host timeline unboundedly
+        self.spans: List[Tuple[str, float, float, bool]] = []
+        self._keep_spans = keep_spans
+
+    def _stats(self, name: str) -> PhaseStats:
+        st = self.phases.get(name)
+        if st is None:
+            st = self.phases[name] = PhaseStats(name)
+        return st
+
+    def _note(
+        self, name: str, t0: float, t1: float, compiled: Optional[bool]
+    ) -> None:
+        self._stats(name).observe(t1 - t0, compiled)
+        self.spans.append(
+            (name, t0 - self._t0, t1 - self._t0, bool(compiled))
+        )
+        if len(self.spans) > self._keep_spans:
+            del self.spans[: -self._keep_spans]
+
+    def wrap(self, name: str, fn: Callable) -> Callable:
+        """Timed twin of a compiled callable: fence on outputs, cache-
+        size probe around the call (None-tolerant for plain callables)."""
+
+        def timed_call(*args, **kwargs):
+            before = _cache_size(fn)
+            t0 = self._clock()
+            out = fence(fn(*args, **kwargs))
+            t1 = self._clock()
+            after = _cache_size(fn)
+            compiled = (
+                None
+                if before is None or after is None
+                else after > before
+            )
+            self._note(name, t0, t1, compiled)
+            return out
+
+        timed_call.__name__ = "timed_%s" % name
+        timed_call.__wrapped__ = fn
+        # sentinel for wrap_cluster's idempotence check: jax.jit
+        # wrappers already carry __wrapped__, so that attr can't tell
+        # "already timed" from "plain jitted".  The bound timer rides
+        # along so a re-instrumentation can recover it.
+        timed_call.__perf_timed__ = True
+        timed_call.__perf_timer__ = self
+        return timed_call
+
+    def phase(self, name: str):
+        """Context manager timing an arbitrary host-side span.  No
+        cache probe exists here, so ``compiled`` is recorded as None
+        (unknown) — the span counts warm but NEVER as a cache hit
+        (cache_hits must mean an observed probe, not an assumption)."""
+        timer = self
+
+        class _Span:
+            def __enter__(self_inner):
+                self_inner._s0 = timer._clock()
+                return self_inner
+
+            def __exit__(self_inner, exc_type, exc, tb):
+                timer._note(name, self_inner._s0, timer._clock(), None)
+
+        return _Span()
+
+    # -- reporting --------------------------------------------------------
+
+    def summary(self, qs: Sequence[float] = DEFAULT_QS) -> List[Dict[str, Any]]:
+        return [
+            self.phases[name].summary(qs) for name in sorted(self.phases)
+        ]
+
+    def emit(self, recorder, qs: Sequence[float] = DEFAULT_QS, **extra) -> int:
+        """One ``perf.phase`` event row per phase onto a RunRecorder
+        (field set validated by scripts/check_metrics_schema.py)."""
+        rows = 0
+        for row in self.summary(qs):
+            recorder.record_event("perf.phase", **row, **extra)
+            rows += 1
+        return rows
+
+    def emit_statsd(self, bridge, key_map: Optional[Dict[str, str]] = None) -> int:
+        """Per-phase warm p50/p95/p99 as statsd TIMER samples through a
+        StatsdBridge (``|ms`` wire type) — phase names mapped onto the
+        reference timing-key scheme via ``key_map`` (default:
+        obs.statsd_bridge.PERF_TIMER_KEYS, unmapped phases ride
+        ``sim.perf.<phase>``)."""
+        from ringpop_tpu.obs.statsd_bridge import PERF_TIMER_KEYS
+
+        key_map = PERF_TIMER_KEYS if key_map is None else key_map
+        emitted = 0
+        for row in self.summary():
+            key = key_map.get(row["phase"], "sim.perf.%s" % row["phase"])
+            for q in ("p50_ms", "p95_ms", "p99_ms"):
+                v = row.get(q)
+                if v is not None:
+                    bridge.timing("%s.%s" % (key, q[:-3]), v)
+                    emitted += 1
+        return emitted
+
+    def chrome_trace_events(self, pid: int = 0, tid: int = 0) -> List[dict]:
+        """The host-timeline track: complete ("X") Trace Event Format
+        events, microsecond timestamps, one per recorded span — merged
+        into the flight-recorder Perfetto export by
+        obs.chrome_trace.add_host_timeline."""
+        events = []
+        for name, s0, s1, compiled in self.spans:
+            events.append(
+                {
+                    "name": name,
+                    "ph": "X",
+                    "pid": pid,
+                    "tid": tid,
+                    "ts": s0 * 1e6,
+                    # >= 1 us: the trace schema requires X spans dur > 0
+                    "dur": max((s1 - s0) * 1e6, 1.0),
+                    "cat": "host",
+                    "args": {"compiled": compiled},
+                }
+            )
+        return events
+
+    # -- the load-bearing consumer ---------------------------------------
+
+    def protocol_delay_ms(
+        self, phase: str = "tick", min_period_ms: float = 200.0
+    ) -> float:
+        """computeProtocolDelay over a phase's exact warm-dispatch
+        walls: ``max(2 * p50, minProtocolPeriod)``
+        (lib/gossip/index.js:42-50).  The phase wall IS the simulated
+        ping round's host latency."""
+        st = self.phases.get(phase)
+        p50 = None
+        if st is not None:
+            p50 = percentiles_exact(st.warm_walls, (50,))["p50_ms"]
+        return compute_protocol_delay(p50, min_period_ms)
+
+
+def wrap_cluster(cluster, timer: Optional[DispatchTimer] = None) -> DispatchTimer:
+    """Instrument a driver's compiled entry points in place: rebinds the
+    instance's ``_tick`` / ``_scanned`` attributes (SimCluster /
+    BatchedSimClusters / ScalableCluster / RoutedStorm — a RoutedStorm's
+    inner cluster handles stay untouched; the routed driver dispatches
+    through its own ``_tick``/``_scanned``).  Drivers that dispatch
+    through structure-keyed module caches instead of instance handles
+    (ShardedStorm) get their public ``step``/``run`` wrapped — same
+    phase names and fencing, no jit-cache probe (compile split reads
+    None there).  Returns the timer; re-instrumenting an
+    already-wrapped driver without an explicit ``timer`` returns the
+    ORIGINAL bound timer (the one the dispatches flow into), never a
+    fresh disconnected one."""
+    # ShardedSim names its scan handle _scan, the other drivers _scanned
+    _HANDLES = (("_tick", "tick"), ("_scanned", "scan"), ("_scan", "scan"))
+    if timer is None:
+        for attr, _ in _HANDLES + (("step", "tick"), ("run", "scan")):
+            fn = getattr(cluster, attr, None)
+            if fn is not None and getattr(fn, "__perf_timed__", False):
+                timer = fn.__perf_timer__
+                break
+    timer = timer or DispatchTimer()
+    wrapped = False
+    for attr, phase in _HANDLES:
+        fn = getattr(cluster, attr, None)
+        if fn is not None:
+            wrapped = True
+            if not getattr(fn, "__perf_timed__", False):
+                setattr(cluster, attr, timer.wrap(phase, fn))
+    if not wrapped:
+        for attr, phase in (("step", "tick"), ("run", "scan")):
+            fn = getattr(cluster, attr, None)
+            if fn is not None and not getattr(fn, "__perf_timed__", False):
+                setattr(cluster, attr, timer.wrap(phase, fn))
+    return timer
+
+
+def timed_window(
+    run: Callable[[], Any],
+    warmup: int = 1,
+    repeats: int = 1,
+    recorder=None,
+    phase: Optional[str] = None,
+    timer: Optional[DispatchTimer] = None,
+    **extra: Any,
+) -> Tuple[Any, float]:
+    """The shared warm-then-measure loop (bench.py phases previously
+    hand-rolled this): call ``run`` ``warmup`` times (compile + first
+    dispatch, unmeasured), then ``repeats`` times fenced and timed.
+    Returns ``(last_result, measured_wall_s)`` — the wall covers ALL
+    measured repeats.  With ``recorder`` + ``phase`` a ``perf.phase``
+    event row is stamped (calls/warm percentiles from the per-repeat
+    walls); ``timer`` accumulates into an existing DispatchTimer
+    instead of a throwaway one."""
+    for _ in range(warmup):
+        fence(run())
+    timer = timer or DispatchTimer()
+    out = None
+    t0 = time.perf_counter()
+    for _ in range(repeats):
+        with timer.phase(phase or "window"):
+            out = fence(run())
+    wall = time.perf_counter() - t0
+    if recorder is not None and phase is not None:
+        row = timer.phases[phase].summary()
+        row.update(extra)
+        recorder.record_event("perf.phase", **row)
+    return out, wall
+
+
+def percentiles_exact(walls_s: Sequence[float], qs=DEFAULT_QS) -> Dict[str, float]:
+    """Exact (un-bucketed) nearest-rank percentiles of raw wall samples
+    in ms — for callers that kept the per-call walls."""
+    arr = np.sort(np.asarray(list(walls_s), np.float64))
+    out = {}
+    for q in qs:
+        if arr.size == 0:
+            out["p%g_ms" % q] = None
+        else:
+            rank = max(1, int(np.ceil(q / 100.0 * arr.size)))
+            out["p%g_ms" % q] = float(arr[rank - 1] * 1e3)
+    return out

@@ -68,6 +68,40 @@ TICK_KEY_MAP: Dict[str, Tuple[str, str]] = {
     "route_ring_points": ("gauge", "sim.route.ring.points"),
 }
 
+# Host-side phase timers (obs.perf DispatchTimer) -> reference TIMING
+# keys (statsd ``|ms`` wire type).  The reference's getStats surfaces
+# protocol-period duration, ping round-trip and checksum-computation
+# timing histograms (SURVEY "Protocol timing profiling": protocol.delay
+# / ping / compute-checksum); our host phases map onto them — one
+# scanned/jitted tick IS one ping round, and the adaptive-period
+# consumer emits the computed protocol.delay.  Unmapped phases ride
+# ``sim.perf.<phase>``.
+PERF_TIMER_KEYS: Dict[str, str] = {
+    "tick": "ping",
+    "scan": "ping",
+    "checksum": "compute-checksum",
+    "protocol_delay": "protocol.delay",
+}
+
+# Device-side latency-histogram tracks (ops.histogram, drained via
+# obs.histograms) -> timing keys.  Reference analogs where they exist
+# (requestProxy retry accounting, send.js:91-208); sim-only
+# distributions ride the sim. namespace.  Values are in TICKS for the
+# engine tracks (one tick == one protocol period) and counts for the
+# routing tracks; the |ms wire type is kept so statsd dashboards
+# aggregate them as timer series like the reference's.
+HIST_TIMER_KEYS: Dict[str, str] = {
+    # engines
+    "rumor_age": "dissemination.rumor-age",
+    "retired_age": "dissemination.rumor-retired-age",
+    "suspicion_duration": "membership-update.suspicion-duration",
+    "dirty_rows": "sim.checksum.dirty-rows.dist",
+    # routing plane
+    "retry_depth": "requestProxy.retry.depth",
+    "reroute_hops": "requestProxy.hops",
+    "dirty_buckets": "sim.route.ring.dirty-buckets.dist",
+}
+
 # Recovery-plane lifecycle counters (models/sim/recovery.py): emitted by
 # CheckpointManager directly (they are per-event, not per-tick, so they
 # ride their own map rather than TICK_KEY_MAP).  The reference has no
@@ -135,6 +169,36 @@ class StatsdBridge:
         ``sharded.exchange.*`` resolution note, round 14) so callers
         never reach into the internal ``_stat`` dispatch."""
         self._stat("gauge", key, value)
+
+    def timing(self, key: str, value) -> None:
+        """Emit one TIMER sample (statsd ``|ms`` wire type) under the
+        bridge's fq-key scheme — the reference emits its protocol.delay
+        / ping / compute-checksum timing histograms this way (getStats
+        timing keys).  The bridge was counters/gauges-only before the
+        performance observatory (round 15)."""
+        self._stat("timing", key, value)
+
+    def emit_hist_summary(
+        self,
+        summary: Dict[str, Dict[str, Any]],
+        key_map: Optional[Dict[str, str]] = None,
+    ) -> int:
+        """Drained device-histogram summaries (obs.histograms.summarize)
+        -> timer keys: per track, the p50/p95/p99 upper bounds emit as
+        ``<key>.p50`` / ``.p95`` / ``.p99`` timing samples (empty tracks
+        emit nothing).  Track names map through ``key_map`` (default
+        HIST_TIMER_KEYS; unmapped tracks ride ``sim.hist.<track>``).
+        Returns the number of emissions."""
+        key_map = HIST_TIMER_KEYS if key_map is None else key_map
+        emitted = 0
+        for track, stats in summary.items():
+            key = key_map.get(track, "sim.hist.%s" % track)
+            for q in ("p50", "p95", "p99"):
+                v = stats.get(q)
+                if v is not None:
+                    self.timing("%s.%s" % (key, q), v)
+                    emitted += 1
+        return emitted
 
     def emit_tick(self, row: Any) -> int:
         """One tick's metrics (NamedTuple or dict).  Counters emit only

@@ -1,0 +1,108 @@
+"""Device-side log2-bucketed latency histograms for the scanned tick.
+
+The reference treats timing distributions as protocol INPUTS, not just
+telemetry: the gossip loop's adaptive protocol period is ``p50 of the
+ping-timing histogram x 2`` (lib/gossip/index.js:42-50), per-tick
+duration rides a ``metrics.Histogram`` surfaced through ``getStats()``,
+and the convergence benchmark reports count/min/max/mean/p75/p95/p99.
+The scanned engines cannot call a host histogram per event (the jaxgate
+purity contract forbids callbacks in the tick), so this module is the
+device half: a fixed-shape ``[tracks, NBUCKETS]`` uint32 counter array
+carried through the scan as an ordinary state field and bumped with
+masked scatter-adds under the *same masks that drive the trajectory* —
+the flight-recorder pattern (models/sim/flight.py), so recording is
+trajectory-neutral by construction (write-only: nothing in the protocol
+reads the counts) and gate-equivalence-safe.
+
+Bucketing: log2 buckets over non-negative int32 values.  Bucket 0 holds
+exactly the value 0; bucket ``b >= 1`` holds ``[2^(b-1), 2^b - 1]``.
+With ``NBUCKETS = 32`` every non-negative int32 lands in a bucket — no
+overflow bucket is needed (the top bucket 31 covers ``[2^30, 2^31-1]``).
+Negative values are invalid observations and must be masked out by the
+caller (``record`` additionally guards with ``v >= 0``).
+
+Host half — exact percentile extraction, summaries, and the runlog /
+statsd / Prometheus rendering — lives in :mod:`ringpop_tpu.obs.histograms`.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+NBUCKETS = 32
+
+
+def init(tracks: int) -> jax.Array:
+    """Zeroed ``[tracks, NBUCKETS]`` uint32 counter array.
+
+    uint32, not int32: a 1M-node storm recording an [N, U]-masked track
+    for thousands of ticks can pass 2^31 observations per bucket."""
+    return jnp.zeros((tracks, NBUCKETS), jnp.uint32)
+
+
+def bucket_index(values: jax.Array) -> jax.Array:
+    """[...] int32 -> [...] int32 bucket index (bit length of the value).
+
+    ``bucket_index(v) == 0 if v == 0 else floor(log2(v)) + 1`` for
+    ``v >= 0`` — computed as a threshold-count sum (no integer log on
+    TPU vector units; 31 compares fuse into one elementwise pass).
+    Negative values clamp to bucket 0; callers mask them out."""
+    v = values.astype(jnp.int32)
+    count = jnp.zeros(v.shape, jnp.int32)
+    for b in range(NBUCKETS - 1):  # thresholds 2^0 .. 2^30
+        count = count + (v >= jnp.int32(1 << b)).astype(jnp.int32)
+    return count
+
+
+def record(
+    hist: jax.Array,  # [H, NBUCKETS] uint32
+    track: int,  # static track index
+    values: jax.Array,  # [M] int32 observations
+    mask: jax.Array,  # [M] bool — which lanes are real observations
+) -> jax.Array:
+    """Masked scatter-add of up to M observations into one track.
+
+    Duplicate buckets within one call accumulate (``.add`` scatter
+    semantics); masked-out and negative lanes land in a dropped slot
+    past the bucket axis.  Static shapes throughout — scan-safe."""
+    values = values.reshape(-1)
+    mask = mask.reshape(-1)
+    ok = mask & (values >= 0)
+    idx = jnp.where(ok, bucket_index(values), NBUCKETS)  # NBUCKETS drops
+    return hist.at[track, idx].add(
+        ok.astype(jnp.uint32), mode="drop"
+    )
+
+
+def record_count(
+    hist: jax.Array, track: int, value: jax.Array
+) -> jax.Array:
+    """One scalar observation per call (per-tick size metrics — dirty
+    rows, dirty buckets): records ``value`` once, unconditionally."""
+    v = value.astype(jnp.int32).reshape(1)
+    return record(hist, track, v, jnp.ones(1, bool))
+
+
+# -- host-side bucket arithmetic (shared with obs.histograms) -------------
+
+
+def bucket_lo(b: int) -> int:
+    """Smallest value bucket ``b`` holds."""
+    return 0 if b == 0 else 1 << (b - 1)
+
+
+def bucket_hi(b: int) -> int:
+    """Largest value bucket ``b`` holds."""
+    return 0 if b == 0 else (1 << b) - 1
+
+
+def bucket_index_np(values) -> np.ndarray:
+    """Host/numpy reference of :func:`bucket_index` — the oracle the
+    device op is tested against (tests/ops/test_histogram.py)."""
+    v = np.asarray(values, np.int64)
+    out = np.zeros(v.shape, np.int64)
+    nz = v > 0
+    out[nz] = np.floor(np.log2(v[nz])).astype(np.int64) + 1
+    return np.clip(out, 0, NBUCKETS - 1).astype(np.int32)

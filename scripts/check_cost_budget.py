@@ -1,0 +1,85 @@
+#!/usr/bin/env python
+"""Diff XLA static costs of the compiled entry points against
+COST_BUDGET.json — the perf-regression gate that needs no chip.
+
+Compiles every auditable entry point (the jaxpr prong's registry) at
+its toy shape and compares ``cost_analysis()`` flops/bytes and
+``memory_analysis()`` sizes to the committed manifest (see
+ringpop_tpu/analysis/cost.py).  An accidental O(N^2) blowup, a widened
+dtype, or a new temp buffer fails the diff.
+
+Usage::
+
+    python scripts/check_cost_budget.py                    # diff, exit 1 on drift
+    python scripts/check_cost_budget.py --write            # regenerate manifest
+    python scripts/check_cost_budget.py --entries a,b,c    # subset (diff only)
+    python scripts/check_cost_budget.py --rtol 0.05
+
+``--write`` REFUSES to commit a manifest containing entries that failed
+to trace or compile — a broken entry point is a finding, not a budget.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parents[1]))
+
+from ringpop_tpu.analysis import cost  # noqa: E402
+from ringpop_tpu.analysis.findings import render_text  # noqa: E402
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument(
+        "--write",
+        action="store_true",
+        help="compile the entry points and (re)write COST_BUDGET.json",
+    )
+    parser.add_argument(
+        "--budget",
+        default=None,
+        help="manifest path (default: COST_BUDGET.json at repo root)",
+    )
+    parser.add_argument(
+        "--entries",
+        default=None,
+        help="comma-separated entry-name subset (diff mode only)",
+    )
+    parser.add_argument(
+        "--rtol",
+        type=float,
+        default=cost.DEFAULT_RTOL,
+        help="relative drift tolerance (default %g)" % cost.DEFAULT_RTOL,
+    )
+    args = parser.parse_args(argv)
+    path = Path(args.budget) if args.budget else None
+    names = (
+        [n.strip() for n in args.entries.split(",") if n.strip()]
+        if args.entries
+        else None
+    )
+
+    if args.write:
+        if names is not None:
+            parser.error("--write regenerates the FULL manifest; drop --entries")
+        actual = cost.collect_costs()
+        out = cost.write_manifest(actual, path)
+        flops = sum(e.get("flops", 0) for e in actual.values())
+        print(
+            "wrote %s (%d entries, %d total budgeted flops)"
+            % (out, len(actual), flops)
+        )
+        return 0
+
+    findings = cost.check_against_manifest(
+        entry_names=names, path=path, rtol=args.rtol
+    )
+    print(render_text(findings))
+    return 1 if findings else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -83,7 +83,41 @@ ROUTE_EVENT_FIELDS = {
         "single_device_resolution",
         "differs_from_single_device",
     ),
+    # round-15 performance observatory: every host phase-timing row
+    # names its phase, wall and call count (obs/perf.py DispatchTimer /
+    # timed_window), and every device-histogram drain names its source
+    # plane and carries the per-track summaries (obs/histograms.py
+    # drain_row — tracks is a dict of {count, p50, p95, p99, ...})
+    "perf.phase": ("phase", "wall_s", "calls"),
+    "hist.drain": ("source", "tracks"),
 }
+
+
+def _check_hist_drain(row: dict, path: str, ln: int) -> list:
+    """hist.drain rows: per-track summaries must carry count + the
+    p50/p95/p99 keys (None for empty tracks is valid)."""
+    problems = []
+    tracks = row.get("tracks")
+    if not isinstance(tracks, dict):
+        if "tracks" in row:
+            problems.append(
+                "%s:%d: hist.drain tracks must be an object" % (path, ln)
+            )
+        return problems
+    for name, stats in tracks.items():
+        if not isinstance(stats, dict):
+            problems.append(
+                "%s:%d: hist.drain track %r must be an object"
+                % (path, ln, name)
+            )
+            continue
+        for field in ("count", "p50", "p95", "p99"):
+            if field not in stats:
+                problems.append(
+                    "%s:%d: hist.drain track %r missing %r"
+                    % (path, ln, name, field)
+                )
+    return problems
 
 
 def _check_route_rows(path: str) -> list:
@@ -121,6 +155,8 @@ def _check_route_rows(path: str) -> list:
                                 "%s:%d: %s event missing %r"
                                 % (path, ln, row["name"], field)
                             )
+                if row.get("name") == "hist.drain":
+                    problems.extend(_check_hist_drain(row, path, ln))
     return problems
 
 

@@ -1,0 +1,201 @@
+"""Static cost manifest gate (analysis/cost.py + COST_BUDGET.json).
+
+Tier-1 wiring: a cheap-probe subset of the auditable entry points is
+compiled and diffed against the committed manifest every run (the full
+set belongs to scripts/check_cost_budget.py).  The mutation tests prove
+the gate FIRES: a deliberately cost-blown twin of an entry drifts the
+manifest and the script exits non-zero."""
+
+import importlib.util as ilu
+import json
+import os
+from pathlib import Path
+
+import jax
+import pytest
+
+from ringpop_tpu.analysis import cost
+
+# cheap compiles (seconds total, warm under the persistent XLA cache) —
+# the tier-1 slice of the manifest; the full diff is the script's job
+CHEAP_COST_ENTRIES = (
+    "exchange-xla",
+    "ring-device-lookup",
+    "fused-checksum-xla",
+    "route-tick-incremental",
+)
+
+
+def _script():
+    spec = ilu.spec_from_file_location(
+        "check_cost_budget",
+        os.path.join(
+            os.path.dirname(__file__), "..", "..", "scripts",
+            "check_cost_budget.py",
+        ),
+    )
+    mod = ilu.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_cheap_probe_subset_matches_committed_manifest():
+    findings = cost.check_against_manifest(
+        entry_names=CHEAP_COST_ENTRIES
+    )
+    from ringpop_tpu.analysis.findings import render_text
+
+    assert findings == [], "\n" + render_text(findings)
+
+
+def test_manifest_covers_observatory_entries():
+    manifest = cost.load_manifest()
+    entries = set(manifest["entries"])
+    assert set(CHEAP_COST_ENTRIES) <= entries
+    # the round-15 histogram-enabled ticks are budgeted too
+    assert {
+        "engine-tick-scan-histograms",
+        "engine-scalable-tick-histograms",
+        "route-tick-histograms",
+    } <= entries
+    for e in manifest["entries"].values():
+        assert "error" not in e
+        assert e["flops"] >= 0 and e["bytes_accessed"] > 0
+
+
+def test_mutation_cost_blown_entry_drifts_manifest():
+    """The gate fires on a real cost regression: a twin of exchange-xla
+    that accidentally runs the op twice (the unbatched/recompute
+    anti-pattern — 2x flops and bytes) must drift every cost metric far
+    past the tolerance."""
+    import jax.numpy as jnp
+
+    from ringpop_tpu.analysis import jaxpr_audit as ja
+    from ringpop_tpu.ops import exchange as exch
+
+    def blown(heard, pulled, pushed, r_delta):
+        nh, d, c = exch.exchange(heard, pulled, pushed, r_delta, impl="xla")
+        nh2, d2, _ = exch.exchange(nh, pulled, pushed, r_delta, impl="xla")
+        return nh2, d + d2, c
+
+    args = ja._exchange_args()
+    mutated = cost._extract(jax.jit(blown).lower(*args).compile())
+    manifest = cost.load_manifest()
+    sliced = dict(manifest)
+    sliced["entries"] = {"exchange-xla": manifest["entries"]["exchange-xla"]}
+    findings = cost.compare_to_manifest(
+        {"exchange-xla": mutated}, sliced
+    )
+    assert findings, "cost-blown twin produced no drift findings"
+    assert any("flops" in f.message for f in findings)
+    assert all(f.rule == "cost-budget" for f in findings)
+
+
+def test_mutation_widened_dtype_drifts_manifest():
+    """A widened dtype on the farmhash row-hash path (uint8 bytes
+    upcast to float32 before hashing-adjacent reductions) blows bytes
+    accessed — the HBM-traffic regression class the manifest exists to
+    catch."""
+    import jax.numpy as jnp
+
+    from ringpop_tpu.analysis import jaxpr_audit as ja
+    from ringpop_tpu.ops import jax_farmhash as jfh
+
+    mat, lens = ja._farmhash_args()
+
+    def widened(mat, lens):
+        out = jfh.hash32_rows(mat, lens, impl="scan")
+        # the accidental fp32 materialization of the byte matrix
+        return out, jnp.sum(mat.astype(jnp.float32) * 1.5, axis=1)
+
+    mutated = cost._extract(jax.jit(widened).lower(mat, lens).compile())
+    manifest = cost.load_manifest()
+    exp = manifest["entries"]["farmhash-scan"]
+    assert cost._drifted(
+        mutated["bytes_accessed"], exp["bytes_accessed"], cost.DEFAULT_RTOL
+    ) or cost._drifted(
+        mutated["flops"], exp["flops"], cost.DEFAULT_RTOL
+    ), (mutated, exp)
+
+
+def test_script_exits_nonzero_on_doctored_manifest(tmp_path):
+    """End-to-end proof the CI gate fires: perturb one committed entry
+    (the O(N^2)-blowup signature: 3x flops + 3x bytes) and the script's
+    diff mode exits non-zero; the pristine manifest exits zero."""
+    mod = _script()
+    pristine = tmp_path / "ok.json"
+    doctored = tmp_path / "bad.json"
+    manifest = cost.load_manifest()
+    pristine.write_text(json.dumps(manifest))
+    bad = json.loads(json.dumps(manifest))
+    bad["entries"]["exchange-xla"]["flops"] *= 3
+    bad["entries"]["exchange-xla"]["bytes_accessed"] *= 3
+    doctored.write_text(json.dumps(bad))
+    args = ["--entries", ",".join(CHEAP_COST_ENTRIES)]
+    assert mod.main(args + ["--budget", str(pristine)]) == 0
+    assert mod.main(args + ["--budget", str(doctored)]) == 1
+
+
+def test_write_manifest_refuses_failed_entries(tmp_path):
+    with pytest.raises(ValueError, match="refusing"):
+        cost.write_manifest(
+            {"good": {"flops": 1}, "broken": {"error": "boom"}},
+            tmp_path / "m.json",
+        )
+
+
+def test_compare_flags_missing_and_extra_entries():
+    manifest = {"entries": {"a": {"flops": 10}, "b": {"flops": 10}}}
+    findings = cost.compare_to_manifest(
+        {"a": {"flops": 10}, "c": {"flops": 5}}, manifest
+    )
+    msgs = "\n".join(f.message for f in findings)
+    assert "not measured" in msgs  # b missing
+    assert "no manifest entry" in msgs  # c extra
+
+
+def test_compare_tolerance_and_direction():
+    manifest = {"entries": {"a": {"flops": 1000}}}
+    ok = cost.compare_to_manifest({"a": {"flops": 1050}}, manifest)
+    assert ok == []  # 5% < rtol
+    up = cost.compare_to_manifest({"a": {"flops": 1500}}, manifest)
+    assert len(up) == 1 and "cost regression" in up[0].message
+    down = cost.compare_to_manifest({"a": {"flops": 500}}, manifest)
+    assert len(down) == 1 and "stale manifest" in down[0].message
+
+
+def test_full_run_detects_stale_manifest_entry(tmp_path, monkeypatch):
+    """An entry point removed from the registry while its manifest row
+    survives must be a finding on a FULL run (no --entries subset) —
+    the subset path legitimately slices, the full path must not."""
+    manifest = {
+        "backend": jax.default_backend(),
+        "entries": {"a": {"flops": 1}, "ghost": {"flops": 2}},
+    }
+    p = tmp_path / "m.json"
+    p.write_text(json.dumps(manifest))
+    monkeypatch.setattr(cost, "_entry_names_for_backend", lambda b: ["a"])
+    monkeypatch.setattr(
+        cost, "collect_costs", lambda names=None: {"a": {"flops": 1}}
+    )
+    findings = cost.check_against_manifest(path=Path(p))
+    assert any("not measured" in f.message for f in findings)
+    # the explicit subset path still slices the manifest to scope
+    assert cost.check_against_manifest(("a",), Path(p)) == []
+
+
+def test_backend_mismatch_skips_cleanly(tmp_path):
+    other = {
+        "backend": "tpu" if jax.default_backend() != "tpu" else "cpu",
+        "entries": {"exchange-xla": {"flops": 1}},
+    }
+    p = tmp_path / "other.json"
+    p.write_text(json.dumps(other))
+    assert cost.check_against_manifest(("exchange-xla",), Path(p)) == []
+
+
+def test_missing_manifest_is_a_finding(tmp_path):
+    findings = cost.check_against_manifest(
+        ("exchange-xla",), tmp_path / "nope.json"
+    )
+    assert len(findings) == 1 and "missing" in findings[0].message

@@ -61,6 +61,13 @@ def test_jaxpr_prong_covers_required_entry_points():
         # collective programs hold the same purity / uint32 gates
         "exchange-plane",
         "engine-scalable-tick-shardmap",
+        # ISSUE 11 acceptance: the latency-histogram-enabled ticks (both
+        # engines + the routing plane) stay callback-free — the whole
+        # point of device-side histograms is percentile telemetry
+        # without host round-trips in the scan
+        "engine-tick-scan-histograms",
+        "engine-scalable-tick-histograms",
+        "route-tick-histograms",
     } <= names
     assert len(names) >= 5
 

@@ -1,0 +1,167 @@
+"""Host half of the latency histograms: exact percentile extraction vs
+a raw-value numpy oracle, batched drains, and the adaptive-period
+consumer (obs/histograms.py)."""
+
+import numpy as np
+import pytest
+
+from ringpop_tpu.obs import histograms as oh
+from ringpop_tpu.ops import histogram as hg
+
+
+def _counts_of(values) -> np.ndarray:
+    counts = np.zeros(hg.NBUCKETS, np.int64)
+    for b in hg.bucket_index_np(values):
+        counts[b] += 1
+    return counts
+
+
+def _nearest_rank(values, q) -> int:
+    s = np.sort(np.asarray(values))
+    rank = max(1, int(np.ceil(q / 100.0 * s.size)))
+    return int(s[rank - 1])
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2, 3])
+@pytest.mark.parametrize("q", [50, 95, 99])
+def test_percentile_bucket_contains_true_order_statistic(seed, q):
+    """The exactness claim: bucketization is monotone, so the bucket
+    found by walking cumulative counts to the nearest-rank position is
+    EXACTLY the bucket holding the true order statistic of the raw
+    values — lo <= v* <= hi, and the bucket indices agree."""
+    rng = np.random.default_rng(seed)
+    values = (2.0 ** (rng.random(997) * 30)).astype(np.int64) - 1
+    counts = _counts_of(values)
+    p = oh.percentile(counts, q)
+    vstar = _nearest_rank(values, q)
+    assert p["bucket"] == int(hg.bucket_index_np(vstar))
+    assert p["lo"] <= vstar <= p["hi"]
+    assert p["value"] == p["hi"]
+
+
+def test_percentile_empty_histogram_is_none():
+    counts = np.zeros(hg.NBUCKETS, np.int64)
+    assert oh.percentile(counts, 50) is None
+    s = oh.summarize_track(counts)
+    assert s["count"] == 0 and s["p50"] is None and s["p99"] is None
+
+
+def test_percentile_single_bucket_and_top_bucket():
+    counts = np.zeros(hg.NBUCKETS, np.int64)
+    counts[0] = 10
+    assert oh.percentile(counts, 99)["value"] == 0
+    # overflow-range values (>= 2^30) land in the top bucket and come
+    # back with its bounds, never clipped away
+    top = np.zeros(hg.NBUCKETS, np.int64)
+    top[hg.NBUCKETS - 1] = 3
+    p = oh.percentile(top, 50)
+    assert p["bucket"] == hg.NBUCKETS - 1 and p["hi"] == 2**31 - 1
+
+
+def test_percentile_rank_boundaries_exact():
+    # 100 observations of value 1, one of value 1000: p99 must stay in
+    # bucket(1); only p>99.0099.. crosses — nearest-rank arithmetic, no
+    # interpolation
+    values = [1] * 100 + [1000]
+    counts = _counts_of(values)
+    assert oh.percentile(counts, 99)["value"] == 1
+    assert oh.percentile(counts, 100)["bucket"] == int(
+        hg.bucket_index_np(1000)
+    )
+
+
+def test_percentile_rejects_bad_q():
+    counts = _counts_of([1, 2, 3])
+    with pytest.raises(ValueError):
+        oh.percentile(counts, 0)
+    with pytest.raises(ValueError):
+        oh.percentile(counts, 101)
+
+
+def test_summarize_names_tracks_and_checks_shape():
+    h = np.zeros((2, hg.NBUCKETS), np.int64)
+    h[0][1] = 4
+    s = oh.summarize(h, ("a", "b"))
+    assert s["a"]["count"] == 4 and s["b"]["count"] == 0
+    with pytest.raises(ValueError):
+        oh.summarize(h, ("a",))
+    with pytest.raises(ValueError):
+        oh.summarize(np.zeros((2, 2, hg.NBUCKETS)), ("a", "b"))
+
+
+def test_summarize_batched_aggregate_pools_observations():
+    """A vmapped [B, H, NB] drain: aggregate percentiles == percentiles
+    of the pooled raw observations (bucket counts are additive)."""
+    rng = np.random.default_rng(7)
+    per_instance = [rng.integers(0, 1000, size=50) for _ in range(4)]
+    h = np.stack([[_counts_of(v)] for v in per_instance])  # [4, 1, NB]
+    agg = oh.summarize_batched(h, ("t",), aggregate=True)
+    pooled = np.concatenate(per_instance)
+    want = oh.summarize_track(_counts_of(pooled))
+    assert agg["t"] == want
+    per = oh.summarize_batched(h, ("t",), aggregate=False)
+    assert len(per) == 4
+    for inst, vals in zip(per, per_instance):
+        assert inst["t"]["count"] == len(vals)
+
+
+def test_drain_row_shape_passes_schema_gate(tmp_path):
+    """A hist.drain event row written through a RunRecorder validates
+    against scripts/check_metrics_schema.py (the CI gate)."""
+    import importlib.util as ilu
+    import os
+
+    from ringpop_tpu.obs.recorder import RunRecorder
+
+    summary = oh.summarize(np.zeros((1, hg.NBUCKETS)), ("rumor_age",))
+    path = str(tmp_path / "x.runlog.jsonl")
+    with RunRecorder(path) as rec:
+        rec.record_event("hist.drain", **oh.drain_row("sim.engine", summary))
+    spec = ilu.spec_from_file_location(
+        "check_metrics_schema",
+        os.path.join(
+            os.path.dirname(__file__), "..", "..", "scripts",
+            "check_metrics_schema.py",
+        ),
+    )
+    mod = ilu.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    assert mod.check([path], verbose=False) == []
+    # and a BROKEN drain row (track summary missing p-keys) is caught
+    with open(path, "a", encoding="utf-8") as fh:
+        fh.write(
+            '{"kind": "event", "name": "hist.drain", "source": "x", '
+            '"tracks": {"t": {"count": 1}}}\n'
+        )
+    assert mod.check([path], verbose=False) != []
+
+
+def test_host_histogram_shares_bucket_scheme():
+    h = oh.HostHistogram(unit=0.5)
+    for v in (0.0, 1.0, 1.0, 4.0):
+        h.observe(v)
+    h.observe(-1.0)  # ignored
+    s = h.summary()
+    assert s["count"] == 4
+    # values scale back to caller units (bucketized at 0.5/unit)
+    assert s["p50"] == hg.bucket_hi(int(hg.bucket_index_np(2))) * 0.5
+
+
+def test_compute_protocol_delay_reference_formula():
+    """computeProtocolDelay (lib/gossip/index.js:42-50): p50 x 2 floored
+    at the minimum protocol period; no samples -> the floor."""
+    assert oh.compute_protocol_delay(None) == 200.0
+    assert oh.compute_protocol_delay(50.0) == 200.0  # 100 < floor
+    assert oh.compute_protocol_delay(150.0) == 300.0
+    assert oh.compute_protocol_delay(150.0, min_protocol_period=400) == 400.0
+
+
+def test_adaptive_protocol_period_consumer():
+    app = oh.AdaptiveProtocolPeriod(min_period_ms=200.0)
+    assert app.period_ms() == 200.0  # pre-samples: the floor
+    for _ in range(100):
+        app.observe(400.0)
+    # p50 upper bound of bucket(400) x 2
+    p50 = hg.bucket_hi(int(hg.bucket_index_np(400)))
+    assert app.period_ms() == max(2.0 * p50, 200.0)
+    assert app.period_ms() > 200.0  # the histogram is load-bearing

@@ -1,0 +1,209 @@
+"""Host-side phase timing (obs/perf.py): dispatch-timer wrapping,
+compile/execute split via the jit-cache probe, perf.phase runlog rows,
+and the host-timeline Perfetto track."""
+
+import importlib.util as ilu
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from ringpop_tpu.obs import perf as obs_perf
+from ringpop_tpu.obs.chrome_trace import (
+    add_host_timeline,
+    validate_chrome_trace,
+)
+from ringpop_tpu.obs.recorder import RunRecorder, read_run_log
+
+
+def _schema_module():
+    spec = ilu.spec_from_file_location(
+        "check_metrics_schema",
+        os.path.join(
+            os.path.dirname(__file__), "..", "..", "scripts",
+            "check_metrics_schema.py",
+        ),
+    )
+    mod = ilu.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_wrap_detects_compile_then_cache_hits():
+    @jax.jit
+    def f(x):
+        return x * 2
+
+    timer = obs_perf.DispatchTimer()
+    g = timer.wrap("f", f)
+    g(jnp.ones(8))  # fresh jit: compile-carrying call
+    g(jnp.ones(8))  # same shape: warm
+    g(jnp.ones(8))
+    st = timer.phases["f"]
+    assert st.calls == 3
+    assert st.compile_calls == 1
+    assert st.cache_hits == 2
+    g(jnp.ones(16))  # new shape: a second (budgeted) compile
+    assert timer.phases["f"].compile_calls == 2
+
+
+def test_wrap_fences_outputs_and_preserves_results():
+    @jax.jit
+    def f(x):
+        return x + 1
+
+    timer = obs_perf.DispatchTimer()
+    g = timer.wrap("f", f)
+    out = g(jnp.arange(4))
+    assert (np.asarray(out) == np.arange(4) + 1).all()
+    assert timer.phases["f"].total_s > 0
+
+
+def test_wrap_plain_callable_has_no_cache_probe():
+    timer = obs_perf.DispatchTimer()
+    g = timer.wrap("host", lambda x: x)
+    g(3)
+    st = timer.phases["host"]
+    # compiled is unknowable: neither a compile call nor a cache hit
+    assert st.calls == 1 and st.compile_calls == 0 and st.cache_hits == 0
+
+
+def test_summary_and_emit_rows_validate_against_schema(tmp_path):
+    @jax.jit
+    def f(x):
+        return jnp.sum(x * x)
+
+    timer = obs_perf.DispatchTimer()
+    g = timer.wrap("tick", f)
+    for _ in range(5):
+        g(jnp.ones(32))
+    rows = timer.summary()
+    (row,) = rows
+    assert row["phase"] == "tick" and row["calls"] == 5
+    assert row["warm_calls"] == row["calls"] - row["compile_calls"]
+    assert row["p50_ms"] is not None and row["p99_ms"] >= row["p50_ms"]
+
+    path = str(tmp_path / "perf.runlog.jsonl")
+    with RunRecorder(path) as rec:
+        assert timer.emit(rec) == 1
+    assert _schema_module().check([path], verbose=False) == []
+    log = read_run_log(path)
+    (ev,) = [e for e in log["events"] if e["name"] == "perf.phase"]
+    assert ev["phase"] == "tick" and ev["calls"] == 5 and "wall_s" in ev
+
+
+def test_perf_phase_row_missing_fields_fails_schema(tmp_path):
+    path = str(tmp_path / "bad.runlog.jsonl")
+    with RunRecorder(path) as rec:
+        rec.record_event("perf.phase", phase="tick")  # no wall_s/calls
+    assert _schema_module().check([path], verbose=False) != []
+
+
+def test_host_timeline_merges_into_flight_trace():
+    timer = obs_perf.DispatchTimer()
+    with timer.phase("scan"):
+        pass
+    trace = {"traceEvents": []}
+    add_host_timeline(trace, timer)
+    assert validate_chrome_trace(trace) == []
+    names = [e.get("name") for e in trace["traceEvents"]]
+    assert "process_name" in names and "scan" in names
+    span = [e for e in trace["traceEvents"] if e.get("ph") == "X"][0]
+    assert span["dur"] >= 1.0  # schema floor
+
+
+def test_wrap_cluster_times_without_changing_trajectory():
+    from ringpop_tpu.models.sim import engine
+    from ringpop_tpu.models.sim.cluster import EventSchedule, SimCluster
+
+    n = 8
+
+    def run(wrapped):
+        c = SimCluster(n=n, params=engine.SimParams(n=n), seed=5)
+        timer = obs_perf.wrap_cluster(c) if wrapped else None
+        c.bootstrap()
+        c.run(EventSchedule(ticks=6, n=n))
+        return c, timer
+
+    a, _ = run(False)
+    b, timer = run(True)
+    for f in engine.SimState._fields:
+        va, vb = getattr(a.state, f), getattr(b.state, f)
+        if va is None and vb is None:
+            continue
+        assert np.array_equal(np.asarray(va), np.asarray(vb)), f
+    assert timer.phases["tick"].calls >= 1  # bootstrap step
+    assert timer.phases["scan"].calls == 1
+    # idempotent: re-wrapping must not double-wrap, and re-instrumenting
+    # WITHOUT an explicit timer returns the ORIGINAL bound timer (the
+    # one the dispatches flow into), never a fresh disconnected one
+    timer2 = obs_perf.wrap_cluster(b)
+    assert timer2 is timer
+    obs_perf.wrap_cluster(b, timer)
+    assert b._tick.__name__ == "timed_tick"
+    assert not getattr(b._tick.__wrapped__, "__perf_timed__", False)
+
+
+def test_wrap_cluster_sharded_storm_fallback():
+    """ShardedStorm dispatches through structure-keyed module caches,
+    not instance handles — wrap_cluster falls back to timing its public
+    step/run under the same phase names."""
+    from ringpop_tpu.models.sim import engine_scalable as es
+    from ringpop_tpu.models.sim.storm import StormSchedule
+    from ringpop_tpu.parallel import mesh as pmesh
+
+    storm = pmesh.ShardedStorm(
+        n=16,
+        mesh=pmesh.make_mesh(1),
+        params=es.ScalableParams(n=16, u=128),
+        seed=0,
+    )
+    timer = obs_perf.wrap_cluster(storm)
+    storm.step()
+    storm.run(StormSchedule(ticks=3, n=16))
+    assert timer.phases["tick"].calls == 1
+    assert timer.phases["scan"].calls == 1
+
+
+def test_timed_window_warms_measures_and_stamps_row(tmp_path):
+    calls = []
+
+    @jax.jit
+    def f(x):
+        return x * 3
+
+    def run():
+        calls.append(1)
+        return f(jnp.ones(4))
+
+    path = str(tmp_path / "w.runlog.jsonl")
+    with RunRecorder(path) as rec:
+        out, wall = obs_perf.timed_window(
+            run, warmup=2, repeats=3, recorder=rec, phase="bench", n=4
+        )
+    assert len(calls) == 5  # 2 warm + 3 measured
+    assert wall > 0 and (np.asarray(out) == 3).all()
+    log = read_run_log(path)
+    (ev,) = [e for e in log["events"] if e["name"] == "perf.phase"]
+    assert ev["calls"] == 3 and ev["n"] == 4
+    assert _schema_module().check([path], verbose=False) == []
+
+
+def test_protocol_delay_consumer_reads_phase_histogram():
+    timer = obs_perf.DispatchTimer()
+    # no samples: the reference floor
+    assert timer.protocol_delay_ms() == 200.0
+    st = timer._stats("tick")
+    for _ in range(32):
+        st.observe(0.4, compiled=False)  # 400 ms warm dispatches
+    assert timer.protocol_delay_ms() > 200.0
+
+
+def test_percentiles_exact_nearest_rank():
+    walls = [0.001 * k for k in range(1, 101)]
+    out = obs_perf.percentiles_exact(walls)
+    assert out["p50_ms"] == pytest.approx(50.0)
+    assert out["p99_ms"] == pytest.approx(99.0)
+    assert obs_perf.percentiles_exact([])["p50_ms"] is None

@@ -1,0 +1,124 @@
+"""Device log2-bucket histogram primitives (ops/histogram.py)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from ringpop_tpu.ops import histogram as hg
+
+
+def test_bucket_index_matches_numpy_reference_on_edges():
+    edges = [0, 1, 2, 3, 4, 7, 8, 15, 16, 2**20 - 1, 2**20, 2**30, 2**31 - 1]
+    vals = jnp.asarray(edges, jnp.int32)
+    got = np.asarray(hg.bucket_index(vals))
+    want = hg.bucket_index_np(edges)
+    assert (got == want).all(), (got, want)
+    # spot the closed-form: 0 -> 0, v>0 -> floor(log2)+1
+    assert got[0] == 0 and got[1] == 1 and got[2] == 2 and got[3] == 2
+    assert got[-1] == hg.NBUCKETS - 1
+
+
+def test_bucket_index_matches_numpy_reference_randomized():
+    rng = np.random.default_rng(0)
+    # log-uniform coverage of the whole int32 range
+    v = np.unique(
+        (2.0 ** (rng.random(4096) * 31)).astype(np.int64) - 1
+    ).astype(np.int32)
+    got = np.asarray(hg.bucket_index(jnp.asarray(v)))
+    assert (got == hg.bucket_index_np(v)).all()
+
+
+def test_bucket_bounds_partition_the_int32_range():
+    lo_prev = -1
+    for b in range(hg.NBUCKETS):
+        lo, hi = hg.bucket_lo(b), hg.bucket_hi(b)
+        assert lo <= hi
+        assert lo == lo_prev + 1  # contiguous, gap-free
+        lo_prev = hi
+    assert hg.bucket_hi(hg.NBUCKETS - 1) == 2**31 - 1
+
+
+def test_record_masked_adds_and_duplicate_buckets_accumulate():
+    h = hg.init(2)
+    vals = jnp.asarray([0, 1, 1, 3, 8, -5, 100], jnp.int32)
+    mask = jnp.asarray([True, True, True, True, True, True, False])
+    h = hg.record(h, 1, vals, mask)
+    out = np.asarray(h)
+    assert out[0].sum() == 0  # untouched track
+    assert out[1].sum() == 5  # negative + masked-out lanes dropped
+    assert out[1][0] == 1  # value 0
+    assert out[1][1] == 2  # duplicate 1s accumulate
+    assert out[1][2] == 1  # value 3
+    assert out[1][4] == 1  # value 8
+    # accumulation across calls
+    h = hg.record(h, 1, vals, mask)
+    assert np.asarray(h)[1].sum() == 10
+
+
+def test_record_count_records_one_observation():
+    h = hg.init(1)
+    h = hg.record_count(h, 0, jnp.int32(5))
+    h = hg.record_count(h, 0, jnp.int32(0))
+    out = np.asarray(h)[0]
+    assert out.sum() == 2 and out[0] == 1 and out[3] == 1
+
+
+def test_record_is_scan_and_jit_safe():
+    def body(h, v):
+        return hg.record(h, 0, v, v >= 0), None
+
+    vals = jnp.asarray(
+        np.random.default_rng(1).integers(-4, 100, size=(16, 8)), jnp.int32
+    )
+    h, _ = jax.jit(lambda h, v: jax.lax.scan(body, h, v))(hg.init(1), vals)
+    want = np.zeros(hg.NBUCKETS, np.int64)
+    flat = np.asarray(vals).reshape(-1)
+    for b in hg.bucket_index_np(flat[flat >= 0]):
+        want[b] += 1
+    assert (np.asarray(h)[0] == want).all()
+
+
+def test_record_rejects_nothing_silently_counts_are_uint32():
+    assert hg.init(3).dtype == jnp.uint32
+
+
+@pytest.mark.parametrize("shape", [(4, 4), (3, 2, 2)])
+def test_record_flattens_any_mask_shape(shape):
+    vals = jnp.ones(shape, jnp.int32)
+    h = hg.record(hg.init(1), 0, vals, jnp.ones(shape, bool))
+    assert int(np.asarray(h)[0][1]) == int(np.prod(shape))
+
+
+def test_vmapped_batch_records_and_drains():
+    """The vmapped-driver shape: B instances each carrying their own
+    [H, NB] counters through a scanned recorder, drained as [B, H, NB]
+    via obs.histograms.summarize_batched — aggregate == pooled counts,
+    per-instance == each instance's own observations."""
+    from ringpop_tpu.obs import histograms as oh
+
+    b, t = 4, 16
+    rng = np.random.default_rng(3)
+    vals = jnp.asarray(
+        rng.integers(0, 500, size=(b, t, 8)), jnp.int32
+    )  # per-instance observation streams
+
+    def one_instance(stream):  # [T, 8] -> [1, NB]
+        def body(h, v):
+            return hg.record(h, 0, v, v >= 0), None
+
+        h, _ = jax.lax.scan(body, hg.init(1), stream)
+        return h
+
+    hists = jax.jit(jax.vmap(one_instance))(vals)  # [B, 1, NB]
+    assert hists.shape == (b, 1, hg.NBUCKETS)
+    agg = oh.summarize_batched(hists, ("x",), aggregate=True)
+    assert agg["x"]["count"] == b * t * 8
+    per = oh.summarize_batched(hists, ("x",), aggregate=False)
+    for i, inst in enumerate(per):
+        assert inst["x"]["count"] == t * 8
+        # per-instance p50 buckets match a host recount of that instance
+        want = np.zeros(hg.NBUCKETS, np.int64)
+        for bb in hg.bucket_index_np(np.asarray(vals[i]).reshape(-1)):
+            want[bb] += 1
+        assert (np.asarray(hists[i][0]) == want).all()
