@@ -619,6 +619,135 @@ def _ckpt_rate(n: int, ticks: int, every: int, recorder=None) -> dict:
     return out
 
 
+def _leave_churn_schedule(ticks: int, n: int, every: int = 3, seed: int = 0):
+    """Dissemination-active window for the full-engine ladder (round
+    16): one graceful leave + next-tick rejoin every ``every`` ticks
+    keeps the change tables hot — sender select, receiver apply/bump
+    and response assembly fire every tick — without the ping-req storms
+    a kill window adds.  That isolates exactly the phases the fused
+    tick (SimParams.fused_tick) rewired; kill-window behavior is
+    covered by the existing churn_parity capture.  Boundary clamp: a
+    leave drawn on the window's last tick gets its rejoin the SAME
+    tick (min(t+1, ticks-1)) — a leave+join TickInputs row instead of
+    the leave->rejoin pair, still dissemination-active and shared by
+    both A/B legs; kept as-is so the committed code reproduces the
+    banked BENCH_r15 schedule byte-for-byte."""
+    from ringpop_tpu.models.sim.cluster import EventSchedule
+
+    rng = np.random.default_rng(seed)
+    sched = EventSchedule(ticks=ticks, n=n)
+    sched.leave = np.zeros((ticks, n), bool)
+    for t in range(1, ticks, every):
+        v = int(rng.integers(0, n))
+        sched.leave[t, v] = True
+        sched.join[min(t + 1, ticks - 1), v] = True
+    return sched
+
+
+def _full_rate(n: int, ticks: int, fused_tick: str, recorder=None):
+    """One measured full-engine window at SimParams.fused_tick=
+    ``fused_tick`` — same protocol as every other window (construct,
+    bootstrap, converge, warm, fenced measure).  Returns (rate,
+    elapsed, sim) so the ladder can bitwise-gate the A/B final states
+    in-phase."""
+    import jax
+
+    from ringpop_tpu.models.sim import engine
+    from ringpop_tpu.models.sim.cluster import SimCluster
+    from ringpop_tpu.obs import perf as obs_perf
+
+    sim = SimCluster(
+        n=n,
+        params=engine.SimParams(
+            n=n, checksum_mode="fast", fused_tick=fused_tick
+        ),
+    )
+    sim.bootstrap()
+    converged_in = sim.run_until_converged(max_ticks=96, quiet_after=1)
+    if converged_in < 0:
+        raise RuntimeError(
+            "full phase: cluster failed to converge before the window "
+            "(n=%d, fused_tick=%s)" % (n, fused_tick)
+        )
+    sched = _leave_churn_schedule(ticks, n)
+    obs_perf.fence(sim.run(sched))  # compile + warm
+    jax.block_until_ready(sim.state)
+    with _profile_ctx(
+        "full-%s" % sim.params.fused_tick, recorder=recorder
+    ):
+        _metrics, elapsed = obs_perf.timed_window(
+            lambda: sim.run(sched),
+            warmup=0,
+            recorder=recorder,
+            phase="measure[full:%s]" % sim.params.fused_tick,
+            n=n,
+        )
+        jax.block_until_ready(sim.state)
+    return n * ticks / elapsed, elapsed, sim
+
+
+def _full_ladder(ns, ticks: int, recorder=None) -> dict:
+    """Round-16 full-engine scaling ladder: fused (auto-resolved
+    SimParams.fused_tick) vs classic phase-by-phase node-ticks/s at
+    each ``n``, with the bitwise final-state gate ASSERTED in-phase —
+    every SimState field must match or the bench aborts (the ISSUE 14
+    acceptance shape)."""
+    from ringpop_tpu.models.sim import engine
+    from ringpop_tpu.models.sim.cluster import clear_executable_cache
+
+    import jax
+
+    # the fused leg pins the backend's twin EXPLICITLY (pallas on TPU,
+    # xla elsewhere): the ladder's job is the fused-vs-classic A/B at
+    # every rung — the auto table's small-n "off" pick would reduce the
+    # low rungs to off-vs-off (auto itself is pinned from this ladder's
+    # measured crossover; see engine.resolve_fused_tick)
+    fused_mode = "pallas" if jax.default_backend() == "tpu" else "xla"
+    rungs = []
+    for n in ns:
+        r_off, _, s_off = _full_rate(n, ticks, "off", recorder=recorder)
+        r_f, _, s_f = _full_rate(
+            n, ticks, fused_mode, recorder=recorder
+        )
+        for f in engine.SimState._fields:
+            v = getattr(s_off.state, f)
+            if v is None:
+                continue
+            if not np.array_equal(
+                np.asarray(getattr(s_f.state, f)), np.asarray(v)
+            ):
+                raise RuntimeError(
+                    "full phase: fused trajectory diverged from the "
+                    "classic path at n=%d (state field %r)" % (n, f)
+                )
+        rung = {
+            "n": n,
+            "fused_tick": s_f.params.fused_tick,
+            "node_ticks_per_sec": round(r_f, 1),
+            "off_node_ticks_per_sec": round(r_off, 1),
+            "fused_vs_off": round(r_f / r_off, 3),
+            "bitwise_equal": True,
+        }
+        if recorder is not None:
+            for mode, rate in (
+                ("off", r_off),
+                (s_f.params.fused_tick, r_f),
+            ):
+                recorder.record_event(
+                    "full_window",
+                    n=n,
+                    ticks=ticks,
+                    fused_tick=mode,
+                    node_ticks_per_sec=round(rate, 1),
+                    bitwise_equal=True,
+                )
+        rungs.append(rung)
+        # two [N, N]-state executable sets per rung: drop them before
+        # the next size so the ladder's memory high-water stays bounded
+        clear_executable_cache()
+    return {"full_ticks": ticks, "full_ladder": rungs}
+
+
 def _sparse_churn_schedule(n: int, ticks: int, churn: int, seed: int = 0):
     """Sparse per-tick churn: ``churn`` random kills each tick, revived
     two ticks later — the steady trickle the incremental ring kernel is
@@ -1102,6 +1231,35 @@ def _measure_recorded(n: int, ticks: int, platform: str, recorder) -> dict:
             if _is_transient(exc):
                 raise
             result["mesh_error"] = "%s: %s" % (
+                type(exc).__name__,
+                str(exc)[:300],
+            )
+
+    # full-engine phase (BENCH_FULL=0 opts out): the round-16 fused
+    # full-fidelity tick — fused vs phase-by-phase node-ticks/s ladder
+    # over BENCH_FULL_N sizes on a dissemination-active window, with
+    # the bitwise final-state gate asserted IN-PHASE (a divergence
+    # aborts the bench) and full_window runlog events per measured
+    # window.
+    if os.environ.get("BENCH_FULL", "1") == "1":
+        try:
+            fns = [
+                int(x)
+                for x in os.environ.get(
+                    "BENCH_FULL_N", "1024,4096"
+                ).split(",")
+                if x.strip()
+            ]
+            fticks = int(os.environ.get("BENCH_FULL_TICKS", "8"))
+            result.update(
+                _retry_helper_500(
+                    _full_ladder, fns, fticks, recorder=recorder
+                )
+            )
+        except Exception as exc:
+            if _is_transient(exc):
+                raise
+            result["full_error"] = "%s: %s" % (
                 type(exc).__name__,
                 str(exc)[:300],
             )

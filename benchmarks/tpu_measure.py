@@ -967,6 +967,66 @@ def phase_observatory(results: dict) -> None:
         )
 
 
+def phase_fused_full(results: dict) -> None:
+    """Round-16 fused full-fidelity tick on-chip: the full [N, N]
+    engine's fused (pallas streaming kernels) vs xla-twin vs classic
+    phase-by-phase node-ticks/s at chip-viable sizes, on the same
+    dissemination-active leave/rejoin window bench.py's full phase
+    measures on CPU — with the bitwise final-state gate asserted per
+    rung.  This is where the fused tick's real thesis (one VMEM pass
+    per [N_tile, N] site instead of ~a dozen HBM temporaries) gets its
+    first chip numbers; the CPU ladder (BENCH_r15) only proves the twin
+    + gate harness."""
+    import sys
+
+    import jax
+    import numpy as np
+
+    sys.path.insert(
+        0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    )
+    import bench as bench_mod
+
+    from ringpop_tpu.models.sim import engine
+
+    for n in (1024, 4096):
+        key = "fused_full_%d" % n
+        if not _todo(results, key):
+            continue
+        try:
+            ticks = 8
+            rung: dict = {"n": n, "ticks": ticks}
+            rates = {}
+            states = {}
+            for mode in ("off", "xla", "pallas"):
+                rate, _el, sim = bench_mod._full_rate(n, ticks, mode)
+                rates[mode] = round(rate, 1)
+                states[mode] = jax.device_get(sim.state)
+            rung["node_ticks_per_sec"] = rates
+            rung["fused_vs_off"] = round(
+                rates["pallas"] / rates["off"], 3
+            )
+            rung["xla_vs_off"] = round(rates["xla"] / rates["off"], 3)
+            rung["bitwise_equal"] = bool(
+                all(
+                    np.array_equal(
+                        np.asarray(getattr(states[m], f)),
+                        np.asarray(getattr(states["off"], f)),
+                    )
+                    for m in ("xla", "pallas")
+                    for f in engine.SimState._fields
+                    if getattr(states["off"], f) is not None
+                )
+            )
+            if not rung["bitwise_equal"]:
+                rung["error"] = "fused trajectory diverged from classic"
+            results[key] = rung
+        except Exception as e:
+            results[key] = {"error": str(e)[:300]}
+        _drop_executables()
+        print(json.dumps({key: results[key]}), flush=True)
+
+
 def phase_ckpt(results: dict) -> None:
     """Round-13 recovery plane on-chip: checkpoint-cadence overhead and
     save/restore MB/s at n=1M (device->host gather + atomic manifest
@@ -1286,6 +1346,7 @@ def main() -> int:
         ("weak_scaling", phase_weak_scaling),
         ("route", phase_route),
         ("observatory", phase_observatory),
+        ("fused_full", phase_fused_full),
         ("ckpt", phase_ckpt),
         ("epidemic_100k", phase_epidemic_100k),
         ("batched", phase_batched),

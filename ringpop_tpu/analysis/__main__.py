@@ -77,11 +77,11 @@ def main(argv: Optional[List[str]] = None) -> int:
     )
     parser.add_argument(
         "--prong",
-        default="ast,jaxpr",
+        default="ast,jaxpr,kernels",
         help=(
-            "comma list of prongs to run: ast, jaxpr, retrace, cost "
-            "(or 'all'; default ast,jaxpr — retrace/cost compile real "
-            "entry points and are opt-in; CI runs them via "
+            "comma list of prongs to run: ast, jaxpr, kernels, retrace, "
+            "cost (or 'all'; default ast,jaxpr,kernels — retrace/cost "
+            "compile real entry points and are opt-in; CI runs them via "
             "scripts/check_retrace_budget.py / check_cost_budget.py)"
         ),
     )
@@ -106,7 +106,9 @@ def main(argv: Optional[List[str]] = None) -> int:
             print(f"    {rule.summary}")
         print(
             "\njaxpr prong: callback-primitive, wide-dtype-on-hash-path, "
-            "trace-failure\nretrace prong: retrace-budget"
+            "trace-failure\nkernels prong: unregistered-kernel, "
+            "missing-kernel-entry, missing-twin-entry, missing-gate-test, "
+            "stale-registry-row\nretrace prong: retrace-budget"
             "\ncost prong: cost-budget, cost-failure"
         )
         print(
@@ -117,11 +119,11 @@ def main(argv: Optional[List[str]] = None) -> int:
         return 0
 
     prongs = (
-        {"ast", "jaxpr", "retrace", "cost"}
+        {"ast", "jaxpr", "kernels", "retrace", "cost"}
         if args.prong.strip() == "all"
         else {p.strip() for p in args.prong.split(",") if p.strip()}
     )
-    unknown = prongs - {"ast", "jaxpr", "retrace", "cost"}
+    unknown = prongs - {"ast", "jaxpr", "kernels", "retrace", "cost"}
     if unknown:
         parser.error(f"unknown prong(s): {sorted(unknown)}")
 
@@ -177,6 +179,11 @@ def main(argv: Optional[List[str]] = None) -> int:
             from ringpop_tpu.analysis import jaxpr_audit
 
             all_findings.extend(jaxpr_audit.audit_entries())
+
+    if "kernels" in prongs:
+        from ringpop_tpu.analysis import kernel_coverage
+
+        all_findings.extend(kernel_coverage.check_kernel_coverage())
 
     if "retrace" in prongs:
         from ringpop_tpu.analysis import retrace

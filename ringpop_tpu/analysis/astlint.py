@@ -63,6 +63,12 @@ TRACED_ENTRIES: Dict[str, Set[str]] = {
     # traced code (all_to_all / all_gather / ppermute-class primitives)
     "parallel/mesh.py": {"make_exchange_plane", "_route_rows"},
     "ops/fused_checksum.py": {"membership_checksums", "fused_hash_rows"},
+    # the round-16 kernel toolkit + fused full-tick ops: the shared
+    # row-streaming scaffold and both fused sites are traced from
+    # engine.tick and from the audit/gate harnesses
+    "ops/toolkit.py": {"stream_row_tiles", "pack_bool_rows"},
+    "ops/fused_apply.py": {"apply_updates", "apply_updates_xla"},
+    "ops/fused_piggyback.py": {"pb_budget", "pb_budget_xla"},
     "ops/checksum_encode.py": {"membership_rows", "ring_rows"},
     "ops/pallas_farmhash.py": {
         "block_loop",

@@ -319,19 +319,27 @@ def _toy_universe(n: int = 8):
 
 
 def _sim_setup(
-    n: int = 8, flight_recorder: bool = False, histograms: bool = False
+    n: int = 8,
+    flight_recorder: bool = False,
+    histograms: bool = False,
+    fused_tick: str = "off",
 ):
     import jax
 
     from ringpop_tpu.models.sim import engine
 
     universe = _toy_universe(n)
+    # fused_tick defaults to the pinned CLASSIC shape so the base
+    # entries stay comparable with the pre-round-16 manifests; the
+    # -fused entries pin the xla twin explicitly (pallas is covered at
+    # the op level, exchange-pallas style)
     params = engine.SimParams(
         n=n,
         hash_impl="scan",
         flight_recorder=flight_recorder,
         event_capacity=256 if flight_recorder else 65536,
         histograms=histograms,
+        fused_tick=fused_tick,
     )
     params = engine.resolve_auto_parity(params, jax.default_backend())
     state = engine.init_state(params, seed=0, universe=universe)
@@ -339,13 +347,18 @@ def _sim_setup(
 
 
 def _entry_engine_tick_scan(
-    flight_recorder: bool = False, histograms: bool = False
+    flight_recorder: bool = False,
+    histograms: bool = False,
+    fused_tick: str = "off",
 ) -> Tuple[Callable, Tuple]:
     import jax
     import jax.numpy as jnp
 
     engine, params, universe, state = _sim_setup(
-        8, flight_recorder=flight_recorder, histograms=histograms
+        8,
+        flight_recorder=flight_recorder,
+        histograms=histograms,
+        fused_tick=fused_tick,
     )
     n, t = 8, 2
     inputs = engine.TickInputs(
@@ -693,8 +706,89 @@ def _entry_fuzz_scan_scalable() -> Tuple[Callable, Tuple]:
     return scan, (states, inputs)
 
 
+def _fused_apply_args(n: int = 8, seed: int = 0):
+    import jax.numpy as jnp
+    import numpy as np
+
+    from ringpop_tpu.ops import fused_apply as fap
+
+    rng = np.random.default_rng(seed)
+
+    def bpl(p):
+        return jnp.asarray(rng.random((n, n)) < p)
+
+    def ipl(lo, hi):
+        return jnp.asarray(rng.integers(lo, hi, (n, n)), dtype=jnp.int32)
+
+    st = fap.ApplyState(
+        bpl(0.8), ipl(0, 4), ipl(0, 20), bpl(0.3), ipl(0, 4),
+        ipl(0, 20), ipl(-1, n), ipl(0, 20), ipl(0, 9), ipl(-1, 30),
+    )
+    return st, bpl(0.4), ipl(0, 4), ipl(0, 20), ipl(0, n), ipl(0, 20)
+
+
+def _entry_fused_apply(impl: str) -> Tuple[Callable, Tuple]:
+    """The round-16 fused membership-update op (ops.fused_apply): both
+    lowerings must stay callback-free with integer dataflow discipline."""
+    import jax.numpy as jnp
+
+    from ringpop_tpu.ops import fused_apply as fap
+    from ringpop_tpu.ops import toolkit
+
+    st, recv, us, ui, usrc, usi = _fused_apply_args()
+    n = st.status.shape[0]
+    union = jnp.zeros((n, toolkit.packed_width(n)), jnp.uint32)
+
+    def op(st, recv, us, ui, usrc, usi, union):
+        return fap.apply_updates(
+            st, recv, us, ui, usrc, usi, jnp.int32(5), jnp.int32(9),
+            union, impl=impl, want_masks=True, want_count=True,
+        )
+
+    return op, (st, recv, us, ui, usrc, usi, union)
+
+
+def _entry_fused_piggyback(impl: str) -> Tuple[Callable, Tuple]:
+    """The round-16 fused dissemination-budget op (ops.fused_piggyback)."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    from ringpop_tpu.ops import fused_piggyback as fpb
+
+    n = 8
+    rng = np.random.default_rng(2)
+    active = jnp.asarray(rng.random((n, n)) < 0.5)
+    pb = jnp.asarray(rng.integers(0, 9, (n, n)), dtype=jnp.int32)
+    nbump = jnp.asarray(rng.integers(0, 3, n), dtype=jnp.int32)
+    max_pb = jnp.asarray(rng.integers(4, 16, n), dtype=jnp.int32)
+    hits = jnp.asarray(rng.integers(0, 2, (n, n)), dtype=jnp.int32)
+
+    def op(active, pb, nbump, max_pb, hits):
+        return fpb.pb_budget(active, pb, nbump, max_pb, hits, impl=impl)
+
+    return op, (active, pb, nbump, max_pb, hits)
+
+
 DEFAULT_ENTRIES: List[EntryPoint] = [
     EntryPoint("engine-tick-scan", _entry_engine_tick_scan),
+    # the round-16 fused full-fidelity tick: the scanned tick with the
+    # apply/piggyback sites routed through the toolkit's fused ops must
+    # hold the same purity / dtype gates as the classic shape
+    EntryPoint(
+        "engine-tick-scan-fused",
+        lambda: _entry_engine_tick_scan(fused_tick="xla"),
+    ),
+    EntryPoint("fused-apply-xla", lambda: _entry_fused_apply("xla")),
+    EntryPoint(
+        "fused-apply-pallas", lambda: _entry_fused_apply("pallas")
+    ),
+    EntryPoint(
+        "fused-piggyback-xla", lambda: _entry_fused_piggyback("xla")
+    ),
+    EntryPoint(
+        "fused-piggyback-pallas",
+        lambda: _entry_fused_piggyback("pallas"),
+    ),
     # the flight-recorder-enabled scanned tick MUST stay callback-free:
     # the whole point of the device-side recorder is event telemetry
     # without host round-trips in the scan (ISSUE 4 acceptance)

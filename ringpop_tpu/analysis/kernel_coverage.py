@@ -1,0 +1,225 @@
+"""Kernel-coverage prong: every Pallas kernel under ops/ must have a
+registered bit-exact twin and a gate-equivalence test.
+
+The toolkit pattern (ops/toolkit.py) requires every ``pallas_call``
+under ``ringpop_tpu/ops/`` to ship with a pure-XLA twin and a test
+pinning their bitwise equality — the rounds-7/10/14 kernels all did,
+by convention.  This prong makes the convention MACHINE-CHECKED, in the
+required-coverage style of the jaxpr registry gate: the AST is walked
+for ``pallas_call`` call sites, and every module containing one must
+have a ``toolkit.TWIN_REGISTRY`` row whose kernel entry, twin entry and
+gate test all exist (and the test must mention the kernel entry by
+name, so a renamed entry cannot silently orphan its gate).  A mutation
+test proves the rule fires on an unregistered kernel
+(tests/analysis/test_kernel_coverage.py).
+
+Findings (prong "kernels"):
+
+- ``unregistered-kernel`` — a module under ops/ calls ``pallas_call``
+  but has no TWIN_REGISTRY row;
+- ``missing-kernel-entry`` / ``missing-twin-entry`` — a registry row
+  names a function that does not exist in its module;
+- ``missing-gate-test`` — the registered test file does not exist or
+  never mentions the kernel entry;
+- ``stale-registry-row`` — a registry row's module has no
+  ``pallas_call`` at all (the kernel was removed; drop the row).
+"""
+
+from __future__ import annotations
+
+import ast
+from pathlib import Path
+from typing import Iterable, List, Optional, Sequence
+
+from ringpop_tpu.analysis.findings import Finding
+
+PRONG = "kernels"
+
+# the toolkit module ITSELF holds the one shared gridless pallas_call
+# (stream_row_tiles, the scaffold every row-streaming kernel lowers
+# through) — it is infrastructure, not a kernel; the kernels built on
+# it are detected via their stream_row_tiles call sites instead
+EXEMPT_MODULES = frozenset({"toolkit"})
+
+
+def _module_paths(ops_root: Path) -> List[Path]:
+    return sorted(p for p in ops_root.glob("*.py") if p.name != "__init__.py")
+
+
+def _pallas_call_lines(tree: ast.AST) -> List[int]:
+    """Line numbers of Pallas kernel call sites: direct ``pallas_call``
+    (attribute or bare name) and the toolkit scaffold
+    (``stream_row_tiles`` — the shared gridless pallas_call every
+    row-streaming kernel lowers through)."""
+    lines = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        fn = node.func
+        name = None
+        if isinstance(fn, ast.Attribute):
+            name = fn.attr
+        elif isinstance(fn, ast.Name):
+            name = fn.id
+        if name in ("pallas_call", "stream_row_tiles"):
+            lines.append(node.lineno)
+    return lines
+
+
+def _toplevel_defs(tree: ast.AST) -> set:
+    return {
+        node.name
+        for node in ast.iter_child_nodes(tree)
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef))
+    }
+
+
+def check_kernel_coverage(
+    ops_root: Optional[Path] = None,
+    registry: Optional[Sequence] = None,
+    repo_root: Optional[Path] = None,
+) -> List[Finding]:
+    """Run the coverage rule.  ``ops_root``/``registry``/``repo_root``
+    default to the live tree and ``toolkit.TWIN_REGISTRY`` — the
+    overrides exist so the mutation tests can point the rule at a
+    doctored tree and prove it fires."""
+    from ringpop_tpu.ops import toolkit
+
+    if ops_root is None:
+        ops_root = Path(toolkit.__file__).resolve().parent
+    if repo_root is None:
+        repo_root = ops_root.parents[1]
+    if registry is None:
+        registry = toolkit.TWIN_REGISTRY
+
+    findings: List[Finding] = []
+    trees = {}
+    kernel_modules = {}
+    for path in _module_paths(ops_root):
+        try:
+            tree = ast.parse(path.read_text())
+        except SyntaxError as e:
+            findings.append(
+                Finding(
+                    rule="unregistered-kernel",
+                    path=str(path),
+                    line=e.lineno or 0,
+                    message="unparseable ops module: %s" % e,
+                    prong=PRONG,
+                )
+            )
+            continue
+        trees[path.stem] = tree
+        lines = _pallas_call_lines(tree)
+        if lines and path.stem not in EXEMPT_MODULES:
+            kernel_modules[path.stem] = (path, lines)
+
+    by_module: dict = {}
+    for row in registry:
+        by_module.setdefault(row.module, []).append(row)
+
+    for mod, (path, lines) in sorted(kernel_modules.items()):
+        if mod not in by_module:
+            findings.append(
+                Finding(
+                    rule="unregistered-kernel",
+                    path=str(path),
+                    line=lines[0],
+                    message=(
+                        "ops/%s.py holds a pallas_call but has no "
+                        "toolkit.TWIN_REGISTRY row — register its "
+                        "bit-exact twin and gate-equivalence test"
+                        % mod
+                    ),
+                    prong=PRONG,
+                )
+            )
+
+    for row in registry:
+        if row.module not in trees:
+            findings.append(
+                Finding(
+                    rule="stale-registry-row",
+                    path="<registry:%s>" % row.module,
+                    line=0,
+                    message=(
+                        "TWIN_REGISTRY names ops module %r which does "
+                        "not exist" % row.module
+                    ),
+                    prong=PRONG,
+                )
+            )
+            continue
+        if row.module not in kernel_modules:
+            findings.append(
+                Finding(
+                    rule="stale-registry-row",
+                    path="<registry:%s>" % row.module,
+                    line=0,
+                    message=(
+                        "TWIN_REGISTRY row %s.%s registered but "
+                        "ops/%s.py holds no pallas_call — drop the row"
+                        % (row.module, row.kernel_entry, row.module)
+                    ),
+                    prong=PRONG,
+                )
+            )
+        if row.kernel_entry not in _toplevel_defs(trees[row.module]):
+            findings.append(
+                Finding(
+                    rule="missing-kernel-entry",
+                    path="<registry:%s>" % row.module,
+                    line=0,
+                    message=(
+                        "registered kernel entry %s.%s does not exist"
+                        % (row.module, row.kernel_entry)
+                    ),
+                    prong=PRONG,
+                )
+            )
+        twin_mod = row.twin_module or row.module
+        if twin_mod not in trees or row.twin_entry not in _toplevel_defs(
+            trees[twin_mod]
+        ):
+            findings.append(
+                Finding(
+                    rule="missing-twin-entry",
+                    path="<registry:%s>" % row.module,
+                    line=0,
+                    message=(
+                        "registered twin %s.%s does not exist"
+                        % (twin_mod, row.twin_entry)
+                    ),
+                    prong=PRONG,
+                )
+            )
+        test_path = repo_root / row.gate_test
+        if not test_path.is_file():
+            findings.append(
+                Finding(
+                    rule="missing-gate-test",
+                    path=row.gate_test,
+                    line=0,
+                    message=(
+                        "gate-equivalence test %s for %s.%s does not "
+                        "exist" % (row.gate_test, row.module,
+                                   row.kernel_entry)
+                    ),
+                    prong=PRONG,
+                )
+            )
+        elif row.kernel_entry not in test_path.read_text():
+            findings.append(
+                Finding(
+                    rule="missing-gate-test",
+                    path=row.gate_test,
+                    line=0,
+                    message=(
+                        "gate test %s never mentions kernel entry %r — "
+                        "a rename orphaned the gate"
+                        % (row.gate_test, row.kernel_entry)
+                    ),
+                    prong=PRONG,
+                )
+            )
+    return findings

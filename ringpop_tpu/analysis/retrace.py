@@ -303,6 +303,32 @@ def _probe_engine_tick() -> Tuple[Callable, List[Tuple[str, Tuple]]]:
     ]
 
 
+def _probe_engine_tick_fused() -> Tuple[Callable, List[Tuple[str, Tuple]]]:
+    """The round-16 fused full-fidelity tick (fused_tick="xla"): the
+    fused apply/piggyback sites must hold the same cache discipline as
+    the classic shape — new values cache-hit, a pytree-structure flip
+    is the one budgeted recompile."""
+    import functools
+
+    import jax
+    import jax.numpy as jnp
+
+    from ringpop_tpu.analysis import jaxpr_audit as ja
+
+    engine, params, universe, state = ja._sim_setup(8, fused_tick="xla")
+    fn = jax.jit(
+        functools.partial(engine.tick, params=params, universe=universe)
+    )
+    quiet = engine.TickInputs.quiet(8)
+    churn = quiet._replace(kill=jnp.zeros(8, bool).at[3].set(True))
+    resumed = quiet._replace(resume=jnp.zeros(8, bool))
+    return fn, [
+        ("n=8 quiet fused tick", (state, quiet)),
+        ("n=8 churn tick, same structure (expect cache hit)", (state, churn)),
+        ("n=8 resume plane present (expect recompile)", (state, resumed)),
+    ]
+
+
 def _probe_engine_scalable_tick() -> Tuple[Callable, List[Tuple[str, Tuple]]]:
     import functools
 
@@ -465,6 +491,7 @@ DEFAULT_PROBES: List[Probe] = [
     Probe("fused-checksum-xla", _probe_fused_checksum_xla),
     Probe("ring-device-lookup", _probe_ring_lookup),
     Probe("engine-tick", _probe_engine_tick),
+    Probe("engine-tick-fused", _probe_engine_tick_fused),
     Probe("engine-scalable-tick", _probe_engine_scalable_tick),
     Probe("exchange-xla", _probe_exchange_xla),
     Probe("exchange-plane", _probe_exchange_plane),

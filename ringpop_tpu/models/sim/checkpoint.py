@@ -64,6 +64,12 @@ _TRAJECTORY_NEUTRAL_PARAMS = frozenset(
         # the record cache is rebuilt from (known, status, inc) on load
         "fused_checksum",
         "cell_batch",
+        # fused full-fidelity tick (round 16): bitwise-identical
+        # trajectories in every mode (tests/models/test_fused_tick.py),
+        # and drivers pin backend-resolved values at construction — a
+        # TPU-saved checkpoint (fused_tick="pallas") must load on a CPU
+        # resume ("xla"/"off"), and pre-round-16 checkpoints lack the key
+        "fused_tick",
         # flight recorder / wavefront tracing: write-only telemetry
         # planes, trajectory-neutral by construction (nothing in the
         # protocol reads them) — a resume may toggle or resize freely;

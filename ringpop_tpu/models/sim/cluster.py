@@ -233,6 +233,13 @@ class SimCluster(CheckpointableMixin):
         self.params = params or engine.SimParams(n=n)
         if self.params.n != n:
             self.params = self.params._replace(n=n)
+        # pre-resolution requests, kept for the toolkit's op_resolution
+        # observability notes (attach_recorder)
+        self._requested_knobs = {
+            "fused_checksum": self.params.fused_checksum,
+            "fused_tick": self.params.fused_tick,
+            "parity_recompute": self.params.parity_recompute,
+        }
         self.params = _resolve_hash_impl(self.params)
         self.state = engine.init_state(self.params, seed=seed, universe=self.universe)
         # shared per-(params, universe) executables — a fresh SimCluster
@@ -261,9 +268,47 @@ class SimCluster(CheckpointableMixin):
         folded into it (per-tick rows + totals/histograms), and bounded-
         parity overflow replays are logged as events.  The recorder's
         config is enriched with this cluster's static telemetry context
-        (engine params incl. which checksum-recompute path is compiled)."""
+        (engine params incl. which checksum-recompute path is compiled).
+        Every backend-resolved fused-op knob lands as an
+        ``op_resolution`` event row (the toolkit's shared observability
+        shape, round 16)."""
+        import jax as _jax
+
+        from ringpop_tpu.ops import toolkit
+
         recorder.describe("sim.engine", self.params.n, self.params)
+        backend = _jax.default_backend()
+        for knob in ("fused_checksum", "fused_tick", "parity_recompute"):
+            toolkit.emit_resolution(
+                toolkit.resolution_note(
+                    knob,
+                    self._requested_knobs.get(knob, "auto"),
+                    getattr(self.params, knob),
+                    backend,
+                ),
+                recorder=recorder,
+            )
         self.recorder = recorder
+
+    def emit_resolution_stat(self, bridge) -> None:
+        """Publish the resolved fused-op knobs to a statsd bridge — the
+        toolkit's shared gauge shape (``sim.<knob>.*``)."""
+        import jax as _jax
+
+        from ringpop_tpu.ops import toolkit
+
+        backend = _jax.default_backend()
+        for knob in ("fused_checksum", "fused_tick"):
+            toolkit.emit_resolution(
+                toolkit.resolution_note(
+                    knob,
+                    self._requested_knobs.get(knob, "auto"),
+                    getattr(self.params, knob),
+                    backend,
+                ),
+                statsd=bridge,
+                gauge_prefix="sim.%s" % knob,
+            )
 
     # -- bounded-parity overflow fallback --------------------------------
 

@@ -77,7 +77,11 @@ import jax.numpy as jnp
 import numpy as np
 
 from ringpop_tpu.ops import checksum_encode as ce
+from ringpop_tpu.ops import fused_apply as fap
+from ringpop_tpu.ops import fused_piggyback as fpb
 from ringpop_tpu.ops import jax_farmhash as jfh
+from ringpop_tpu.ops import toolkit
+from ringpop_tpu.ops.exchange import popcount_u32
 from ringpop_tpu.models.sim.gating import phase as _phase
 from ringpop_tpu.ops.record_mix import record_mix
 
@@ -196,6 +200,22 @@ class SimParams(NamedTuple):
     # boundaries block fusion and serialize the program, and vmapped
     # multi-cluster batching turns conds into run-both selects anyway.
     gate_phases: bool = True
+    # Fused full-fidelity tick (round 16, ops/fused_apply.py +
+    # ops/fused_piggyback.py): route the tick's six membership-update
+    # application sites and four piggyback-budget sites through the
+    # toolkit's fused row-streaming ops instead of the classic
+    # phase-by-phase temporaries.  "pallas" = the gridless streaming
+    # kernels ([N_tile, N] VMEM tiles; interpret off-TPU), "xla" = the
+    # bit-exact pure-XLA twins (the CPU production path — the fused
+    # sites return per-row/scalar reductions instead of dense [N, N]
+    # started/refuted/applied masks, so far fewer full planes cross the
+    # phase cond boundaries per tick), "off" = the classic shape (the
+    # A/B baseline).  "auto" resolves at SimCluster construction
+    # (resolve_fused_tick): "pallas" on TPU, "xla" elsewhere.
+    # Bitwise-identical trajectories and metrics in every mode — pinned
+    # by tests/models/test_fused_tick.py across gate_phases x
+    # histograms x flight_recorder.
+    fused_tick: str = "auto"
     # Device-side protocol flight recorder (models/sim/flight.py +
     # obs/events.py): when True the tick appends structured int32 event
     # records — pings, view changes, suspect/faulty verdicts, full
@@ -357,21 +377,10 @@ class TickMetrics(NamedTuple):
     dirty_rows: jax.Array
 
 
-def _overrides(u_status, u_inc, c_status, c_inc):
-    """The exact SWIM precedence table (member.js:171-202), vectorized."""
-    alive_ov = (u_status == ALIVE) & (u_inc > c_inc)
-    suspect_ov = (u_status == SUSPECT) & (
-        ((c_status == SUSPECT) & (u_inc > c_inc))
-        | ((c_status == FAULTY) & (u_inc > c_inc))
-        | ((c_status == ALIVE) & (u_inc >= c_inc))
-    )
-    faulty_ov = (u_status == FAULTY) & (
-        ((c_status == SUSPECT) & (u_inc >= c_inc))
-        | ((c_status == FAULTY) & (u_inc > c_inc))
-        | ((c_status == ALIVE) & (u_inc >= c_inc))
-    )
-    leave_ov = (u_status == LEAVE) & (c_status != LEAVE) & (u_inc >= c_inc)
-    return alive_ov | suspect_ov | faulty_ov | leave_ov
+# The exact SWIM precedence table (member.js:171-202), vectorized — the
+# single source lives with the fused op (ops never imports upward);
+# classic and fused paths share it by construction.
+_overrides = fap.overrides
 
 
 def stamp_to_ms(stamp: jax.Array, params: "SimParams") -> jax.Array:
@@ -574,22 +583,74 @@ def resolve_fused_checksum(params: "SimParams", backend: str) -> str:
     streaming-kernel pipeline replaces the XLA byte-assembly floor — and
     "off" elsewhere (the CPU's gated dirty-chunk recompute already skips
     quiet ticks, and interpret-mode Pallas would be a slowdown).  An
-    explicit "on"/"off" is honored as-is ("on" requires farmhash mode)."""
-    if params.fused_checksum != "auto":
-        if (
-            params.fused_checksum == "on"
-            and params.checksum_mode != "farmhash"
-        ):
-            raise ValueError(
-                "fused_checksum='on' requires checksum_mode='farmhash' "
-                "(fast mode has no checksum strings to fuse)"
-            )
-        return params.fused_checksum
-    return (
-        "on"
-        if backend == "tpu" and params.checksum_mode == "farmhash"
-        else "off"
+    explicit "on"/"off" is honored as-is ("on" requires farmhash mode).
+    Table mechanics: the shared toolkit resolver (ops.toolkit)."""
+    if params.fused_checksum == "on" and params.checksum_mode != "farmhash":
+        raise ValueError(
+            "fused_checksum='on' requires checksum_mode='farmhash' "
+            "(fast mode has no checksum strings to fuse)"
+        )
+    return toolkit.resolve_impl(
+        "fused_checksum",
+        params.fused_checksum,
+        backend,
+        auto={
+            "tpu": "on" if params.checksum_mode == "farmhash" else "off",
+            "*": "off",
+        },
+        allowed=("on", "off"),
     )
+
+
+def resolve_fused_tick(params: "SimParams", backend: str) -> str:
+    """Resolve ``fused_tick="auto"`` to a concrete "pallas"/"xla"/"off"
+    (SimParams.fused_tick): the gridless streaming kernels on TPU; off
+    TPU the bit-exact XLA twin from n >= 4096 — unlike the
+    checksum/exchange knobs, the twin IS a CPU win at scale (the fused
+    sites return per-row/scalar reductions and a packed applied-cells
+    union instead of dense [N, N] started/refuted/applied masks, so
+    the memory-bound tick crosses fewer plane boundaries): the
+    BENCH_r15 dissemination ladder measured 1.15x at n=4096 and 1.05x
+    at n=8192, but 0.94x at n=1024, where the accumulator bookkeeping
+    outweighs the saved planes — small-n CPU auto therefore keeps the
+    classic shape.  "off" is the classic phase-by-phase program, kept
+    verbatim as the A/B baseline.  Table mechanics: the shared toolkit
+    resolver (ops.toolkit)."""
+    return toolkit.resolve_impl(
+        "fused_tick",
+        params.fused_tick,
+        backend,
+        auto={
+            "tpu": "pallas",
+            "*": "xla" if params.n >= 4096 else "off",
+        },
+        allowed=("pallas", "xla", "off"),
+    )
+
+
+def resolve_sharded_fused_tick(params: "SimParams", backend: str) -> str:
+    """Resolve ``fused_tick`` for a MESH-sharded full engine
+    (ShardedSim) — the round-14 lesson applied up front instead of
+    re-learned: a ``pallas_call`` does not partition under GSPMD, so a
+    sharded tick must never embed the streaming kernels.  The table:
+
+    ==========  =======  ==========================================
+    fused_tick  backend  resolves to
+    ==========  =======  ==========================================
+    auto        tpu      "xla" — the partitionable twin (the
+                         single-device auto pick would be "pallas")
+    auto        other    the single-device pick (the xla twin already
+                         partitions; small-n keeps the classic shape)
+    pallas      any      "xla" — there is no shard-local plane for the
+                         full tick yet, so an explicit pallas drops to
+                         the partitionable twin; the driver surfaces
+                         the divergence via its op_resolution note
+                         (never the PR-5 silent drop)
+    xla / off   any      honored
+    ==========  =======  ==========================================
+    """
+    resolved = resolve_fused_tick(params, backend)
+    return "xla" if resolved == "pallas" else resolved
 
 
 def resolve_exact_recompute(params: "SimParams", backend: str) -> str:
@@ -650,7 +711,8 @@ def resolve_auto_parity(params: "SimParams", backend: str) -> "SimParams":
     waves).  Re-validate on-chip via benchmarks/tpu_measure.py's fused
     phase when the tunnel is up."""
     params = params._replace(
-        fused_checksum=resolve_fused_checksum(params, backend)
+        fused_checksum=resolve_fused_checksum(params, backend),
+        fused_tick=resolve_fused_tick(params, backend),
     )
     if params.parity_recompute == "auto":
         if params.fused_checksum == "on":
@@ -1043,6 +1105,95 @@ def _apply_updates(
     return new_state, gate, start_t, stop_t, refute
 
 
+def _apply_state_of(state: SimState) -> fap.ApplyState:
+    """The ten planes an application site touches, in the fused op's
+    field order."""
+    return fap.ApplyState(
+        known=state.known,
+        status=state.status,
+        inc=state.inc,
+        ch_active=state.ch_active,
+        ch_status=state.ch_status,
+        ch_inc=state.ch_inc,
+        ch_source=state.ch_source,
+        ch_source_inc=state.ch_source_inc,
+        ch_pb=state.ch_pb,
+        susp_deadline=state.susp_deadline,
+    )
+
+
+def _with_apply_state(state: SimState, ast: fap.ApplyState) -> SimState:
+    return state._replace(**ast._asdict())
+
+
+def _apply_site(
+    state: SimState,
+    union: "Optional[jax.Array]",
+    recv_mask: jax.Array,
+    u_status: jax.Array,
+    u_inc: jax.Array,
+    u_source: jax.Array,
+    u_source_inc: jax.Array,
+    now: jax.Array,
+    deadline: jax.Array,
+    *,
+    impl: str,
+    want_masks: bool,
+    want_count: bool = False,
+    want_refute: bool = True,
+    stamp: bool = True,
+):
+    """One membership-update application site, fused-tick aware.
+
+    ``impl == "off"`` is the classic shape VERBATIM — the historical
+    ``_apply_updates`` + caller-side deadline stamp (``stamp=False``
+    reproduces the expiry/join sites, which never stamped) — so the
+    "off" program is byte-for-byte the pre-fused tick and the bench A/B
+    is honest.  Other impls run the fused op (``ops.fused_apply``),
+    which folds the stamp in and returns reductions instead of dense
+    masks.
+
+    Returns ``(state, union, applied_mask_or_None, applied_rows,
+    refute_diag, applied_count)`` — classic mode returns the dense mask
+    with ``applied_rows``/``applied_count`` as None (its callers derive
+    everything from the mask, exactly as before; the unused Nones cost
+    nothing)."""
+    if impl == "off":
+        st2, applied, started, _, refuted = _apply_updates(
+            state, now, recv_mask, u_status, u_inc, u_source, u_source_inc
+        )
+        if stamp:
+            st2 = st2._replace(
+                susp_deadline=jnp.where(
+                    started, deadline, st2.susp_deadline
+                )
+            )
+        return st2, union, applied, None, _self_view(refuted), None
+    out = fap.apply_updates(
+        _apply_state_of(state),
+        recv_mask,
+        u_status,
+        u_inc,
+        u_source,
+        u_source_inc,
+        now,
+        deadline,
+        union,
+        impl=impl,
+        want_masks=want_masks,
+        want_count=want_count,
+        want_refute=want_refute,
+    )
+    return (
+        _with_apply_state(state, out.state),
+        out.union,
+        out.applied,
+        out.applied_rows,
+        out.refute_diag,
+        out.applied_count,
+    )
+
+
 def _rows(m: jax.Array, idx: jax.Array, n: int) -> jax.Array:
     """``m[idx]`` — select whole rows of an [N, N] array by an [N] index.
 
@@ -1084,6 +1235,23 @@ def tick(
 ) -> tuple[SimState, TickMetrics]:
     n = params.n
     gate = params.gate_phases  # static: picks cond vs straight-line phases
+    # fused-tick resolution (static): "off" keeps the classic
+    # phase-by-phase shape verbatim; "xla"/"pallas" route the apply and
+    # piggyback sites through the toolkit's fused ops (direct engine
+    # users may leave "auto" — drivers pinned a concrete value at
+    # construction via resolve_auto_parity, like fused_checksum)
+    ft = resolve_fused_tick(params, jax.default_backend())
+    ft_on = ft != "off"
+    # fused parity mode tracks WHICH cells changed (see changed_mid
+    # below); hoisted here because the fused tick keys its mask
+    # emission on it
+    fused = params.checksum_mode == "farmhash" and (
+        resolve_fused_checksum(params, jax.default_backend()) == "on"
+    )
+    # the obs planes and the fused-checksum cell tracker consume dense
+    # per-site applied masks; without them the fused sites emit only
+    # reductions and no per-site [N, N] mask ever materializes
+    want_masks = params.flight_recorder or params.histograms or fused
     # tick-start views: the flight recorder's old_status baseline (and
     # nothing else — the protocol phases read live state as before)
     prev_known, prev_status = state.known, state.status
@@ -1279,20 +1447,54 @@ def tick(
             jnp.broadcast_to(subject, (n, n)).astype(jnp.int32),  # source = joiner
             jnp.broadcast_to(self_inc[None, :], (n, n)),
         )
+        if ft_on:
+            # the fused tick's packed applied-cells union is seeded
+            # INSIDE this cond — join-free ticks keep the loop-invariant
+            # zeros accumulator and never touch a dense mask
+            return (
+                state,
+                joined,
+                ja_applied if want_masks else None,
+                jnp.any(ja_applied, axis=1),
+                union0 | toolkit.pack_bool_rows(ja_applied),
+            )
         return state, joined, ja_applied
 
-    state, joined, ja_applied = _phase(
-        gate,
-        jnp.any(joiner),
-        _join_phase,
-        lambda s: (s, jnp.zeros(n, bool), jnp.zeros((n, n), bool)),
-        state,
-    )
+    if ft_on:
+        # packed [N, ceil(N/32)] uint32 applied-cells accumulator
+        # (toolkit.pack_bool_rows layout): every fused apply site except
+        # suspicion expiry ORs its gate in-pass (faulty marks are
+        # excluded from changes_applied, exactly like the classic union)
+        union0 = jnp.zeros((n, toolkit.packed_width(n)), jnp.uint32)
+        state, joined, ja_applied, ja_rows, union = _phase(
+            gate,
+            jnp.any(joiner),
+            _join_phase,
+            lambda s: (
+                s,
+                jnp.zeros(n, bool),
+                jnp.zeros((n, n), bool) if want_masks else None,
+                jnp.zeros(n, bool),
+                union0,
+            ),
+            state,
+        )
+    else:
+        union = ja_rows = None  # fused-tick-only accumulators
+        state, joined, ja_applied = _phase(
+            gate,
+            jnp.any(joiner),
+            _join_phase,
+            lambda s: (s, jnp.zeros(n, bool), jnp.zeros((n, n), bool)),
+            state,
+        )
 
     # rows whose VIEW changed so far this tick (revive reset, leave/rejoin
     # self-updates, join merge, makeAlive of joiners) — drives the dirty-row
     # checksum cache in _checksums_where
-    dirty = rv | rejoin | joined | jnp.any(ja_applied, axis=1)
+    dirty = rv | rejoin | joined | (
+        ja_rows if ft_on else jnp.any(ja_applied, axis=1)
+    )
     if inputs.leave is not None:
         dirty = dirty | lv
 
@@ -1300,9 +1502,7 @@ def tick(
     # record cache re-encodes O(changed cells), not O(dirty rows * N).
     # Conservative over-approximations (whole revived/joined rows) are
     # bit-neutral: re-encoding an unchanged cell reproduces its bytes.
-    fused = params.checksum_mode == "farmhash" and (
-        resolve_fused_checksum(params, jax.default_backend()) == "on"
-    )
+    # (`fused` itself is resolved at the top of the tick.)
     changed_mid = None
     if fused:
         changed_mid = (
@@ -1444,6 +1644,18 @@ def tick(
     # nothing to select or bump when every change table is empty (the
     # converged steady state) — cond-gated like the other rare phases
     def _sender_piggyback(state):
+        if ft_on:
+            out = fpb.pb_budget(
+                state.ch_active,
+                state.ch_pb,
+                valid_send.astype(jnp.int32),
+                max_pb,
+                impl=ft,
+            )
+            state = state._replace(
+                ch_pb=out.ch_pb, ch_active=out.ch_active
+            )
+            return state, out.content, out.drops
         bump = valid_send[:, None] & state.ch_active
         ch_pb = state.ch_pb + bump.astype(jnp.int32)
         over = state.ch_active & (ch_pb > max_pb[:, None])
@@ -1476,7 +1688,13 @@ def tick(
     seg = jnp.where(delivered, target, n)  # undelivered -> dropped segment
     msg_content = sendable & delivered[:, None]
 
-    def _receive_phase(state):
+    # the suspicion-deadline stamp every in-tick start uses (classic
+    # sites computed it inline; one shared traced value, CSE'd anyway)
+    deadline = tick_next + params.suspicion_ticks
+
+    def _combine_ping(state):
+        """The ping-message winner-combine (shared by the classic and
+        fused receive shapes — only the APPLY differs between them)."""
         keys = jnp.where(
             msg_content,
             _pack_key(state.ch_inc, state.ch_status),
@@ -1517,28 +1735,47 @@ def tick(
             seg,
             num_segments=n + 1,
         )[:n]
-        state, applied_ping, started, _, refuted = _apply_updates(
-            state, now, recv_mask, u_status, u_inc, u_source, u_source_inc
-        )
-        state = state._replace(
-            susp_deadline=jnp.where(
-                started, tick_next + params.suspicion_ticks, state.susp_deadline
-            )
+        return recv_mask, u_status, u_inc, u_source, u_source_inc
+
+    def _receive_phase(state, union=None):
+        upd = _combine_ping(state)
+        state, union, applied_ping, rows, refute_diag, _cnt = _apply_site(
+            state, union, *upd, now, deadline, impl=ft,
+            want_masks=want_masks,
         )
         # refute cells live on the diagonal only (is_self), so the [N]
         # diagonal carries the full mask — the flight recorder's
         # per-refuter view; metrics sum it (identical to the old matrix
         # sum)
-        return state, applied_ping, _self_view(refuted)
+        if ft_on:
+            return state, union, applied_ping, rows, refute_diag
+        return state, applied_ping, refute_diag
 
-    state, applied_ping, refute_recv = _phase(
-        gate,
-        jnp.any(msg_content),
-        _receive_phase,
-        lambda s: (s, jnp.zeros((n, n), bool), jnp.zeros(n, bool)),
-        state,
-    )
-    dirty = dirty | jnp.any(applied_ping, axis=1)
+    if ft_on:
+        state, union, applied_ping, rows_ping, refute_recv = _phase(
+            gate,
+            jnp.any(msg_content),
+            _receive_phase,
+            lambda s, u: (
+                s,
+                u,
+                jnp.zeros((n, n), bool) if want_masks else None,
+                jnp.zeros(n, bool),
+                jnp.zeros(n, bool),
+            ),
+            state,
+            union,
+        )
+        dirty = dirty | rows_ping
+    else:
+        state, applied_ping, refute_recv = _phase(
+            gate,
+            jnp.any(msg_content),
+            _receive_phase,
+            lambda s: (s, jnp.zeros((n, n), bool), jnp.zeros(n, bool)),
+            state,
+        )
+        dirty = dirty | jnp.any(applied_ping, axis=1)
     if fused:
         changed_mid = changed_mid | applied_ping
 
@@ -1553,6 +1790,9 @@ def tick(
     )[:n]
 
     def _receiver_bump(state):
+        # the origin filter's per-cell gathers by ch_source stay in XLA
+        # either way (the toolkit convention: dynamic gathers never
+        # live inside a row-tiled kernel)
         src_c = jnp.clip(state.ch_source, 0, n - 1)
         origin_hit = (
             state.ch_active
@@ -1561,6 +1801,19 @@ def tick(
             & (target[src_c] == node)
             & (state.ch_source_inc == sent_self_inc[src_c])
         )
+        if ft_on:
+            out = fpb.pb_budget(
+                state.ch_active,
+                state.ch_pb,
+                nrecv,
+                max_pb,
+                origin_hit.astype(jnp.int32),
+                impl=ft,
+            )
+            state = state._replace(
+                ch_pb=out.ch_pb, ch_active=out.ch_active
+            )
+            return state, out.content, out.drops
         bump_r = (nrecv[:, None] > 0) & state.ch_active
         nbump = jnp.where(
             bump_r, nrecv[:, None] - origin_hit.astype(jnp.int32), 0
@@ -1631,38 +1884,75 @@ def tick(
             _rows(state.ch_source_inc, tgt, n),
         )
         apply_resp = resp_mask | fs_mask
-        state, applied_resp, started_r, _, refuted_r = _apply_updates(
-            state, now, apply_resp, r_status, r_inc, r_source, r_source_inc
-        )
-        state = state._replace(
-            susp_deadline=jnp.where(
-                started_r, tick_next + params.suspicion_ticks, state.susp_deadline
+        state, union_r, applied_resp, rows, refute_diag, _cnt = (
+            _apply_site(
+                state,
+                union,
+                apply_resp,
+                r_status,
+                r_inc,
+                r_source,
+                r_source_inc,
+                now,
+                deadline,
+                impl=ft,
+                want_masks=want_masks,
             )
         )
-        return (
-            state,
-            applied_resp,
-            full_sync,
-            _self_view(refuted_r),
-            # per-sender record counts (rows of the full-sync payloads);
-            # the scalar metric is their sum, the flight recorder wants
-            # them per event
-            jnp.sum(fs_mask, axis=1, dtype=jnp.int32),
-        )
+        # per-sender record counts (rows of the full-sync payloads);
+        # the scalar metric is their sum, the flight recorder wants
+        # them per event
+        fs_rec = jnp.sum(fs_mask, axis=1, dtype=jnp.int32)
+        if ft_on:
+            return (
+                state,
+                union_r,
+                applied_resp,
+                rows,
+                full_sync,
+                refute_diag,
+                fs_rec,
+            )
+        return state, applied_resp, full_sync, refute_diag, fs_rec
 
-    state, applied_resp, full_sync, refute_resp, fs_rec_rows = _phase(
-        gate,
-        jnp.any(resp_possible),
-        _response_phase,
-        lambda s: (
-            s,
-            jnp.zeros((n, n), bool),
-            jnp.zeros(n, bool),
-            jnp.zeros(n, bool),
-            jnp.zeros(n, jnp.int32),
-        ),
-        state,
-    )
+    if ft_on:
+        (
+            state,
+            union,
+            applied_resp,
+            rows_resp,
+            full_sync,
+            refute_resp,
+            fs_rec_rows,
+        ) = _phase(
+            gate,
+            jnp.any(resp_possible),
+            _response_phase,
+            lambda s: (
+                s,
+                union,
+                jnp.zeros((n, n), bool) if want_masks else None,
+                jnp.zeros(n, bool),
+                jnp.zeros(n, bool),
+                jnp.zeros(n, bool),
+                jnp.zeros(n, jnp.int32),
+            ),
+            state,
+        )
+    else:
+        state, applied_resp, full_sync, refute_resp, fs_rec_rows = _phase(
+            gate,
+            jnp.any(resp_possible),
+            _response_phase,
+            lambda s: (
+                s,
+                jnp.zeros((n, n), bool),
+                jnp.zeros(n, bool),
+                jnp.zeros(n, bool),
+                jnp.zeros(n, jnp.int32),
+            ),
+            state,
+        )
     fs_records = jnp.sum(fs_rec_rows, dtype=jnp.int32)
 
     # ---- phase 7: ping-req (indirect probe) ---------------------------
@@ -1738,10 +2028,23 @@ def tick(
         # intermediary is reachable (the dissemination.js:142-155 quirk)
         pb0, active0 = state.ch_pb, state.ch_active
         n_slots = jnp.sum(pr_valid, axis=1).astype(jnp.int32)  # [N]
-        new_pb = pb0 + jnp.where(active0, n_slots[:, None], 0)
-        over_pr = active0 & (new_pb > max_pb[:, None])
-        state = state._replace(ch_pb=new_pb, ch_active=active0 & ~over_pr)
-        pb_drops_pr = jnp.sum(over_pr, dtype=jnp.int32)
+        if ft_on:
+            # content mask unused at this site: slot-k message content
+            # (send_k below) is computed from the PRE-bump planes
+            out1 = fpb.pb_budget(
+                active0, pb0, n_slots, max_pb, impl=ft, want_content=False
+            )
+            state = state._replace(
+                ch_pb=out1.ch_pb, ch_active=out1.ch_active
+            )
+            pb_drops_pr = out1.drops
+        else:
+            new_pb = pb0 + jnp.where(active0, n_slots[:, None], 0)
+            over_pr = active0 & (new_pb > max_pb[:, None])
+            state = state._replace(
+                ch_pb=new_pb, ch_active=active0 & ~over_pr
+            )
+            pb_drops_pr = jnp.sum(over_pr, dtype=jnp.int32)
 
         karange = jnp.arange(K_pr, dtype=jnp.int32)
         send_k = (  # [N, K, N]: slot-k message content per sender
@@ -1788,20 +2091,19 @@ def tick(
         u_srcinc_pr = jax.ops.segment_max(
             jnp.where(final_w, srcinc3, NEG), segf, num_segments=n + 1
         )[:n]
-        state, applied_prm, started_m, _, refuted_m = _apply_updates(
-            state,
-            now,
-            recv_mask_pr,
-            (recv_key_pr % 4).astype(jnp.int32),
-            recv_key_pr // 4,
-            u_source_pr,
-            u_srcinc_pr,
-        )
-        state = state._replace(
-            susp_deadline=jnp.where(
-                started_m,
-                tick_next + params.suspicion_ticks,
-                state.susp_deadline,
+        state, union_pr, applied_prm, rows_prm, refute_m, _cm = (
+            _apply_site(
+                state,
+                union,
+                recv_mask_pr,
+                (recv_key_pr % 4).astype(jnp.int32),
+                recv_key_pr // 4,
+                u_source_pr,
+                u_srcinc_pr,
+                now,
+                deadline,
+                impl=ft,
+                want_masks=want_masks,
             )
         )
         # -- leg 3: responses (issueAsReceiver per arriving ping-req) --
@@ -1822,15 +2124,30 @@ def tick(
             jnp.take_along_axis(cnt_sm, src_c, axis=1),
             0,
         )
-        bump_pr = (prrecv[:, None] > 0) & state.ch_active
-        nb = jnp.where(bump_pr, prrecv[:, None] - hits, 0)
-        ch_pb2 = state.ch_pb + nb
-        over2 = state.ch_active & (ch_pb2 > max_pb[:, None])
-        respondable_pr = bump_pr & ~over2
-        state = state._replace(
-            ch_pb=ch_pb2, ch_active=state.ch_active & ~over2
-        )
-        pb_drops_pr = pb_drops_pr + jnp.sum(over2, dtype=jnp.int32)
+        if ft_on:
+            out3 = fpb.pb_budget(
+                state.ch_active,
+                state.ch_pb,
+                prrecv,
+                max_pb,
+                hits,
+                impl=ft,
+            )
+            respondable_pr = out3.content
+            state = state._replace(
+                ch_pb=out3.ch_pb, ch_active=out3.ch_active
+            )
+            pb_drops_pr = pb_drops_pr + out3.drops
+        else:
+            bump_pr = (prrecv[:, None] > 0) & state.ch_active
+            nb = jnp.where(bump_pr, prrecv[:, None] - hits, 0)
+            ch_pb2 = state.ch_pb + nb
+            over2 = state.ch_active & (ch_pb2 > max_pb[:, None])
+            respondable_pr = bump_pr & ~over2
+            state = state._replace(
+                ch_pb=ch_pb2, ch_active=state.ch_active & ~over2
+            )
+            pb_drops_pr = pb_drops_pr + jnp.sum(over2, dtype=jnp.int32)
 
         # response content per slot, winner-combined at the sender (max
         # key; ties keep the lowest slot): filtered changes, or the
@@ -1892,20 +2209,19 @@ def tick(
             best_key = jnp.where(better, key_k, best_key)
             best_src = jnp.where(better, src_k, best_src)
             best_srcinc = jnp.where(better, srcinc_k, best_srcinc)
-        state, applied_prr, started_r, _, refuted_rr = _apply_updates(
-            state,
-            now,
-            best_key >= 0,
-            (best_key % 4).astype(jnp.int32),
-            best_key // 4,
-            best_src,
-            best_srcinc,
-        )
-        state = state._replace(
-            susp_deadline=jnp.where(
-                started_r,
-                tick_next + params.suspicion_ticks,
-                state.susp_deadline,
+        state, union_pr, applied_prr, rows_prr, refute_rr, _cr = (
+            _apply_site(
+                state,
+                union_pr,
+                best_key >= 0,
+                (best_key % 4).astype(jnp.int32),
+                best_key // 4,
+                best_src,
+                best_srcinc,
+                now,
+                deadline,
+                impl=ft,
+                want_masks=want_masks,
             )
         )
 
@@ -1915,20 +2231,43 @@ def tick(
         sus_mask = jnp.zeros((n, n), bool).at[jnp.arange(n, dtype=jnp.int32), tgt].set(mark_suspect)
         sus_inc = state.inc[jnp.arange(n, dtype=jnp.int32), tgt]  # member's current inc
         cur_self = _self_view(state.inc)
-        state, applied_sus, started_s, _, _ = _apply_updates(
+        state, union_pr, applied_sus, rows_sus, _rd, sus_cnt = _apply_site(
             state,
-            now,
+            union_pr,
             sus_mask,
             jnp.full((n, n), SUSPECT, jnp.int32),
             jnp.broadcast_to(sus_inc[:, None], (n, n)),
             jnp.broadcast_to(node, (n, n)).astype(jnp.int32),
             jnp.broadcast_to(cur_self[:, None], (n, n)),
+            now,
+            deadline,
+            impl=ft,
+            want_masks=want_masks,
+            want_count=True,
+            want_refute=False,
         )
-        state = state._replace(
-            susp_deadline=jnp.where(
-                started_s, tick_next + params.suspicion_ticks, state.susp_deadline
+        if ft_on:
+            applied_pr = (
+                (applied_prm | applied_prr | applied_sus)
+                if want_masks
+                else None
             )
-        )
+            return (
+                state,
+                union_pr,
+                applied_sus,
+                applied_pr,
+                rows_prm | rows_prr | rows_sus,
+                sus_cnt,
+                ping_req_count,
+                pr_inconclusive,
+                pb_drops_pr,
+                refute_m,
+                refute_rr,
+                jnp.stack(pr_fs_list, axis=1),
+                jnp.stack(pr_fs_rec_list, axis=1),
+                pr_sel,
+            )
         applied_pr = applied_prm | applied_prr | applied_sus
         return (
             state,
@@ -1937,44 +2276,83 @@ def tick(
             ping_req_count,
             pr_inconclusive,
             pb_drops_pr,
-            _self_view(refuted_m),
-            _self_view(refuted_rr),
+            refute_m,
+            refute_rr,
             jnp.stack(pr_fs_list, axis=1),
             jnp.stack(pr_fs_rec_list, axis=1),
             pr_sel,
         )
 
-    (
-        state,
-        applied_sus,
-        applied_pr,
-        ping_req_count,
-        pr_inconclusive,
-        pb_drops_pr,
-        refute_prm,
-        refute_prr,
-        pr_fs_mask,
-        pr_fs_recs,
-        pr_sel,
-    ) = _phase(
-        gate,
-        jnp.any(need_pr),
-        _ping_req_phase,
-        lambda s: (
-            s,
-            jnp.zeros((n, n), bool),
-            jnp.zeros((n, n), bool),
-            jnp.int32(0),
-            jnp.int32(0),
-            jnp.int32(0),
-            jnp.zeros(n, bool),
-            jnp.zeros(n, bool),
-            jnp.zeros((n, K_pr), bool),
-            jnp.zeros((n, K_pr), jnp.int32),
-            jnp.zeros((n, K_pr), jnp.int32),
-        ),
-        state,
-    )
+    if ft_on:
+        (
+            state,
+            union,
+            applied_sus,
+            applied_pr,
+            rows_pr,
+            sus_count,
+            ping_req_count,
+            pr_inconclusive,
+            pb_drops_pr,
+            refute_prm,
+            refute_prr,
+            pr_fs_mask,
+            pr_fs_recs,
+            pr_sel,
+        ) = _phase(
+            gate,
+            jnp.any(need_pr),
+            _ping_req_phase,
+            lambda s: (
+                s,
+                union,
+                jnp.zeros((n, n), bool) if want_masks else None,
+                jnp.zeros((n, n), bool) if want_masks else None,
+                jnp.zeros(n, bool),
+                jnp.int32(0),
+                jnp.int32(0),
+                jnp.int32(0),
+                jnp.int32(0),
+                jnp.zeros(n, bool),
+                jnp.zeros(n, bool),
+                jnp.zeros((n, K_pr), bool),
+                jnp.zeros((n, K_pr), jnp.int32),
+                jnp.zeros((n, K_pr), jnp.int32),
+            ),
+            state,
+        )
+    else:
+        (
+            state,
+            applied_sus,
+            applied_pr,
+            ping_req_count,
+            pr_inconclusive,
+            pb_drops_pr,
+            refute_prm,
+            refute_prr,
+            pr_fs_mask,
+            pr_fs_recs,
+            pr_sel,
+        ) = _phase(
+            gate,
+            jnp.any(need_pr),
+            _ping_req_phase,
+            lambda s: (
+                s,
+                jnp.zeros((n, n), bool),
+                jnp.zeros((n, n), bool),
+                jnp.int32(0),
+                jnp.int32(0),
+                jnp.int32(0),
+                jnp.zeros(n, bool),
+                jnp.zeros(n, bool),
+                jnp.zeros((n, K_pr), bool),
+                jnp.zeros((n, K_pr), jnp.int32),
+                jnp.zeros((n, K_pr), jnp.int32),
+            ),
+            state,
+        )
     pr_fs_count = jnp.sum(pr_fs_mask, dtype=jnp.int32)
     pr_fs_records = jnp.sum(pr_fs_recs, dtype=jnp.int32)
 
@@ -2000,35 +2378,67 @@ def tick(
         state = state._replace(
             susp_deadline=jnp.where(expired, -1, state.susp_deadline)
         )
-        state, applied_faulty, _, _, _ = _apply_updates(
+        # faulty marks are excluded from the changes_applied union, so
+        # the fused site runs with union=None (no accumulation); the
+        # fused stamp fold is a no-op here by construction (a FAULTY
+        # update can never start a suspicion timer)
+        state, _u, applied_faulty, rows, _rd, cnt = _apply_site(
             state,
-            now,
+            None,
             expired,
             jnp.full((n, n), FAULTY, jnp.int32),
             state.inc,  # member's current incarnation (suspicion.js:67-70)
             jnp.broadcast_to(node, (n, n)).astype(jnp.int32),
             jnp.broadcast_to(cur_self_inc[:, None], (n, n)),
+            now,
+            deadline,
+            impl=ft,
+            want_masks=want_masks,
+            want_count=True,
+            want_refute=False,
+            stamp=False,
         )
+        if ft_on:
+            return state, applied_faulty, rows, cnt
         return state, applied_faulty
 
-    state, applied_faulty = _phase(
-        gate,
-        any_deadline,
-        _expiry_phase,
-        lambda s: (s, jnp.zeros((n, n), bool)),
-        state,
-    )
+    if ft_on:
+        state, applied_faulty, rows_faulty, faulty_count = _phase(
+            gate,
+            any_deadline,
+            _expiry_phase,
+            lambda s: (
+                s,
+                jnp.zeros((n, n), bool) if want_masks else None,
+                jnp.zeros(n, bool),
+                jnp.int32(0),
+            ),
+            state,
+        )
+    else:
+        state, applied_faulty = _phase(
+            gate,
+            any_deadline,
+            _expiry_phase,
+            lambda s: (s, jnp.zeros((n, n), bool)),
+            state,
+        )
 
     # ---- phase 9: checksums + metrics ---------------------------------
     # rows untouched since the mid-tick values reuse them; phases 6-8
     # dirty views via responses, the ping-req exchange, and expiry
-    dirty_late = (
-        jnp.any(applied_resp, axis=1)
-        | jnp.any(applied_pr, axis=1)
-        | jnp.any(applied_faulty, axis=1)
-    )
+    if ft_on:
+        dirty_late = rows_resp | rows_pr | rows_faulty
+    else:
+        dirty_late = (
+            jnp.any(applied_resp, axis=1)
+            | jnp.any(applied_pr, axis=1)
+            | jnp.any(applied_faulty, axis=1)
+        )
     changed_late = None
     if fused:
+        # fused-checksum cell tracking forces want_masks, so the dense
+        # per-site masks exist in every tick mode
         changed_late = applied_resp | applied_pr | applied_faulty
     checksum, late_overflow, state = _checksums_where(
         state, universe, params, dirty_late, mid_checksum, changed_late
@@ -2047,18 +2457,30 @@ def tick(
         + (cs_sorted[0] != jnp.uint32(0xFFFFFFFF)).astype(jnp.int32)
     ).astype(jnp.int32)
 
+    if ft_on:
+        # the fused sites fed the union/count reductions in-pass; the
+        # sums below are over the SAME cell sets the classic mask
+        # expressions cover (integer sums — bitwise-identical)
+        changes_applied = jnp.sum(popcount_u32(union), dtype=jnp.int32)
+        suspects_marked = sus_count
+        faulties_marked = faulty_count
+    else:
+        changes_applied = jnp.sum(
+            (applied_ping | applied_resp | applied_pr | ja_applied).astype(
+                jnp.int32
+            )
+        )
+        suspects_marked = jnp.sum(applied_sus.astype(jnp.int32))
+        faulties_marked = jnp.sum(applied_faulty.astype(jnp.int32))
+
     metrics = TickMetrics(
         pings_sent=jnp.sum(valid_send.astype(jnp.int32)),
         pings_delivered=jnp.sum(delivered.astype(jnp.int32)),
         ping_reqs=ping_req_count,
         full_syncs=jnp.sum(full_sync.astype(jnp.int32)) + pr_fs_count,
-        changes_applied=jnp.sum(
-            (applied_ping | applied_resp | applied_pr | ja_applied).astype(
-                jnp.int32
-            )
-        ),
-        suspects_marked=jnp.sum(applied_sus.astype(jnp.int32)),
-        faulties_marked=jnp.sum(applied_faulty.astype(jnp.int32)),
+        changes_applied=changes_applied,
+        suspects_marked=suspects_marked,
+        faulties_marked=faulties_marked,
         distinct_checksums=distinct,
         converged=distinct <= 1,
         parity_overflow=mid_overflow + late_overflow,

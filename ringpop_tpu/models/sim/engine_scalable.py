@@ -463,15 +463,17 @@ def resolve_fused_exchange(params: "ScalableParams", backend: str) -> str:
     (the megakernel's one-HBM-pass win), "off" elsewhere — the CPU's
     inline phases + MXU-limb delta matmul are already exact and
     interpret-mode Pallas would be a slowdown.  "xla" (the op twin) is
-    never auto-picked: it exists for A/B and the equivalence gates."""
-    if params.fused_exchange != "auto":
-        if params.fused_exchange not in ("pallas", "xla", "off"):
-            raise ValueError(
-                "fused_exchange must be auto|pallas|xla|off, got %r"
-                % (params.fused_exchange,)
-            )
-        return params.fused_exchange
-    return "pallas" if backend == "tpu" else "off"
+    never auto-picked: it exists for A/B and the equivalence gates.
+    Table mechanics: the shared toolkit resolver (ops.toolkit)."""
+    from ringpop_tpu.ops import toolkit
+
+    return toolkit.resolve_impl(
+        "fused_exchange",
+        params.fused_exchange,
+        backend,
+        auto={"tpu": "pallas", "*": "off"},
+        allowed=("pallas", "xla", "off"),
+    )
 
 
 def resolve_sharded_exchange(
@@ -513,16 +515,22 @@ def resolve_sharded_exchange(
     """
     if shards < 1:
         raise ValueError("shards must be >= 1, got %d" % (shards,))
+    from ringpop_tpu.ops import toolkit
+
     fe = params.fused_exchange
-    if fe == "auto":
-        return ("shard_map", "pallas" if backend == "tpu" else "xla")
-    if fe == "pallas":
-        return ("shard_map", "pallas")
-    if fe in ("xla", "off"):
-        return ("gspmd", fe)
-    raise ValueError(
-        "fused_exchange must be auto|pallas|xla|off, got %r" % (fe,)
+    # same toolkit table mechanics as the single-device resolver — only
+    # the auto row differs ("xla" off-TPU: under the plane the twin is
+    # the partitionable form, not a slowdown)
+    resolved = toolkit.resolve_impl(
+        "fused_exchange",
+        fe,
+        backend,
+        auto={"tpu": "pallas", "*": "xla"},
+        allowed=("pallas", "xla", "off"),
     )
+    if fe in ("auto", "pallas"):
+        return ("shard_map", resolved)
+    return ("gspmd", resolved)
 
 
 def resolve_scalable_params(

@@ -259,9 +259,40 @@ class ScalableCluster(CheckpointableMixin):
         }
 
     def attach_recorder(self, recorder) -> None:
-        """Attach an obs.RunRecorder; step()/run() metrics fold into it."""
+        """Attach an obs.RunRecorder; step()/run() metrics fold into it.
+        The fused-exchange resolution lands as an ``op_resolution``
+        event row (the toolkit's shared observability shape — the
+        single-device analog of the mesh driver's
+        ``mesh_exchange_resolution``)."""
+        from ringpop_tpu.ops import toolkit
+
         recorder.describe("sim.engine_scalable", self.params.n, self.params)
+        toolkit.emit_resolution(
+            toolkit.resolution_note(
+                "fused_exchange",
+                self._requested_fused_exchange,
+                self.params.fused_exchange,
+                jax.default_backend(),
+            ),
+            recorder=recorder,
+        )
         self.recorder = recorder
+
+    def emit_resolution_stat(self, bridge) -> None:
+        """Publish the fused-exchange resolution to a statsd bridge —
+        the toolkit's shared gauge shape (``sim.fused_exchange.*``)."""
+        from ringpop_tpu.ops import toolkit
+
+        toolkit.emit_resolution(
+            toolkit.resolution_note(
+                "fused_exchange",
+                self._requested_fused_exchange,
+                self.params.fused_exchange,
+                jax.default_backend(),
+            ),
+            statsd=bridge,
+            gauge_prefix="sim.fused_exchange",
+        )
 
     def step(self, inputs: Optional[es.ChurnInputs] = None):
         if inputs is None:

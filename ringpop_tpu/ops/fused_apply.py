@@ -1,0 +1,409 @@
+"""Fused membership-update application — one row-streaming pass over
+the full-fidelity engine's hottest ``[N, N]`` phase.
+
+The full engine applies ``Member.evaluateUpdate`` at six points per tick
+(ping receive, responses, three ping-req legs, suspicion expiry).  The
+classic shape (``engine._apply_updates``) materializes ~a dozen dense
+``[N, N]`` temporaries per call — the precedence gate, ten updated state
+planes, plus ``started`` / ``stop`` / ``refuted`` masks that leave the
+phase's ``lax.cond`` boundary only to be consumed by one more pass each
+(the suspicion-deadline stamp, the refute diagonal read, the metric
+sums).  At n >= 4k every such plane is tens of MB and the tick is
+memory-bound: the boundary crossings ARE the cost.
+
+This op fuses the whole site into one pass per ``[N_tile, N]`` tile:
+
+- the SWIM precedence gate (:func:`overrides` — the ONE copy of the
+  member.js:171-202 table; ``engine._overrides`` aliases it), refute
+  detection, change-table recording, and suspicion timer starts/stops
+  INCLUDING the deadline stamp (the classic path's separate
+  ``where(started, deadline, susp)`` pass folds in);
+- ``started`` / ``stop`` / full ``refuted`` never exist outside the
+  tile: the op returns the refute DIAGONAL (``[N]`` — refutes only live
+  on self cells) and a per-row applied OR (the dirty-row feed), both
+  opt-in per site, plus an opt-in applied-cell count (the
+  suspects/faulties metric feed);
+- an optional running applied-cells union accumulated in-pass as a
+  PACKED ``[N, ceil(N/32)]`` uint32 row bitmask
+  (``toolkit.pack_bool_rows`` — 8x smaller than the bool plane it
+  replaces), so ``changes_applied`` needs no per-site ``[N, N]`` masks
+  and the accumulator crossing every phase boundary stays cheap;
+- the full per-site ``applied`` mask is emitted ONLY under
+  ``want_masks`` (flight recorder / histograms / fused-checksum cell
+  tracking need it; the perf path does not).
+
+Two implementations, the toolkit pattern (``ops.toolkit``):
+
+- ``"pallas"`` — gridless row-streaming kernel, rows tiled to the VPU
+  [8 x 128] geometry by ``toolkit.stream_row_tiles``, tiles beyond the
+  VMEM budget mapped through an outer ``lax.scan``; interpret mode
+  off-TPU keeps tests hermetic.
+- ``"xla"`` — the bit-exact pure-XLA twin: the same formula
+  (:func:`_formula` is shared verbatim between kernel and twin) as
+  plain vector ops — the CPU production path.
+
+Everything here is small-integer/bool arithmetic (selects, compares,
+ORs, bit packs), so every impl agrees bit-for-bit with the classic
+phase code — pinned by tests/ops/test_fused_apply.py and the
+engine-level gate-equivalence suite (tests/models/test_fused_tick.py).
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+
+from ringpop_tpu.ops import toolkit
+
+# status codes (== engine / checksum_encode order): rank order IS
+# override priority at equal incarnation
+ALIVE, SUSPECT, FAULTY, LEAVE = 0, 1, 2, 3
+
+
+def overrides(u_status, u_inc, c_status, c_inc):
+    """The exact SWIM precedence table (member.js:171-202), vectorized —
+    the single source (``engine._overrides`` is an alias)."""
+    alive_ov = (u_status == ALIVE) & (u_inc > c_inc)
+    suspect_ov = (u_status == SUSPECT) & (
+        ((c_status == SUSPECT) & (u_inc > c_inc))
+        | ((c_status == FAULTY) & (u_inc > c_inc))
+        | ((c_status == ALIVE) & (u_inc >= c_inc))
+    )
+    faulty_ov = (u_status == FAULTY) & (
+        ((c_status == SUSPECT) & (u_inc >= c_inc))
+        | ((c_status == FAULTY) & (u_inc > c_inc))
+        | ((c_status == ALIVE) & (u_inc >= c_inc))
+    )
+    leave_ov = (u_status == LEAVE) & (c_status != LEAVE) & (u_inc >= c_inc)
+    return alive_ov | suspect_ov | faulty_ov | leave_ov
+
+
+class ApplyState(NamedTuple):
+    """The ten per-(observer, subject) planes an application site reads
+    and writes — field order is the kernel's ref order."""
+
+    known: jax.Array  # [N, N] bool
+    status: jax.Array  # [N, N] int32
+    inc: jax.Array  # [N, N] int32
+    ch_active: jax.Array  # [N, N] bool
+    ch_status: jax.Array  # [N, N] int32
+    ch_inc: jax.Array  # [N, N] int32
+    ch_source: jax.Array  # [N, N] int32
+    ch_source_inc: jax.Array  # [N, N] int32
+    ch_pb: jax.Array  # [N, N] int32
+    susp_deadline: jax.Array  # [N, N] int32
+
+
+class ApplyOut(NamedTuple):
+    state: ApplyState
+    union: Optional[jax.Array]  # [N, W] uint32 packed union, or None
+    applied: Optional[jax.Array]  # [N, N] bool — only under want_masks
+    applied_rows: Optional[jax.Array]  # [N] bool — per-row applied OR
+    applied_count: Optional[jax.Array]  # [] int32 — opt-in
+    refute_diag: Optional[jax.Array]  # [N] bool — opt-in
+
+
+def _formula(
+    st: ApplyState,
+    recv_mask,
+    u_status,
+    u_inc,
+    u_source,
+    u_source_inc,
+    row_ids,  # [rows, 1] int32 — absolute observer ids
+    now,  # [rows, 1] int32 (or scalar) — this tick's incarnation stamp
+    deadline,  # [rows, 1] int32 (or scalar) — suspicion deadline stamp
+):
+    """One application site's exact cell arithmetic — shared verbatim by
+    the Pallas kernel (on [rows, N] VMEM tiles) and the XLA twin (on
+    full [N, N] planes); bitwise-identical to engine._apply_updates +
+    the caller-side deadline stamp by construction."""
+    cols = jax.lax.broadcasted_iota(jnp.int32, st.status.shape, 1)
+    is_self = cols == row_ids
+
+    refute = (
+        recv_mask
+        & is_self
+        & ((u_status == SUSPECT) | (u_status == FAULTY))
+    )
+    eff_status = jnp.where(refute, ALIVE, u_status)
+    eff_inc = jnp.where(refute, now, u_inc)
+
+    new_member = recv_mask & ~st.known
+    gate = recv_mask & (
+        refute
+        | new_member
+        | overrides(eff_status, eff_inc, st.status, st.inc)
+    )
+
+    status = jnp.where(gate, eff_status, st.status)
+    inc = jnp.where(gate, eff_inc, st.inc)
+    start_t = gate & (status == SUSPECT) & ~is_self
+    stop_t = gate & (status != SUSPECT)
+    out = ApplyState(
+        known=st.known | new_member,
+        status=status,
+        inc=inc,
+        ch_active=st.ch_active | gate,
+        ch_status=jnp.where(gate, status, st.ch_status),
+        ch_inc=jnp.where(gate, inc, st.ch_inc),
+        ch_source=jnp.where(gate, u_source, st.ch_source),
+        ch_source_inc=jnp.where(gate, u_source_inc, st.ch_source_inc),
+        ch_pb=jnp.where(gate, 0, st.ch_pb),
+        # starts and stops are disjoint (status == SUSPECT vs !=), so
+        # folding the stamp in is order-free — bit-identical to the
+        # classic stop-then-start sequence
+        susp_deadline=jnp.where(
+            start_t, deadline, jnp.where(stop_t, -1, st.susp_deadline)
+        ),
+    )
+    return out, gate, refute & is_self
+
+
+def _make_kernel(
+    want_union: bool, want_masks: bool, want_count: bool, want_refute: bool
+):
+    def kernel(*refs):
+        st = ApplyState(*(r[...] for r in refs[:10]))
+        recv, us, ui, usrc, usi = (r[...] for r in refs[10:15])
+        meta = refs[15][...]
+        idx = 16
+        union = None
+        if want_union:
+            union = refs[idx][...]
+            idx += 1
+        outs = refs[idx:]
+        new_st, gate, refute = _formula(
+            st,
+            recv,
+            us,
+            ui,
+            usrc,
+            usi,
+            meta[:, 0:1],
+            meta[:, 1:2],
+            meta[:, 2:3],
+        )
+        o = 0
+        for plane in new_st:
+            outs[o][...] = plane
+            o += 1
+        if want_union:
+            outs[o][...] = union | toolkit.pack_bool_rows(gate)
+            o += 1
+        if want_masks:
+            outs[o][...] = gate
+            o += 1
+        outs[o][...] = jnp.any(gate, axis=1, keepdims=True)
+        o += 1
+        if want_count:
+            outs[o][...] = jnp.sum(
+                gate.astype(jnp.int32),
+                axis=1,
+                keepdims=True,
+                dtype=jnp.int32,
+            )
+            o += 1
+        if want_refute:
+            outs[o][...] = jnp.any(refute, axis=1, keepdims=True)
+
+    return kernel
+
+
+def apply_updates_xla(
+    st: ApplyState,
+    recv_mask,
+    u_status,
+    u_inc,
+    u_source,
+    u_source_inc,
+    now,
+    deadline,
+    union=None,
+    *,
+    want_masks: bool = False,
+    want_count: bool = False,
+    want_refute: bool = True,
+) -> ApplyOut:
+    """The bit-exact pure-XLA twin: full-plane vector ops, one shared
+    formula with the kernel."""
+    n = st.status.shape[0]
+    row_ids = jnp.arange(n, dtype=jnp.int32)[:, None]
+    new_st, gate, refute = _formula(
+        st,
+        recv_mask,
+        u_status,
+        u_inc,
+        u_source,
+        u_source_inc,
+        row_ids,
+        jnp.asarray(now, jnp.int32),
+        jnp.asarray(deadline, jnp.int32),
+    )
+    return ApplyOut(
+        state=new_st,
+        union=None if union is None else (
+            union | toolkit.pack_bool_rows(gate)
+        ),
+        applied=gate if want_masks else None,
+        applied_rows=jnp.any(gate, axis=1),
+        applied_count=(
+            jnp.sum(gate, dtype=jnp.int32) if want_count else None
+        ),
+        refute_diag=(
+            jnp.any(refute, axis=1) if want_refute else None
+        ),
+    )
+
+
+def apply_updates(
+    st: ApplyState,
+    recv_mask,
+    u_status,
+    u_inc,
+    u_source,
+    u_source_inc,
+    now,
+    deadline,
+    union=None,
+    *,
+    impl: Optional[str] = None,
+    want_masks: bool = False,
+    want_count: bool = False,
+    want_refute: bool = True,
+    interpret: Optional[bool] = None,
+    vmem_budget: int = toolkit.DEFAULT_VMEM_BUDGET,
+) -> ApplyOut:
+    """Fused membership-update application at one site.
+
+    ``st``: the ten state planes; ``recv_mask`` [N, N] bool + the four
+    ``u_*`` [N, N] int32 update planes (consumed only under
+    ``recv_mask``); ``now`` / ``deadline``: traced int32 scalars (this
+    tick's incarnation stamp and suspicion-deadline stamp); ``union``:
+    optional [N, ceil(N/32)] uint32 packed running-union accumulator
+    (``toolkit.pack_bool_rows`` layout; None skips the accumulate and
+    returns None).  ``impl``: "pallas" (gridless streaming kernel;
+    interpret off-TPU) or "xla" (the bit-exact twin); None picks per
+    backend.  ``want_masks`` additionally emits the full per-site
+    applied mask (the obs planes' feed); ``want_count`` /
+    ``want_refute`` opt into the applied-cell count and refute-diagonal
+    reductions — sites that don't consume them keep the reduction out
+    of the program entirely.
+    """
+    if len(set(p.shape for p in st)) != 1:
+        raise ValueError("ApplyState planes must share one [N, N] shape")
+    if st.status.shape[0] != st.status.shape[1]:
+        raise ValueError(
+            "apply_updates wants square [N, N] planes, got %r"
+            % (st.status.shape,)
+        )
+    n = st.status.shape[0]
+    if union is not None and union.shape != (n, toolkit.packed_width(n)):
+        raise ValueError(
+            "union must be a packed [N, ceil(N/32)] uint32 bitmask, "
+            "got %r" % (union.shape,)
+        )
+    if impl is None:
+        impl = "pallas" if jax.default_backend() == "tpu" else "xla"
+    if impl == "xla":
+        return apply_updates_xla(
+            st,
+            recv_mask,
+            u_status,
+            u_inc,
+            u_source,
+            u_source_inc,
+            now,
+            deadline,
+            union,
+            want_masks=want_masks,
+            want_count=want_count,
+            want_refute=want_refute,
+        )
+    if impl != "pallas":
+        raise ValueError("unknown apply_updates impl %r" % (impl,))
+    want_union = union is not None
+    meta = jnp.stack(
+        [
+            jnp.arange(n, dtype=jnp.int32),
+            jnp.full((n,), now, jnp.int32),
+            jnp.full((n,), deadline, jnp.int32),
+        ],
+        axis=1,
+    )
+    inputs = list(st) + [
+        recv_mask,
+        u_status,
+        u_inc,
+        u_source,
+        u_source_inc,
+        meta,
+    ]
+    # explicit plane flags: meta and the packed union are narrow per-row
+    # inputs even when their widths collide with n at tiny sizes
+    in_planes = [True] * 15 + [False]
+    ncp_w = (-(-n // toolkit.LANE) * toolkit.LANE) // 32
+    if want_union:
+        # align the packed accumulator to the column-padded tile width
+        # (zero words — exact; cropped back below)
+        w = toolkit.packed_width(n)
+        inputs.append(
+            jnp.pad(union, ((0, 0), (0, ncp_w - w))) if ncp_w > w
+            else union
+        )
+        in_planes.append(False)
+    out_widths: list = ["plane"] * 10
+    out_dtypes: list = [p.dtype for p in st]
+    if want_union:
+        # the kernel packs over the column-padded tile; padded columns
+        # carry gate=0, so cropping back to ceil(n/32) words is exact
+        out_widths.append(ncp_w)
+        out_dtypes.append(jnp.uint32)
+    if want_masks:
+        out_widths.append("plane")
+        out_dtypes.append(jnp.bool_)
+    out_widths.append(1)
+    out_dtypes.append(jnp.bool_)
+    if want_count:
+        out_widths.append(1)
+        out_dtypes.append(jnp.int32)
+    if want_refute:
+        out_widths.append(1)
+        out_dtypes.append(jnp.bool_)
+    outs = toolkit.stream_row_tiles(
+        _make_kernel(want_union, want_masks, want_count, want_refute),
+        inputs,
+        out_widths,
+        out_dtypes,
+        n_cols=n,
+        in_planes=in_planes,
+        vmem_budget=vmem_budget,
+        interpret=interpret,
+    )
+    new_st = ApplyState(*outs[:10])
+    idx = 10
+    new_union = None
+    if want_union:
+        new_union = outs[idx][:, : toolkit.packed_width(n)]
+        idx += 1
+    applied = None
+    if want_masks:
+        applied = outs[idx]
+        idx += 1
+    rows = outs[idx][:, 0]
+    idx += 1
+    cnt = None
+    if want_count:
+        cnt = jnp.sum(outs[idx][:, 0], dtype=jnp.int32)
+        idx += 1
+    refute_diag = outs[idx][:, 0] if want_refute else None
+    return ApplyOut(
+        state=new_st,
+        union=new_union,
+        applied=applied,
+        applied_rows=rows,
+        applied_count=cnt,
+        refute_diag=refute_diag,
+    )

@@ -1,0 +1,208 @@
+"""Fused dissemination budget pass — sender piggyback selection,
+receiver bumps, and retirement accounting in one ``[N_tile, N]`` sweep.
+
+The full engine touches the change table's budget planes at four points
+per tick (sender select in phase 3, receiver bump in phase 5.5, and the
+two ping-req budget bumps), each the same arithmetic: add this round's
+bump count to ``ch_pb``, retire cells past the ``15*ceil(log10(n+1))``
+bound (dissemination.js:41), emit the surviving message-content mask,
+and count the drops.  The classic shape materializes the bump plane,
+the post-bump ``ch_pb``, the ``over`` mask and the content mask as
+separate ``[N, N]`` temporaries per site — this op fuses each site into
+one pass per tile and returns the drop count as a per-row reduction
+(never a dense mask crossing the phase's ``lax.cond`` boundary).
+
+One formula covers all four sites (bitwise-pinned against the classic
+phase code by tests/ops/test_fused_piggyback.py and the engine
+gate-equivalence suite):
+
+- sender select: ``nbump = valid_send`` (0/1), no hits — ``content``
+  is the ``sendable`` mask;
+- receiver bump: ``nbump = nrecv``, ``hits`` = the origin-filter counts
+  (dissemination.js:147-160; computed OUTSIDE the op — the per-cell
+  gathers by ``ch_source`` stay in XLA, the toolkit convention that
+  dynamic gathers never live inside a row-tiled kernel) — ``content``
+  is the ``respondable`` mask;
+- ping-req leg-1: ``nbump = n_slots`` (several bumps per selected
+  intermediary, the bump-even-if-unreachable quirk), content unused;
+- ping-req leg-3: ``nbump = prrecv`` with its own hits plane.
+
+Implementations (the ``ops.toolkit`` pattern): ``"pallas"`` — gridless
+row-streaming kernel via ``toolkit.stream_row_tiles`` — and ``"xla"``,
+the bit-exact twin sharing :func:`_formula` verbatim.  All small-int
+arithmetic: every impl is bitwise-identical.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+
+from ringpop_tpu.ops import toolkit
+
+
+class BudgetOut(NamedTuple):
+    ch_pb: jax.Array  # [N, N] int32 — post-bump piggyback counts
+    ch_active: jax.Array  # [N, N] bool — with over-budget cells retired
+    content: Optional[jax.Array]  # [N, N] bool — surviving message mask
+    drops: jax.Array  # [] int32 — cells retired at this site
+
+
+def _formula(active, pb, hits, nbump_col, max_pb_col):
+    """One budget site's exact cell arithmetic (shared kernel/twin).
+
+    ``nbump_col`` / ``max_pb_col``: [rows, 1] int32 columns; ``hits``:
+    [rows, N] int32 origin-filter counts or None (sites without an
+    origin filter — the None keeps the zeros plane out of the program
+    entirely).  Matches the classic phase code cell-for-cell: rows
+    with ``nbump == 0`` add 0 either way, so gating the add on
+    ``nbump > 0`` is bit-neutral (phase 3 gates, ping-req leg 1 does
+    not)."""
+    has = nbump_col > 0
+    eff = jnp.where(
+        active & has,
+        nbump_col - hits if hits is not None else nbump_col,
+        0,
+    )
+    pb2 = pb + eff
+    over = active & (pb2 > max_pb_col)
+    return (
+        pb2,
+        active & ~over,
+        active & has & ~over,  # content: bumped cells that survived
+        over,
+    )
+
+
+def _make_kernel(want_hits: bool, want_content: bool):
+    def kernel(*refs):
+        active = refs[0][...]
+        pb = refs[1][...]
+        meta = refs[2][...]
+        idx = 3
+        if want_hits:
+            hits = refs[idx][...]
+            idx += 1
+        else:
+            hits = None
+        outs = refs[idx:]
+        pb2, active2, content, over = _formula(
+            active, pb, hits, meta[:, 0:1], meta[:, 1:2]
+        )
+        outs[0][...] = pb2
+        outs[1][...] = active2
+        o = 2
+        if want_content:
+            outs[o][...] = content
+            o += 1
+        outs[o][...] = jnp.sum(
+            over.astype(jnp.int32), axis=1, keepdims=True, dtype=jnp.int32
+        )
+
+    return kernel
+
+
+def pb_budget_xla(
+    ch_active,
+    ch_pb,
+    nbump,
+    max_pb,
+    hits=None,
+    *,
+    want_content: bool = True,
+) -> BudgetOut:
+    """The bit-exact pure-XLA twin: full-plane vector ops, one shared
+    formula with the kernel."""
+    pb2, active2, content, over = _formula(
+        ch_active, ch_pb, hits, nbump[:, None], max_pb[:, None]
+    )
+    return BudgetOut(
+        ch_pb=pb2,
+        ch_active=active2,
+        content=content if want_content else None,
+        drops=jnp.sum(over, dtype=jnp.int32),
+    )
+
+
+def pb_budget(
+    ch_active,
+    ch_pb,
+    nbump,
+    max_pb,
+    hits=None,
+    *,
+    impl: Optional[str] = None,
+    want_content: bool = True,
+    interpret: Optional[bool] = None,
+    vmem_budget: int = toolkit.DEFAULT_VMEM_BUDGET,
+) -> BudgetOut:
+    """Fused piggyback budget pass at one dissemination site.
+
+    ``ch_active`` [N, N] bool / ``ch_pb`` [N, N] int32: the change
+    table's budget planes; ``nbump`` [N] int32: this site's per-row
+    bump count; ``max_pb`` [N] int32: the per-row retirement bound;
+    ``hits``: optional [N, N] int32 origin-filter counts subtracted
+    from bumped cells.  ``impl``: "pallas" (gridless streaming kernel;
+    interpret off-TPU) or "xla" (the bit-exact twin); None picks per
+    backend.  ``want_content=False`` drops the [N, N] content-mask
+    output from the program (the ping-req leg-1 site consumes only the
+    budget planes)."""
+    if ch_active.shape != ch_pb.shape or ch_active.ndim != 2:
+        raise ValueError(
+            "pb_budget wants matching [N, N] planes, got %r / %r"
+            % (ch_active.shape, ch_pb.shape)
+        )
+    if nbump.shape != (ch_pb.shape[0],) or max_pb.shape != nbump.shape:
+        raise ValueError(
+            "nbump/max_pb must be [N] vectors, got %r / %r"
+            % (nbump.shape, max_pb.shape)
+        )
+    if impl is None:
+        impl = "pallas" if jax.default_backend() == "tpu" else "xla"
+    if impl == "xla":
+        return pb_budget_xla(
+            ch_active,
+            ch_pb,
+            nbump,
+            max_pb,
+            hits,
+            want_content=want_content,
+        )
+    if impl != "pallas":
+        raise ValueError("unknown pb_budget impl %r" % (impl,))
+    n = ch_pb.shape[0]
+    meta = jnp.stack(
+        [nbump.astype(jnp.int32), max_pb.astype(jnp.int32)], axis=1
+    )
+    inputs = [ch_active, ch_pb, meta]
+    # explicit plane flags: meta is a narrow per-row input even when
+    # its width collides with n at tiny sizes
+    in_planes = [True, True, False]
+    want_hits = hits is not None
+    if want_hits:
+        inputs.append(hits)
+        in_planes.append(True)
+    out_widths = ["plane", "plane"]
+    out_dtypes = [jnp.int32, jnp.bool_]
+    if want_content:
+        out_widths.append("plane")
+        out_dtypes.append(jnp.bool_)
+    out_widths.append(1)
+    out_dtypes.append(jnp.int32)
+    outs = toolkit.stream_row_tiles(
+        _make_kernel(want_hits, want_content),
+        inputs,
+        out_widths,
+        out_dtypes,
+        n_cols=n,
+        in_planes=in_planes,
+        vmem_budget=vmem_budget,
+        interpret=interpret,
+    )
+    content = outs[2] if want_content else None
+    drops = jnp.sum(outs[-1][:, 0], dtype=jnp.int32)
+    return BudgetOut(
+        ch_pb=outs[0], ch_active=outs[1], content=content, drops=drops
+    )

@@ -1,0 +1,415 @@
+"""Shared fused-op toolkit — the twice-proven streaming-kernel pattern
+as reusable parts.
+
+Rounds 7 (fused checksum) and 10/14 (fused exchange) each hand-built the
+same four-piece pattern:
+
+1. a **gridless Pallas streaming kernel** — rows tiled onto the VPU's
+   [8 sublanes x 128 lanes] geometry, tiles beyond the VMEM budget
+   mapped through an outer ``lax.scan``, never a grid (the only Pallas
+   shape the axon tunnel's compile helper accepts — PALLAS_BISECT.json);
+2. a **bit-exact pure-XLA twin** — the same exact integer arithmetic as
+   plain vector ops: the CPU production path, the partitionable GSPMD
+   form, and the reference every interpret-mode kernel test pins
+   against;
+3. **auto resolution** — a per-backend table pinned to concrete values
+   at driver construction (shared executable caches key on params, so a
+   trace-time backend read would alias cache entries), surfaced as an
+   observable runlog event + statsd gauge instead of a silent drop
+   (the round-14 lesson: the PR-5 sharded engine silently fell back to
+   XLA for two rounds);
+4. **registration** — jaxpr-audit entries, astlint TRACED_ENTRIES,
+   retrace probes, COST_BUDGET rows, and a gate-equivalence test, so
+   every kernel is machine-checked callback-free, uint32-disciplined,
+   retrace-budgeted, cost-pinned, and bitwise-twinned.
+
+This module is the single source for pieces 1-3 plus the twin REGISTRY
+that piece 4's machine-checked coverage rule
+(:mod:`ringpop_tpu.analysis.kernel_coverage`) enforces: every
+``pallas_call`` under ``ops/`` must appear here with a bit-exact twin
+and a gate-equivalence test, or the analysis prong fails tier-1.
+
+Backend-gated donation (the PR 8 CPU find — XLA-cache-deserialized CPU
+executables mis-execute buffer donation) stays in
+``models/sim/storm.donate_state_argnums``: donation is a property of
+the jitted *driver* call, not of an individual op, but it is part of
+the pattern contract documented here and in README "Kernel toolkit".
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Callable, Iterable, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+# the VPU tile geometry every streaming kernel in this repo tiles to
+SUB, LANE = 8, 128
+DEFAULT_VMEM_BUDGET = 8 * 1024 * 1024
+
+
+# ---------------------------------------------------------------------------
+# piece 3: the ONE auto-resolution table + observability shape
+#
+# Every fused-op knob in the repo resolves through resolve_impl:
+# engine.resolve_fused_checksum, engine.resolve_fused_tick,
+# engine_scalable.resolve_fused_exchange / resolve_sharded_exchange.
+# Each wrapper owns its table and validation; the mechanics (explicit
+# values honored + validated, "auto" looked up per backend) live here
+# exactly once.
+
+
+def resolve_impl(
+    knob: str,
+    requested: str,
+    backend: str,
+    *,
+    auto: dict,
+    allowed: Sequence[str],
+) -> str:
+    """Resolve a fused-op knob to a concrete impl.
+
+    ``requested`` is the raw param value: anything but "auto" is honored
+    as-is after validation against ``allowed``; "auto" is looked up in
+    the ``auto`` table by ``backend`` ("*" is the fallback row)."""
+    if requested != "auto":
+        if requested not in allowed:
+            raise ValueError(
+                "%s must be auto|%s, got %r"
+                % (knob, "|".join(allowed), requested)
+            )
+        return requested
+    return auto.get(backend, auto["*"])
+
+
+def resolution_note(
+    knob: str,
+    requested: str,
+    resolved: str,
+    backend: str,
+    single_device_resolution: Optional[str] = None,
+    **extra,
+) -> dict:
+    """The runlog-ready resolution dict — the PR-9 mesh note's shape
+    generalized to any fused-op knob.  ``differs_from_single_device``
+    flags an "auto" request whose resolution diverged from the plain
+    single-device pick (the observable replacement for a silent
+    drop)."""
+    sdr = resolved if single_device_resolution is None else (
+        single_device_resolution
+    )
+    note = {
+        "knob": knob,
+        "requested": requested,
+        "impl": resolved,
+        "backend": backend,
+        "single_device_resolution": sdr,
+        "differs_from_single_device": (
+            requested == "auto" and resolved != sdr
+        ),
+    }
+    note.update(extra)
+    return note
+
+
+def emit_resolution(
+    note: dict,
+    recorder=None,
+    statsd=None,
+    *,
+    event: str = "op_resolution",
+    gauge_prefix: Optional[str] = None,
+) -> None:
+    """Publish a resolution note through the obs stack: one runlog event
+    row (obs.RunRecorder) + the PR-9 statsd gauge shape
+    (``<prefix>.resolution_differs`` 1/0, plus ``<prefix>.cap`` when the
+    note carries a static cap).  Either sink may be None."""
+    if recorder is not None:
+        recorder.record_event(event, **note)
+    if statsd is not None and gauge_prefix is not None:
+        statsd.gauge(
+            "%s.resolution_differs" % gauge_prefix,
+            int(bool(note.get("differs_from_single_device", False))),
+        )
+        if note.get("cap") is not None:
+            statsd.gauge("%s.cap" % gauge_prefix, int(note["cap"]))
+
+
+# ---------------------------------------------------------------------------
+# pieces 1-2: kernel spec + tile/VMEM-budget row-streaming scaffold
+
+
+def default_interpret() -> bool:
+    """Interpret mode off-TPU keeps kernel tests hermetic (the exchange
+    / farmhash convention)."""
+    return jax.devices()[0].platform != "tpu"
+
+
+def packed_width(n_cols: int) -> int:
+    """Words per row of a :func:`pack_bool_rows` bitmask."""
+    return -(-n_cols // 32)
+
+
+def pack_bool_rows(mask: jax.Array) -> jax.Array:
+    """[N, M] bool -> [N, ceil(M/32)] uint32 row bitmask (bit c%32 of
+    word c//32 = mask[:, c] — the engine_scalable._pack_mask layout).
+    The shared dense-mask compression for accumulator planes that cross
+    phase boundaries: 8x smaller than a bool plane, exact (popcount
+    sums reproduce bool-mask counts bit-for-bit)."""
+    n, m = mask.shape
+    pad = (-m) % 32
+    if pad:
+        mask = jnp.pad(mask, ((0, 0), (0, pad)))
+    w = mask.reshape(n, -1, 32)
+    bits = (jnp.uint32(1) << jnp.arange(32, dtype=jnp.uint32))[
+        None, None, :
+    ]
+    return jnp.sum(
+        jnp.where(w, bits, jnp.uint32(0)), axis=2, dtype=jnp.uint32
+    )
+
+
+def pad_rows(x: jax.Array, rows: int) -> jax.Array:
+    """Zero-pad the leading axis to a multiple of ``rows``."""
+    pad = (-x.shape[0]) % rows
+    if not pad:
+        return x
+    return jnp.pad(x, ((0, pad),) + ((0, 0),) * (x.ndim - 1))
+
+
+def pad_cols(x: jax.Array, lane: int = LANE) -> jax.Array:
+    """Zero-pad the trailing axis to a multiple of ``lane``."""
+    pad = (-x.shape[-1]) % lane
+    if not pad:
+        return x
+    return jnp.pad(x, ((0, 0),) * (x.ndim - 1) + ((0, pad),))
+
+
+def pick_row_tile(
+    row_bytes: int,
+    *,
+    vmem_budget: int = DEFAULT_VMEM_BUDGET,
+    max_rows: Optional[int] = None,
+    name: str = "kernel",
+) -> int:
+    """The VMEM-budget lever shared by every row-streaming kernel: the
+    largest SUB-multiple row tile whose working set (``row_bytes`` per
+    row, inputs + outputs + double-buffer slack included by the caller)
+    fits the budget.  Refuses loudly — like the exchange kernel — when
+    even one sublane group does not fit, instead of issuing a kernel
+    that OOMs VMEM on chip."""
+    if row_bytes <= 0:
+        raise ValueError("row_bytes must be positive, got %d" % row_bytes)
+    tile = (vmem_budget // row_bytes) // SUB * SUB
+    if max_rows is not None:
+        cap = -(-max_rows // SUB) * SUB
+        tile = min(tile, cap)
+    if tile < SUB:
+        raise ValueError(
+            "%s: one [%d]-row sublane tile needs %d bytes of VMEM > "
+            "budget %d — use the bit-exact XLA twin for shapes this "
+            "wide" % (name, SUB, SUB * row_bytes, vmem_budget)
+        )
+    return tile
+
+
+def stream_row_tiles(
+    kernel: Callable,
+    inputs: Sequence[jax.Array],
+    out_widths: Sequence[object],  # "plane" or int trailing width
+    out_dtypes: Sequence[object],
+    *,
+    n_cols: int,
+    in_planes: Optional[Sequence[bool]] = None,
+    row_tile: Optional[int] = None,
+    vmem_budget: int = DEFAULT_VMEM_BUDGET,
+    interpret: Optional[bool] = None,
+) -> List[jax.Array]:
+    """The gridless row-streaming scaffold (pattern piece 1), extracted
+    from ``ops.exchange._exchange_pallas`` / ``ops.pallas_farmhash``:
+
+    - every input is ``[N, C]``; "plane" inputs (trailing width
+      ``n_cols``) are column-padded to a LANE multiple so the lane axis
+      is register-shaped, narrow per-row vectors ride unpadded.
+      ``in_planes`` flags which inputs are planes EXPLICITLY — pass it
+      whenever a narrow input's width could collide with ``n_cols``
+      (e.g. a packed accumulator at tiny n); when omitted, width ==
+      ``n_cols`` is used as the test;
+    - rows are zero-padded to the row tile and the kernel is invoked
+      once per ``[row_tile, C]`` tile — a single gridless
+      ``pallas_call`` when one tile covers all rows, otherwise an outer
+      ``lax.scan`` over tiles (never a grid: the tunnel-validated
+      shape);
+    - outputs are declared by trailing width: the string ``"plane"``
+      means padded-``n_cols`` wide (cropped back to ``n_cols``), an int
+      is a narrow per-row output (row-ORs, per-row counts).  Padded
+      rows/columns are zero on every input, so reductions over them are
+      exact — kernels must preserve that (mask work by an input, not by
+      position).
+
+    Returns the outputs cropped back to ``[N, width]``.
+    """
+    if interpret is None:
+        interpret = default_interpret()
+    from jax.experimental import pallas as pl
+
+    n = inputs[0].shape[0]
+    ncp = -(-n_cols // LANE) * LANE
+    if in_planes is None:
+        in_planes = [x.shape[-1] == n_cols for x in inputs]
+    elif len(in_planes) != len(inputs):
+        raise ValueError(
+            "in_planes must flag every input: %d flags for %d inputs"
+            % (len(in_planes), len(inputs))
+        )
+    padded = [
+        pad_cols(x) if is_plane else x
+        for x, is_plane in zip(inputs, in_planes)
+    ]
+    # out_widths are static Python ints/strings (op-shape metadata,
+    # never traced values)
+    widths = [ncp if w == "plane" else int(w) for w in out_widths]  # jaxgate: ignore[host-coerce]
+    row_bytes = sum(
+        x.shape[-1] * x.dtype.itemsize for x in padded
+    ) + sum(
+        w * jnp.dtype(dt).itemsize for w, dt in zip(widths, out_dtypes)
+    )
+    if row_tile is None:
+        # x2: double-buffered HBM<->VMEM copies in flight
+        row_tile = pick_row_tile(
+            2 * row_bytes,
+            vmem_budget=vmem_budget,
+            max_rows=n,
+            name="stream_row_tiles",
+        )
+    padded = [pad_rows(x, row_tile) for x in padded]
+    nrt = padded[0].shape[0] // row_tile
+    tiles = tuple(
+        x.reshape(nrt, row_tile, x.shape[-1]) for x in padded
+    )
+    out_shape = [
+        jax.ShapeDtypeStruct((row_tile, w), dt)
+        for w, dt in zip(widths, out_dtypes)
+    ]
+    call = pl.pallas_call(kernel, out_shape=out_shape, interpret=interpret)
+    if nrt == 1:
+        outs = call(*(t[0] for t in tiles))
+        outs = tuple(o[None] for o in outs)
+    else:
+        def step(_, xs):
+            return None, tuple(call(*xs))
+
+        _, outs = jax.lax.scan(step, None, tiles)
+    cropped = []
+    for o, w, want in zip(outs, widths, out_widths):
+        flat = o.reshape(nrt * row_tile, w)[:n]
+        cropped.append(flat[:, :n_cols] if want == "plane" else flat)
+    return cropped
+
+
+# ---------------------------------------------------------------------------
+# piece 4: the machine-checked twin registry
+#
+# Every Pallas kernel under ops/ MUST be registered here with its
+# bit-exact twin and the test that gates their equivalence — the
+# analysis.kernel_coverage prong walks ops/ for pallas_call sites and
+# fails tier-1 on any unregistered kernel (mutation-tested in
+# tests/analysis/test_kernel_coverage.py).
+
+
+@dataclasses.dataclass(frozen=True)
+class KernelTwin:
+    """One registered pallas kernel <-> bit-exact twin pair.
+
+    ``module``: ops/ module basename holding the ``pallas_call``;
+    ``kernel_entry``: the public entry that lowers to it;
+    ``twin_entry``: the pure-XLA twin (``twin_module`` when it lives in
+    a sibling ops/ module); ``gate_test``: repo-relative test file that
+    pins kernel-vs-twin bitwise equality and mentions ``kernel_entry``
+    by name."""
+
+    module: str
+    kernel_entry: str
+    twin_entry: str
+    gate_test: str
+    twin_module: Optional[str] = None
+
+
+TWIN_REGISTRY: Tuple[KernelTwin, ...] = (
+    # round 2/7: the farmhash block walk (grid + gridless forms) twins
+    # the scanned XLA lowering in jax_farmhash.hash32_rows
+    KernelTwin(
+        "pallas_farmhash",
+        "block_loop",
+        "hash32_rows",
+        "tests/ops/test_jax_farmhash.py",
+        twin_module="jax_farmhash",
+    ),
+    KernelTwin(
+        "pallas_farmhash",
+        "block_loop_nogrid",
+        "hash32_rows",
+        "tests/ops/test_jax_farmhash.py",
+        twin_module="jax_farmhash",
+    ),
+    # round 7: the fused checksum assemble+hash streaming kernel
+    KernelTwin(
+        "pallas_farmhash",
+        "fused_stream_nogrid",
+        "fused_stream_xla",
+        "tests/ops/test_fused_checksum.py",
+    ),
+    # round 10/14: the fused push-pull exchange megakernel
+    KernelTwin(
+        "exchange",
+        "exchange",
+        "exchange_xla",
+        "tests/ops/test_exchange.py",
+    ),
+    # round 16: the fused full-tick membership-update pass
+    KernelTwin(
+        "fused_apply",
+        "apply_updates",
+        "apply_updates_xla",
+        "tests/ops/test_fused_apply.py",
+    ),
+    # round 16: the fused dissemination budget pass
+    KernelTwin(
+        "fused_piggyback",
+        "pb_budget",
+        "pb_budget_xla",
+        "tests/ops/test_fused_piggyback.py",
+    ),
+)
+
+
+def twins_for_module(module: str) -> Tuple[KernelTwin, ...]:
+    return tuple(t for t in TWIN_REGISTRY if t.module == module)
+
+
+def assert_twin_bitwise(  # jaxgate: host — test helper, never traced
+    op: Callable,
+    args: tuple,
+    *,
+    impls: Iterable[str] = ("xla", "pallas"),
+    **kwargs,
+) -> None:
+    """The shared gate-equivalence assertion: call ``op(*args,
+    impl=...)`` for every impl (interpret mode handles Pallas off-TPU)
+    and require every output array bitwise-identical to the first
+    impl's.  Ops taking an ``impl`` kwarg and returning a pytree of
+    arrays (None leaves allowed) plug in directly."""
+    import numpy as np
+
+    impls = list(impls)
+    ref = jax.tree.leaves(op(*args, impl=impls[0], **kwargs))
+    for impl in impls[1:]:
+        got = jax.tree.leaves(op(*args, impl=impl, **kwargs))
+        assert len(ref) == len(got), (impls[0], impl)
+        for i, (a, b) in enumerate(zip(ref, got)):
+            assert np.array_equal(np.asarray(a), np.asarray(b)), (
+                "output %d differs between impl=%r and impl=%r"
+                % (i, impls[0], impl)
+            )

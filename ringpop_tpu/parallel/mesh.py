@@ -228,7 +228,33 @@ class ShardedSim(CheckpointableMixin):
         # RINGPOP_TPU_PALLAS toggles
         from ringpop_tpu.models.sim.cluster import _resolve_hash_impl
 
+        requested_fused_tick = self.params.fused_tick
         self.params = _resolve_hash_impl(self.params)
+        # sharded-aware fused_tick pin (engine.resolve_sharded_fused_tick,
+        # the resolve_sharded_exchange analog): a pallas_call does not
+        # partition under GSPMD, so the sharded tick runs the
+        # partitionable xla twin instead — observable, never silent
+        import jax as _jax
+
+        self.params = self.params._replace(
+            fused_tick=engine.resolve_sharded_fused_tick(
+                self.params._replace(fused_tick=requested_fused_tick),
+                _jax.default_backend(),
+            )
+        )
+        from ringpop_tpu.ops import toolkit as _toolkit
+
+        self._fused_tick_note = _toolkit.resolution_note(
+            "fused_tick",
+            requested_fused_tick,
+            self.params.fused_tick,
+            _jax.default_backend(),
+            single_device_resolution=engine.resolve_fused_tick(
+                self.params._replace(fused_tick=requested_fused_tick),
+                _jax.default_backend(),
+            ),
+            shards=int(self.mesh.devices.size),
+        )
         if self.params.n % self.mesh.devices.size:
             raise ValueError(
                 "n=%d not divisible by mesh size %d"
@@ -239,10 +265,18 @@ class ShardedSim(CheckpointableMixin):
             self.mesh,
         )
         self._tick = make_sharded_tick(self.params, self.universe, self.mesh)
+
         self._scan = make_sharded_scan(self.params, self.universe, self.mesh)
         # count of bounded-parity overflow replays, like SimCluster's — a
         # window that replayed paid the exact-shape cost too
         self.parity_replays = 0
+
+    def fused_tick_resolution(self) -> dict:
+        """The sharded fused-tick resolution as a runlog-ready dict —
+        ``differs_from_single_device`` flags the auto-on-TPU case where
+        the mesh dropped the pallas kernels to the partitionable xla
+        twin (observable, like the round-14 exchange note)."""
+        return dict(self._fused_tick_note)
 
     def bootstrap(self):
         inputs = engine.TickInputs.quiet(self.params.n)._replace(
@@ -745,24 +779,31 @@ class ShardedStorm(CheckpointableMixin):
         and the mesh exchange resolution lands as a
         ``mesh_exchange_resolution`` event row immediately — the
         observable replacement for the PR-5 silent drop-to-XLA."""
+        from ringpop_tpu.ops import toolkit
+
         recorder.describe(
             "sim.engine_scalable[mesh]", self.params.n, self.params
         )
-        recorder.record_event(
-            "mesh_exchange_resolution", **self._resolution_note
+        toolkit.emit_resolution(
+            self._resolution_note,
+            recorder=recorder,
+            event="mesh_exchange_resolution",
         )
         self.recorder = recorder
 
     def emit_resolution_stat(self, bridge) -> None:
         """Publish the resolution to a statsd bridge (gauges under
         ``sharded.exchange.*``): 1/0 flags a mesh-vs-single-device
-        divergence of the "auto" pick, plus the static all_to_all cap."""
-        bridge.gauge(
-            "sharded.exchange.resolution_differs",
-            int(self._resolution_note["differs_from_single_device"]),
+        divergence of the "auto" pick, plus the static all_to_all cap.
+        The gauge shape is the toolkit's shared emitter — every fused-op
+        resolver in the repo publishes the same way (ops.toolkit)."""
+        from ringpop_tpu.ops import toolkit
+
+        toolkit.emit_resolution(
+            self._resolution_note,
+            statsd=bridge,
+            gauge_prefix="sharded.exchange",
         )
-        if self.exchange_cap is not None:
-            bridge.gauge("sharded.exchange.cap", int(self.exchange_cap))
 
     def _structure_key(self, inputs):
         return (inputs.partition is None, inputs.leave is None)

@@ -90,6 +90,27 @@ ROUTE_EVENT_FIELDS = {
     # drain_row — tracks is a dict of {count, p50, p95, p99, ...})
     "perf.phase": ("phase", "wall_s", "calls"),
     "hist.drain": ("source", "tracks"),
+    # round-16 kernel toolkit: every backend-resolved fused-op knob is
+    # an observable event row (ops.toolkit.resolution_note — the
+    # single-device generalization of mesh_exchange_resolution)
+    "op_resolution": (
+        "knob",
+        "requested",
+        "impl",
+        "backend",
+        "single_device_resolution",
+        "differs_from_single_device",
+    ),
+    # round-16 fused full-fidelity tick: every measured A/B window of
+    # the full-engine ladder names its size, tick mode, and the bitwise
+    # gate verdict
+    "full_window": (
+        "n",
+        "ticks",
+        "fused_tick",
+        "node_ticks_per_sec",
+        "bitwise_equal",
+    ),
 }
 
 

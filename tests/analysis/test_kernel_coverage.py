@@ -1,0 +1,156 @@
+"""Kernel-coverage prong (analysis/kernel_coverage.py): the live tree
+is clean, and — mutation-proven — the rule FIRES on an unregistered
+Pallas kernel, a registry row whose entries/test are missing, and a
+stale row whose kernel was removed."""
+
+from __future__ import annotations
+
+from pathlib import Path
+
+from ringpop_tpu.analysis import kernel_coverage as kc
+from ringpop_tpu.analysis.findings import render_text
+from ringpop_tpu.ops import toolkit
+
+
+def _rules(findings):
+    return {f.rule for f in findings}
+
+
+def test_live_tree_is_clean():
+    findings = kc.check_kernel_coverage()
+    assert findings == [], "\n" + render_text(findings)
+
+
+def test_every_new_fused_op_is_registered():
+    """The round-16 ops must be in the registry (required-coverage
+    style, like the jaxpr entry-point gate)."""
+    rows = {(t.module, t.kernel_entry) for t in toolkit.TWIN_REGISTRY}
+    assert ("fused_apply", "apply_updates") in rows
+    assert ("fused_piggyback", "pb_budget") in rows
+    assert ("exchange", "exchange") in rows
+    assert ("pallas_farmhash", "fused_stream_nogrid") in rows
+
+
+def _fake_ops(tmp_path: Path, body: str) -> Path:
+    ops = tmp_path / "ops"
+    ops.mkdir()
+    (ops / "__init__.py").write_text("")
+    (ops / "mykernel.py").write_text(body)
+    return ops
+
+
+KERNEL_BODY = """
+from jax.experimental import pallas as pl
+
+def my_entry(x):
+    return pl.pallas_call(lambda i, o: None, out_shape=x)(x)
+
+def my_twin(x):
+    return x
+"""
+
+
+def test_mutation_unregistered_kernel_fires():
+    import tempfile
+
+    with tempfile.TemporaryDirectory() as td:
+        ops = _fake_ops(Path(td), KERNEL_BODY)
+        findings = kc.check_kernel_coverage(
+            ops_root=ops, registry=(), repo_root=Path(td)
+        )
+        assert _rules(findings) == {"unregistered-kernel"}, findings
+
+
+def test_mutation_scaffold_call_counts_as_kernel():
+    """A kernel built on the toolkit scaffold (no direct pallas_call)
+    is still in scope — stream_row_tiles call sites are detected."""
+    import tempfile
+
+    body = """
+from ringpop_tpu.ops import toolkit
+
+def my_entry(x):
+    return toolkit.stream_row_tiles(None, [x], ["plane"], [x.dtype], n_cols=4)
+"""
+    with tempfile.TemporaryDirectory() as td:
+        ops = _fake_ops(Path(td), body)
+        findings = kc.check_kernel_coverage(
+            ops_root=ops, registry=(), repo_root=Path(td)
+        )
+        assert _rules(findings) == {"unregistered-kernel"}, findings
+
+
+def test_mutation_missing_entries_and_test_fire():
+    import tempfile
+
+    with tempfile.TemporaryDirectory() as td:
+        ops = _fake_ops(Path(td), KERNEL_BODY)
+        reg = (
+            toolkit.KernelTwin(
+                "mykernel", "no_such_entry", "no_such_twin",
+                "tests/no_such_test.py",
+            ),
+        )
+        findings = kc.check_kernel_coverage(
+            ops_root=ops, registry=reg, repo_root=Path(td)
+        )
+        assert _rules(findings) == {
+            "missing-kernel-entry",
+            "missing-twin-entry",
+            "missing-gate-test",
+        }, findings
+
+
+def test_mutation_gate_test_must_mention_entry():
+    import tempfile
+
+    with tempfile.TemporaryDirectory() as td:
+        td = Path(td)
+        ops = _fake_ops(td, KERNEL_BODY)
+        t = td / "tests"
+        t.mkdir()
+        (t / "test_mykernel.py").write_text("def test_other(): pass\n")
+        reg = (
+            toolkit.KernelTwin(
+                "mykernel", "my_entry", "my_twin",
+                "tests/test_mykernel.py",
+            ),
+        )
+        findings = kc.check_kernel_coverage(
+            ops_root=ops, registry=reg, repo_root=td
+        )
+        assert _rules(findings) == {"missing-gate-test"}, findings
+        # mentioning the entry heals it
+        (t / "test_mykernel.py").write_text(
+            "def test_gate():\n    assert 'my_entry'\n"
+        )
+        findings = kc.check_kernel_coverage(
+            ops_root=ops, registry=reg, repo_root=td
+        )
+        assert findings == [], findings
+
+
+def test_mutation_stale_row_fires():
+    import tempfile
+
+    with tempfile.TemporaryDirectory() as td:
+        td = Path(td)
+        ops = _fake_ops(td, "def my_entry(x):\n    return x\n")
+        (td / "tests").mkdir()
+        (td / "tests" / "t.py").write_text("my_entry\n")
+        reg = (
+            toolkit.KernelTwin(
+                "mykernel", "my_entry", "my_entry", "tests/t.py"
+            ),
+        )
+        findings = kc.check_kernel_coverage(
+            ops_root=ops, registry=reg, repo_root=td
+        )
+        assert _rules(findings) == {"stale-registry-row"}, findings
+
+
+def test_cli_prong_runs(capsys):
+    from ringpop_tpu.analysis.__main__ import main
+
+    assert main(["--prong", "kernels"]) == 0
+    assert "0 finding(s)" in capsys.readouterr().out

@@ -68,6 +68,14 @@ def test_jaxpr_prong_covers_required_entry_points():
         "engine-tick-scan-histograms",
         "engine-scalable-tick-histograms",
         "route-tick-histograms",
+        # ISSUE 14 acceptance: the fused full-fidelity tick and both
+        # lowerings of the two new toolkit ops hold the same purity /
+        # dtype gates as the classic shapes
+        "engine-tick-scan-fused",
+        "fused-apply-xla",
+        "fused-apply-pallas",
+        "fused-piggyback-xla",
+        "fused-piggyback-pallas",
     } <= names
     assert len(names) >= 5
 

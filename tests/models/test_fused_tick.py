@@ -1,0 +1,302 @@
+"""Fused full-fidelity tick (SimParams.fused_tick): gate-equivalence.
+
+ISSUE 14 acceptance pins:
+
+- the fused tick ("xla" twin and "pallas" interpret kernels alike) is
+  bitwise-identical to the classic phase-by-phase path on EVERY
+  SimState field and TickMetrics counter, across ``gate_phases`` x
+  ``histograms`` x ``flight_recorder`` (n=64 tier-1, n=1k farmhash
+  slow),
+- ``step()`` == ``run()`` under the fused tick,
+- a checkpoint written under one fused_tick mode restores and finishes
+  the identical trajectory under another (trajectory-neutral knob,
+  checkpoint._TRAJECTORY_NEUTRAL_PARAMS).
+"""
+
+from __future__ import annotations
+
+import itertools
+
+import numpy as np
+import pytest
+import jax.numpy as jnp
+
+from ringpop_tpu.models.sim import engine
+from ringpop_tpu.models.sim.cluster import EventSchedule, SimCluster
+
+N = 64
+TICKS = 32
+
+
+def _schedule(n: int, ticks: int) -> EventSchedule:
+    """Every fused site exercised: kills (suspicion starts + ping-req
+    + expiry), revive (join merge + makeAlive), graceful leave + rejoin
+    (admin self-writes), steady dissemination in between."""
+    sched = EventSchedule(ticks=ticks, n=n)
+    sched.kill[3, 5] = True
+    sched.revive[ticks // 2, 5] = True
+    sched.kill[7, 11] = True
+    sched.leave = np.zeros((ticks, n), bool)
+    sched.leave[5, 9] = True
+    sched.join[3 * ticks // 4, 9] = True
+    return sched
+
+
+def _run(fused_tick: str, n: int = N, ticks: int = TICKS, **params):
+    p = engine.SimParams(
+        n=n,
+        checksum_mode=params.pop("checksum_mode", "fast"),
+        suspicion_ticks=6,
+        fused_tick=fused_tick,
+        **params,
+    )
+    sim = SimCluster(n=n, params=p, seed=1)
+    sim.bootstrap()
+    metrics = sim.run(_schedule(n, ticks))
+    return sim, metrics
+
+
+def _assert_same(sim_a, m_a, sim_b, m_b, label):
+    for f in engine.SimState._fields:
+        v_a = getattr(sim_a.state, f)
+        if v_a is None:
+            continue
+        assert np.array_equal(
+            np.asarray(getattr(sim_b.state, f)), np.asarray(v_a)
+        ), "state field %r diverged under %s" % (f, label)
+    for f in engine.TickMetrics._fields:
+        assert np.array_equal(
+            np.asarray(getattr(m_b, f)), np.asarray(getattr(m_a, f))
+        ), "metric %r diverged under %s" % (f, label)
+
+
+@pytest.fixture(scope="module")
+def classic_run():
+    return _run("off")
+
+
+@pytest.mark.parametrize(
+    "gate,hist,flight",
+    list(itertools.product([True, False], [False, True], [False, True])),
+)
+def test_fused_xla_bitwise_across_obs_combos(classic_run, gate, hist, flight):
+    sim_off, m_off = classic_run
+    sim, m = _run(
+        "xla",
+        gate_phases=gate,
+        histograms=hist,
+        flight_recorder=flight,
+        event_capacity=1 << 15,
+    )
+    _assert_same(
+        sim_off, m_off, sim, m,
+        "fused_tick=xla gate=%s hist=%s flight=%s" % (gate, hist, flight),
+    )
+
+
+def test_fused_pallas_interpret_bitwise(classic_run):
+    sim_off, m_off = classic_run
+    sim, m = _run("pallas")
+    _assert_same(sim_off, m_off, sim, m, "fused_tick=pallas")
+
+
+def test_auto_resolution_and_knob_validation():
+    import jax
+
+    p = engine.SimParams(n=8, checksum_mode="fast")
+    backend = jax.default_backend()
+    # small-n off-TPU auto keeps the classic shape (the BENCH_r15
+    # crossover); at ladder scale the twin takes over
+    resolved = engine.resolve_fused_tick(p, backend)
+    assert resolved == ("pallas" if backend == "tpu" else "off")
+    big = engine.resolve_fused_tick(p._replace(n=4096), backend)
+    assert big == ("pallas" if backend == "tpu" else "xla")
+    # explicit values honored; junk rejected with the toolkit message
+    assert engine.resolve_fused_tick(
+        p._replace(fused_tick="off"), backend
+    ) == "off"
+    with pytest.raises(ValueError, match="fused_tick must be auto"):
+        engine.resolve_fused_tick(p._replace(fused_tick="bogus"), backend)
+    # driver construction pins a concrete value
+    sim = SimCluster(n=8, params=p, seed=0)
+    assert sim.params.fused_tick in ("pallas", "xla", "off")
+
+
+def test_step_equals_scan_fused():
+    p = engine.SimParams(
+        n=N, checksum_mode="fast", suspicion_ticks=6, fused_tick="xla"
+    )
+    sched = _schedule(N, 12)
+    sim_scan = SimCluster(n=N, params=p, seed=1)
+    sim_scan.bootstrap()
+    sim_scan.run(sched)
+    sim_step = SimCluster(n=N, params=p, seed=1)
+    sim_step.bootstrap()
+    inputs = sched.as_inputs()
+    for t in range(12):
+        sim_step.step(
+            engine.TickInputs(
+                kill=inputs.kill[t],
+                revive=inputs.revive[t],
+                join=inputs.join[t],
+                partition=inputs.partition[t],
+                resume=None,
+                leave=inputs.leave[t],
+            )
+        )
+    for f in engine.SimState._fields:
+        v = getattr(sim_scan.state, f)
+        if v is None:
+            continue
+        assert np.array_equal(
+            np.asarray(getattr(sim_step.state, f)), np.asarray(v)
+        ), f
+
+
+def test_checkpoint_roundtrip_toggles_fused_knob(tmp_path, classic_run):
+    """Save mid-storm under fused_tick="xla", resume under "off" (and
+    back) — the finished trajectory must equal the uninterrupted
+    classic run's (trajectory-neutral knob)."""
+    sim_off, _ = classic_run
+    sched = _schedule(N, TICKS)
+    first = EventSchedule(
+        ticks=TICKS // 2,
+        n=N,
+        kill=sched.kill[: TICKS // 2].copy(),
+        revive=sched.revive[: TICKS // 2].copy(),
+        join=sched.join[: TICKS // 2].copy(),
+        partition=sched.partition[: TICKS // 2].copy(),
+        leave=sched.leave[: TICKS // 2].copy(),
+    )
+    second = EventSchedule(
+        ticks=TICKS - TICKS // 2,
+        n=N,
+        kill=sched.kill[TICKS // 2:].copy(),
+        revive=sched.revive[TICKS // 2:].copy(),
+        join=sched.join[TICKS // 2:].copy(),
+        partition=sched.partition[TICKS // 2:].copy(),
+        leave=sched.leave[TICKS // 2:].copy(),
+    )
+    p_x = engine.SimParams(
+        n=N, checksum_mode="fast", suspicion_ticks=6, fused_tick="xla"
+    )
+    sim = SimCluster(n=N, params=p_x, seed=1)
+    sim.bootstrap()
+    sim.run(first)
+    path = str(tmp_path / "ckpt_fused")
+    sim.save(path)
+
+    p_off = p_x._replace(fused_tick="off")
+    resumed = SimCluster(n=N, params=p_off, seed=1)
+    resumed.bootstrap()  # replaced by the load below
+    resumed.load(path)
+    resumed.run(second)
+    for f in engine.SimState._fields:
+        v = getattr(sim_off.state, f)
+        if v is None:
+            continue
+        assert np.array_equal(
+            np.asarray(getattr(resumed.state, f)), np.asarray(v)
+        ), "resumed (xla->off) state field %r diverged" % f
+
+    # and the reverse toggle: classic save, fused resume
+    sim2 = SimCluster(n=N, params=p_off, seed=1)
+    sim2.bootstrap()
+    sim2.run(first)
+    path2 = str(tmp_path / "ckpt_classic")
+    sim2.save(path2)
+    resumed2 = SimCluster(n=N, params=p_x, seed=1)
+    resumed2.bootstrap()
+    resumed2.load(path2)
+    resumed2.run(second)
+    assert np.array_equal(
+        np.asarray(resumed2.state.checksum), np.asarray(sim_off.state.checksum)
+    )
+    assert np.array_equal(
+        np.asarray(resumed2.state.status), np.asarray(sim_off.state.status)
+    )
+
+
+def test_op_resolution_runlog_and_gauges(tmp_path):
+    """The toolkit's shared resolution observability: attach_recorder
+    lands one op_resolution row per fused-op knob, and the statsd
+    emitter publishes the PR-9 gauge shape."""
+    import json
+
+    from ringpop_tpu.obs.recorder import RunRecorder
+
+    p = engine.SimParams(n=8, checksum_mode="fast")
+    sim = SimCluster(n=8, params=p, seed=0)
+    path = tmp_path / "r.runlog.jsonl"
+    rec = RunRecorder(str(path))
+    sim.attach_recorder(rec)
+    rec.close()
+    rows = [json.loads(l) for l in path.read_text().splitlines()]
+    res = {
+        r["knob"]: r for r in rows
+        if r.get("kind") == "event" and r.get("name") == "op_resolution"
+    }
+    assert {"fused_checksum", "fused_tick", "parity_recompute"} <= set(res)
+    assert res["fused_tick"]["impl"] == sim.params.fused_tick
+    assert res["fused_tick"]["requested"] == "auto"
+
+    class Bridge:
+        def __init__(self):
+            self.gauges = {}
+
+        def gauge(self, key, value):
+            self.gauges[key] = value
+
+    b = Bridge()
+    sim.emit_resolution_stat(b)
+    assert "sim.fused_tick.resolution_differs" in b.gauges
+    assert b.gauges["sim.fused_tick.resolution_differs"] in (0, 1)
+
+
+@pytest.mark.slow
+def test_fused_bitwise_n1k_farmhash():
+    """The n=1k farmhash rung of the acceptance gate: full parity
+    checksums, classic vs fused twin, every state field bitwise."""
+    n, ticks = 1024, 12
+    sim_off, m_off = _run("off", n=n, ticks=ticks, checksum_mode="farmhash")
+    sim_x, m_x = _run("xla", n=n, ticks=ticks, checksum_mode="farmhash")
+    _assert_same(sim_off, m_off, sim_x, m_x, "n=1k farmhash fused_tick=xla")
+
+
+def test_sharded_fused_tick_resolution():
+    """ShardedSim must never embed pallas kernels in a GSPMD tick: the
+    sharded resolver drops pallas to the partitionable xla twin (the
+    round-14 exchange lesson applied up front) and the driver keeps an
+    observable resolution note."""
+    import jax
+
+    from ringpop_tpu.parallel.mesh import ShardedSim, make_mesh
+
+    backend = jax.default_backend()
+    p = engine.SimParams(n=4096, checksum_mode="fast")
+    # table: auto-on-tpu and explicit pallas both drop to xla; xla/off
+    # honored; small-n off-TPU auto keeps the single-device pick
+    assert engine.resolve_sharded_fused_tick(p, "tpu") == "xla"
+    assert engine.resolve_sharded_fused_tick(
+        p._replace(fused_tick="pallas"), backend
+    ) == "xla"
+    assert engine.resolve_sharded_fused_tick(
+        p._replace(fused_tick="off"), backend
+    ) == "off"
+    assert engine.resolve_sharded_fused_tick(
+        p._replace(n=8), "cpu"
+    ) == engine.resolve_fused_tick(p._replace(n=8), "cpu")
+
+    sim = ShardedSim(
+        n=16,
+        mesh=make_mesh(1),
+        params=engine.SimParams(n=16, checksum_mode="fast",
+                                fused_tick="pallas"),
+    )
+    assert sim.params.fused_tick == "xla"
+    note = sim.fused_tick_resolution()
+    assert note["requested"] == "pallas"
+    assert note["impl"] == "xla"
+    assert note["shards"] == 1
+    sim.bootstrap()
+    sim.step()

@@ -1,0 +1,211 @@
+"""Gate tests for the fused membership-update op (ops/fused_apply.py):
+host-numpy reference equality, Pallas-interpret vs XLA-twin bitwise
+equivalence (the toolkit TWIN_REGISTRY contract), output-flag variants,
+and argument validation."""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+import jax.numpy as jnp
+
+from ringpop_tpu.ops import fused_apply as fa
+from ringpop_tpu.ops import toolkit
+
+ALIVE, SUSPECT, FAULTY, LEAVE = 0, 1, 2, 3
+
+
+def _fixture(n: int, seed: int = 0, dense: float = 0.4):
+    rng = np.random.default_rng(seed)
+
+    def bpl(p):
+        return jnp.asarray(rng.random((n, n)) < p)
+
+    def ipl(lo, hi):
+        return jnp.asarray(
+            rng.integers(lo, hi, (n, n)), dtype=jnp.int32
+        )
+
+    st = fa.ApplyState(
+        known=bpl(0.8),
+        status=ipl(0, 4),
+        inc=ipl(0, 50),
+        ch_active=bpl(0.3),
+        ch_status=ipl(0, 4),
+        ch_inc=ipl(0, 50),
+        ch_source=ipl(-1, n),
+        ch_source_inc=ipl(0, 50),
+        ch_pb=ipl(0, 20),
+        susp_deadline=ipl(-1, 60),
+    )
+    upd = (bpl(dense), ipl(0, 4), ipl(0, 50), ipl(0, n), ipl(0, 50))
+    union = jnp.asarray(
+        rng.integers(0, 2**32, (n, toolkit.packed_width(n)), dtype=np.uint32)
+    )
+    return st, upd, union
+
+
+def _reference(st, upd, now, dl):
+    """Straight numpy transliteration of engine._apply_updates + the
+    caller-side deadline stamp (the classic phase code)."""
+    recv, us, ui, usrc, usi = (np.asarray(x) for x in upd)
+    n = recv.shape[0]
+    node = np.arange(n)[:, None]
+    subject = np.arange(n)[None, :]
+    is_self = node == subject
+    c_s, c_i = np.asarray(st.status), np.asarray(st.inc)
+    refute = recv & is_self & ((us == SUSPECT) | (us == FAULTY))
+    eff_s = np.where(refute, ALIVE, us)
+    eff_i = np.where(refute, now, ui)
+    alive_ov = (eff_s == ALIVE) & (eff_i > c_i)
+    suspect_ov = (eff_s == SUSPECT) & (
+        ((c_s == SUSPECT) & (eff_i > c_i))
+        | ((c_s == FAULTY) & (eff_i > c_i))
+        | ((c_s == ALIVE) & (eff_i >= c_i))
+    )
+    faulty_ov = (eff_s == FAULTY) & (
+        ((c_s == SUSPECT) & (eff_i >= c_i))
+        | ((c_s == FAULTY) & (eff_i > c_i))
+        | ((c_s == ALIVE) & (eff_i >= c_i))
+    )
+    leave_ov = (eff_s == LEAVE) & (c_s != LEAVE) & (eff_i >= c_i)
+    new_member = recv & ~np.asarray(st.known)
+    gate = recv & (
+        refute | new_member | alive_ov | suspect_ov | faulty_ov | leave_ov
+    )
+    status = np.where(gate, eff_s, c_s)
+    inc = np.where(gate, eff_i, c_i)
+    start = gate & (status == SUSPECT) & ~is_self
+    stop = gate & (status != SUSPECT)
+    susp = np.where(stop, -1, np.asarray(st.susp_deadline))
+    susp = np.where(start, dl, susp)
+    out = dict(
+        known=np.asarray(st.known) | new_member,
+        status=status,
+        inc=inc,
+        ch_active=np.asarray(st.ch_active) | gate,
+        ch_status=np.where(gate, status, np.asarray(st.ch_status)),
+        ch_inc=np.where(gate, inc, np.asarray(st.ch_inc)),
+        ch_source=np.where(gate, usrc, np.asarray(st.ch_source)),
+        ch_source_inc=np.where(
+            gate, usi, np.asarray(st.ch_source_inc)
+        ),
+        ch_pb=np.where(gate, 0, np.asarray(st.ch_pb)),
+        susp_deadline=susp,
+    )
+    return out, gate, refute
+
+
+@pytest.mark.parametrize("impl", ["xla", "pallas"])
+@pytest.mark.parametrize("n", [16, 37, 64])
+def test_matches_host_reference(impl, n):
+    st, upd, union = _fixture(n, seed=n)
+    now, dl = jnp.int32(51), jnp.int32(77)
+    ref, gate, refute = _reference(st, upd, 51, 77)
+    out = fa.apply_updates(
+        st, *upd, now, dl, union, impl=impl,
+        want_masks=True, want_count=True, want_refute=True,
+    )
+    for f in fa.ApplyState._fields:
+        assert np.array_equal(
+            np.asarray(getattr(out.state, f)), ref[f]
+        ), (impl, f)
+    assert np.array_equal(np.asarray(out.applied), gate)
+    assert np.array_equal(np.asarray(out.applied_rows), gate.any(1))
+    assert int(out.applied_count) == int(gate.sum())
+    assert np.array_equal(
+        np.asarray(out.refute_diag), np.diagonal(refute)
+    )
+    # packed union accumulates exactly: popcount == |old ∪ gate|
+    want = np.asarray(union) | np.asarray(
+        toolkit.pack_bool_rows(jnp.asarray(gate))
+    )
+    assert np.array_equal(np.asarray(out.union), want)
+
+
+def test_pallas_twin_bitwise_equal():
+    """The TWIN_REGISTRY contract: kernel vs twin bitwise across every
+    output, via the shared toolkit gate helper."""
+    st, upd, union = _fixture(48, seed=3)
+
+    def op(st, *upd, impl):
+        return fa.apply_updates(
+            st, *upd, jnp.int32(9), jnp.int32(30), union,
+            impl=impl, want_masks=True, want_count=True,
+        )
+
+    toolkit.assert_twin_bitwise(op, (st,) + upd)
+
+
+@pytest.mark.parametrize("impl", ["xla", "pallas"])
+def test_output_flag_variants(impl):
+    st, upd, _ = _fixture(32, seed=7)
+    out = fa.apply_updates(
+        st, *upd, jnp.int32(5), jnp.int32(11), None, impl=impl,
+        want_masks=False, want_count=False, want_refute=False,
+    )
+    assert out.union is None
+    assert out.applied is None
+    assert out.applied_count is None
+    assert out.refute_diag is None
+    full = fa.apply_updates(
+        st, *upd, jnp.int32(5), jnp.int32(11), None, impl=impl,
+        want_masks=True, want_count=True,
+    )
+    # the lean variant's state planes and rows match the full variant's
+    for f in fa.ApplyState._fields:
+        assert np.array_equal(
+            np.asarray(getattr(out.state, f)),
+            np.asarray(getattr(full.state, f)),
+        ), f
+    assert np.array_equal(
+        np.asarray(out.applied_rows), np.asarray(full.applied_rows)
+    )
+
+
+def test_arg_validation():
+    st, upd, union = _fixture(16)
+    with pytest.raises(ValueError, match="square"):
+        bad = st._replace(
+            **{f: jnp.zeros((16, 8), getattr(st, f).dtype)
+               for f in fa.ApplyState._fields}
+        )
+        fa.apply_updates(bad, *upd, jnp.int32(1), jnp.int32(2))
+    with pytest.raises(ValueError, match="packed"):
+        fa.apply_updates(
+            st, *upd, jnp.int32(1), jnp.int32(2),
+            jnp.zeros((16, 16), jnp.uint32),
+        )
+    with pytest.raises(ValueError, match="impl"):
+        fa.apply_updates(
+            st, *upd, jnp.int32(1), jnp.int32(2), impl="bogus"
+        )
+
+
+def test_overrides_is_engines_table():
+    """engine._overrides must BE this module's table (single source)."""
+    from ringpop_tpu.models.sim import engine
+
+    assert engine._overrides is fa.overrides
+
+
+@pytest.mark.parametrize("impl", ["xla", "pallas"])
+def test_tiny_n_packed_width_collision(impl):
+    """n=4: packed_width(4) == 1 but a 4-wide meta/union could collide
+    with n in width-based plane inference — the explicit in_planes
+    flags keep the scaffold exact at any n (review-found regression)."""
+    st, upd, union = _fixture(4, seed=2)
+    now, dl = jnp.int32(3), jnp.int32(9)
+    ref, gate, refute = _reference(st, upd, 3, 9)
+    out = fa.apply_updates(
+        st, *upd, now, dl, union, impl=impl, want_masks=True,
+        want_count=True,
+    )
+    for f in fa.ApplyState._fields:
+        assert np.array_equal(
+            np.asarray(getattr(out.state, f)), ref[f]
+        ), (impl, f)
+    want = np.asarray(union) | np.asarray(
+        toolkit.pack_bool_rows(jnp.asarray(gate))
+    )
+    assert np.array_equal(np.asarray(out.union), want)

@@ -225,3 +225,30 @@ def test_engine_cache_invariant_under_churn():
         assert (
             np.asarray(fused.state.rec_len) == np.asarray(dense_l)
         ).all(), t
+
+
+def test_stream_kernel_twin_bitwise_equal():
+    """The toolkit TWIN_REGISTRY contract, pinned on the raw stream
+    entries: pallas_farmhash.fused_stream_nogrid (interpret mode
+    off-chip) vs pallas_farmhash.fused_stream_xla, every carry lane
+    bitwise-identical on the adversarial view batch."""
+    from ringpop_tpu.ops import pallas_farmhash as pf
+
+    uni, present, status, inc = _views(seed=19)
+    rec_b, rec_l = fc.member_records(
+        uni, jnp.asarray(present), jnp.asarray(status), jnp.asarray(inc)
+    )
+    rec_w = fc.pack_record_words(rec_b)
+    lens = jnp.asarray(rec_l, jnp.int32)
+    row_len = jnp.sum(rec_l, axis=1, dtype=jnp.int32)
+    total_blocks = jnp.where(row_len > 24, (row_len - 1) // 20, 0)
+    B = rec_w.shape[0]
+    h0 = jnp.zeros(B, jnp.uint32)
+    g0 = jnp.ones(B, jnp.uint32)
+    f0 = jnp.full(B, 2, jnp.uint32)
+    want = pf.fused_stream_xla(h0, g0, f0, rec_w, lens, total_blocks)
+    got = pf.fused_stream_nogrid(
+        h0, g0, f0, rec_w, lens, total_blocks, chunk=4, interpret=True
+    )
+    for a, b in zip(want, got):
+        assert np.array_equal(np.asarray(a), np.asarray(b))

@@ -1,0 +1,128 @@
+"""Gate tests for the fused dissemination-budget op
+(ops/fused_piggyback.py): host-numpy reference equality against every
+classic site shape (sender select / receiver bump / ping-req legs),
+Pallas-interpret vs XLA-twin bitwise equivalence, and validation."""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+import jax.numpy as jnp
+
+from ringpop_tpu.ops import fused_piggyback as fp
+from ringpop_tpu.ops import toolkit
+
+
+def _fixture(n: int, seed: int = 0, max_bump: int = 4):
+    rng = np.random.default_rng(seed)
+    active = jnp.asarray(rng.random((n, n)) < 0.5)
+    pb = jnp.asarray(rng.integers(0, 20, (n, n)), dtype=jnp.int32)
+    nbump = jnp.asarray(
+        rng.integers(0, max_bump, n), dtype=jnp.int32
+    )
+    max_pb = jnp.asarray(rng.integers(5, 25, n), dtype=jnp.int32)
+    hits = jnp.asarray(rng.integers(0, 2, (n, n)), dtype=jnp.int32)
+    return active, pb, nbump, max_pb, hits
+
+
+def _reference(active, pb, nbump, max_pb, hits):
+    """The classic receiver-bump arithmetic (engine phase 5.5) — the
+    sender-select and ping-req shapes are the hits=0 / nbump-vector
+    special cases of the same cell formula."""
+    a, p = np.asarray(active), np.asarray(pb)
+    nb = np.asarray(nbump)[:, None]
+    mx = np.asarray(max_pb)[:, None]
+    h = np.zeros_like(p) if hits is None else np.asarray(hits)
+    eff = np.where(a & (nb > 0), nb - h, 0)
+    p2 = p + eff
+    over = a & (p2 > mx)
+    return p2, a & ~over, a & (nb > 0) & ~over, int(over.sum())
+
+
+@pytest.mark.parametrize("impl", ["xla", "pallas"])
+@pytest.mark.parametrize("n", [16, 37, 64])
+@pytest.mark.parametrize("with_hits", [True, False])
+def test_matches_host_reference(impl, n, with_hits):
+    active, pb, nbump, max_pb, hits = _fixture(n, seed=n)
+    h = hits if with_hits else None
+    p2, a2, content, drops = _reference(active, pb, nbump, max_pb, h)
+    out = fp.pb_budget(active, pb, nbump, max_pb, h, impl=impl)
+    assert np.array_equal(np.asarray(out.ch_pb), p2)
+    assert np.array_equal(np.asarray(out.ch_active), a2)
+    assert np.array_equal(np.asarray(out.content), content)
+    assert int(out.drops) == drops
+
+
+@pytest.mark.parametrize("impl", ["xla", "pallas"])
+def test_sender_site_shape(impl):
+    """phase 3: nbump = valid_send (0/1), no hits — content must equal
+    the classic ``bump & ~over`` sendable mask."""
+    active, pb, _, max_pb, _ = _fixture(48, seed=5)
+    rng = np.random.default_rng(9)
+    valid = rng.random(48) < 0.7
+    nbump = jnp.asarray(valid.astype(np.int32))
+    out = fp.pb_budget(active, pb, nbump, max_pb, impl=impl)
+    bump = valid[:, None] & np.asarray(active)
+    p2 = np.asarray(pb) + bump.astype(np.int32)
+    over = np.asarray(active) & (p2 > np.asarray(max_pb)[:, None])
+    assert np.array_equal(np.asarray(out.ch_pb), p2)
+    assert np.array_equal(np.asarray(out.content), bump & ~over)
+    assert int(out.drops) == int(over.sum())
+
+
+@pytest.mark.parametrize("impl", ["xla", "pallas"])
+def test_leg1_multi_bump_shape(impl):
+    """ping-req leg 1: nbump = n_slots (can exceed 1), ungated add —
+    the op's nbump>0 gate is bit-neutral because 0 adds 0."""
+    active, pb, _, max_pb, _ = _fixture(32, seed=11)
+    n_slots = jnp.asarray(
+        np.random.default_rng(4).integers(0, 4, 32), dtype=jnp.int32
+    )
+    out = fp.pb_budget(
+        active, pb, n_slots, max_pb, impl=impl, want_content=False
+    )
+    assert out.content is None
+    new_pb = np.asarray(pb) + np.where(
+        np.asarray(active), np.asarray(n_slots)[:, None], 0
+    )
+    over = np.asarray(active) & (
+        new_pb > np.asarray(max_pb)[:, None]
+    )
+    assert np.array_equal(np.asarray(out.ch_pb), new_pb)
+    assert np.array_equal(
+        np.asarray(out.ch_active), np.asarray(active) & ~over
+    )
+    assert int(out.drops) == int(over.sum())
+
+
+def test_pallas_twin_bitwise_equal():
+    active, pb, nbump, max_pb, hits = _fixture(48, seed=3)
+
+    def op(active, pb, nbump, max_pb, hits, impl):
+        return fp.pb_budget(active, pb, nbump, max_pb, hits, impl=impl)
+
+    toolkit.assert_twin_bitwise(op, (active, pb, nbump, max_pb, hits))
+
+
+def test_arg_validation():
+    active, pb, nbump, max_pb, hits = _fixture(16)
+    with pytest.raises(ValueError, match="matching"):
+        fp.pb_budget(active[:8], pb, nbump, max_pb)
+    with pytest.raises(ValueError, match="vectors"):
+        fp.pb_budget(active, pb, nbump[:8], max_pb)
+    with pytest.raises(ValueError, match="impl"):
+        fp.pb_budget(active, pb, nbump, max_pb, impl="bogus")
+
+
+@pytest.mark.parametrize("impl", ["xla", "pallas"])
+def test_tiny_n_meta_width_collision(impl):
+    """n=2: the [N, 2] meta vector's width equals n — the explicit
+    in_planes flags keep it a narrow input (review-found regression
+    class)."""
+    active, pb, nbump, max_pb, hits = _fixture(2, seed=8)
+    p2, a2, content, drops = _reference(active, pb, nbump, max_pb, hits)
+    out = fp.pb_budget(active, pb, nbump, max_pb, hits, impl=impl)
+    assert np.array_equal(np.asarray(out.ch_pb), p2)
+    assert np.array_equal(np.asarray(out.ch_active), a2)
+    assert np.array_equal(np.asarray(out.content), content)
+    assert int(out.drops) == drops
