@@ -1,13 +1,23 @@
 """jaxgate: repo-native static analysis for the device path.
 
-Two prongs (see ISSUE 3 / README "Static analysis"):
+The prongs are REGISTERED in :mod:`ringpop_tpu.analysis.prongs` — the
+single source the CLI, ``--prong all`` and the README table derive from.
+Modules (see README "Static analysis"):
 
 - :mod:`ringpop_tpu.analysis.astlint` — syntax rules over ``ringpop_tpu/``
-  (tick purity, dtype discipline, host-sync hygiene).
+  (tick purity, dtype discipline, host-sync hygiene, donation aliasing).
 - :mod:`ringpop_tpu.analysis.jaxpr_audit` — traced-graph audit of the real
   entry points (callback-free scanned tick, uint32 hash-dataflow taint).
-- :mod:`ringpop_tpu.analysis.retrace` — compile-count probes against the
-  committed ``ANALYSIS_BUDGET.json`` manifest.
+- :mod:`ringpop_tpu.analysis.dataflow` — the shared jaxpr dataflow
+  slicer (ONE recursive sub-jaxpr traversal; witness chains, loop
+  fixpoints) under both the taint audit and the noninterference prong.
+- :mod:`ringpop_tpu.analysis.noninterference` — per-entry proof that no
+  obs-only input leaf reaches a trajectory output leaf (ISSUE 15).
+- :mod:`ringpop_tpu.analysis.donation` — donating drivers' alias maps
+  vs the committed ``DONATION_BUDGET.json`` (dropped donation = finding).
+- :mod:`ringpop_tpu.analysis.retrace` / ``cost`` /
+  ``kernel_coverage`` — compile-count, static-cost and kernel-twin
+  budgets against their committed manifests.
 
 CLI: ``python -m ringpop_tpu.analysis`` (see ``--help``).
 """

@@ -1,15 +1,18 @@
 """jaxgate CLI: ``python -m ringpop_tpu.analysis``.
 
-Runs the AST lint (prong B) and the jaxpr auditor (prong A) over the
-repo and exits non-zero on any unsuppressed finding.  The retrace-budget
-prong compiles real entry points and is opt-in (``--prong all`` or
-``--prong retrace``); CI runs it via ``scripts/check_retrace_budget.py``.
+Runs the registered prongs (see :mod:`ringpop_tpu.analysis.prongs` —
+the one registry CLI help, ``--prong all`` and the README table derive
+from) over the repo and exits non-zero on any unsuppressed finding.
+The default set is the cheap one (nothing that compiles entry points);
+``retrace``/``cost``/``donation`` compile real entry points and are
+opt-in — CI runs them via their ``scripts/check_*_budget.py`` twins.
 
 Examples::
 
-    python -m ringpop_tpu.analysis                       # lint + jaxpr audit
-    python -m ringpop_tpu.analysis --format json
+    python -m ringpop_tpu.analysis                       # default prongs
+    python -m ringpop_tpu.analysis --format json         # + per-prong wall time
     python -m ringpop_tpu.analysis --prong ast ringpop_tpu/ops/native.py
+    python -m ringpop_tpu.analysis --prong noninterference,donation
     python -m ringpop_tpu.analysis --changed-only        # pre-commit speed
     python -m ringpop_tpu.analysis --list-rules
 """
@@ -23,6 +26,7 @@ from pathlib import Path
 from typing import List, Optional
 
 from ringpop_tpu.analysis import astlint, findings as fmod
+from ringpop_tpu.analysis.prongs import ALL_PRONGS, DEFAULT_PRONGS, PRONGS
 
 PKG_ROOT = Path(__file__).resolve().parents[1]  # .../ringpop_tpu
 REPO_ROOT = PKG_ROOT.parent
@@ -62,10 +66,21 @@ def _changed_files() -> List[Path]:
     ]
 
 
+def _pkg_rel(files: List[Path]) -> List[str]:
+    """Package-relative posix paths ('models/sim/engine.py') for the
+    touched-module -> affected-entry-point mappings."""
+    out = []
+    for f in files:
+        r = f.resolve()
+        if r.is_relative_to(PKG_ROOT):
+            out.append(r.relative_to(PKG_ROOT).as_posix())
+    return out
+
+
 def main(argv: Optional[List[str]] = None) -> int:
     parser = argparse.ArgumentParser(
         prog="python -m ringpop_tpu.analysis",
-        description="jaxgate: jaxpr auditor + AST lint for ringpop-tpu",
+        description="jaxgate: machine-checked static analysis for ringpop-tpu",
     )
     parser.add_argument(
         "paths",
@@ -77,12 +92,16 @@ def main(argv: Optional[List[str]] = None) -> int:
     )
     parser.add_argument(
         "--prong",
-        default="ast,jaxpr,kernels",
+        default=",".join(DEFAULT_PRONGS),
         help=(
-            "comma list of prongs to run: ast, jaxpr, kernels, retrace, "
-            "cost (or 'all'; default ast,jaxpr,kernels — retrace/cost "
-            "compile real entry points and are opt-in; CI runs them via "
-            "scripts/check_retrace_budget.py / check_cost_budget.py)"
+            "comma list of prongs to run: %s (or 'all'; default %s — "
+            "%s compile real entry points and are opt-in; CI runs them "
+            "via their scripts/check_*_budget.py twins)"
+            % (
+                ", ".join(ALL_PRONGS),
+                ",".join(DEFAULT_PRONGS),
+                "/".join(p for p in ALL_PRONGS if not PRONGS[p].default),
+            )
         ),
     )
     parser.add_argument(
@@ -102,15 +121,14 @@ def main(argv: Optional[List[str]] = None) -> int:
 
     if args.list_rules:
         for rule in astlint.ALL_RULES:
-            print(f"{rule.name:20s} [{rule.scope}]")
+            print(f"{rule.name:26s} [{rule.scope}]")
             print(f"    {rule.summary}")
-        print(
-            "\njaxpr prong: callback-primitive, wide-dtype-on-hash-path, "
-            "trace-failure\nkernels prong: unregistered-kernel, "
-            "missing-kernel-entry, missing-twin-entry, missing-gate-test, "
-            "stale-registry-row\nretrace prong: retrace-budget"
-            "\ncost prong: cost-budget, cost-failure"
-        )
+        print()
+        for spec in PRONGS.values():
+            default = "default" if spec.default else "opt-in"
+            print(f"{spec.name} prong ({default}): {', '.join(spec.rules)}")
+            print(f"    {spec.summary}")
+            print(f"    CI: {spec.ci}")
         print(
             "\nsuppress per line with  # jaxgate: ignore[rule-a,rule-b]  "
             "(bare 'ignore' silences all);\nmark a trace-time host helper "
@@ -119,15 +137,18 @@ def main(argv: Optional[List[str]] = None) -> int:
         return 0
 
     prongs = (
-        {"ast", "jaxpr", "kernels", "retrace", "cost"}
+        set(ALL_PRONGS)
         if args.prong.strip() == "all"
         else {p.strip() for p in args.prong.split(",") if p.strip()}
     )
-    unknown = prongs - {"ast", "jaxpr", "kernels", "retrace", "cost"}
+    unknown = prongs - set(ALL_PRONGS)
     if unknown:
         parser.error(f"unknown prong(s): {sorted(unknown)}")
 
     all_findings: List[fmod.Finding] = []
+    prong_seconds: dict = {}
+
+    from ringpop_tpu.obs.perf import stopwatch
 
     files: Optional[List[Path]] = None
     if args.changed_only:
@@ -158,38 +179,63 @@ def main(argv: Optional[List[str]] = None) -> int:
             explicit_set = {e.resolve() for e in explicit}
             files = [f for f in files if f.resolve() in explicit_set]
 
+    scoped_rel = _pkg_rel(files) if files is not None else None
+
     if "ast" in prongs:
-        all_findings.extend(astlint.lint_paths(PKG_ROOT, files=files))
+        with stopwatch(prong_seconds, "ast"):
+            all_findings.extend(astlint.lint_paths(PKG_ROOT, files=files))
 
     if "jaxpr" in prongs:
         run_jaxpr = True
-        if files is not None:
+        if scoped_rel is not None:
             # a scoped run (--changed-only or explicit paths) only pays
             # for the multi-second entry-point traces when a file the
             # jaxpr prong actually covers is in scope
-            scoped_rel = {
-                f.resolve().relative_to(PKG_ROOT).as_posix()
-                for f in files
-                if f.resolve().is_relative_to(PKG_ROOT)
-            }
-            run_jaxpr = any(
-                src in scoped_rel for src in _JAXPR_SOURCES
-            )
+            run_jaxpr = any(src in scoped_rel for src in _JAXPR_SOURCES)
         if run_jaxpr:
             from ringpop_tpu.analysis import jaxpr_audit
 
-            all_findings.extend(jaxpr_audit.audit_entries())
+            with stopwatch(prong_seconds, "jaxpr"):
+                all_findings.extend(jaxpr_audit.audit_entries())
 
     if "kernels" in prongs:
         from ringpop_tpu.analysis import kernel_coverage
 
-        all_findings.extend(kernel_coverage.check_kernel_coverage())
+        with stopwatch(prong_seconds, "kernels"):
+            all_findings.extend(kernel_coverage.check_kernel_coverage())
+
+    if "noninterference" in prongs:
+        from ringpop_tpu.analysis import noninterference
+
+        entry_names = None
+        if scoped_rel is not None:
+            # touched-module -> affected-entry-point mapping: a scoped
+            # run re-proves only the entries a changed module can feed
+            entry_names = noninterference.entries_for_changed(scoped_rel)
+        if entry_names is None or entry_names:
+            with stopwatch(prong_seconds, "noninterference"):
+                all_findings.extend(
+                    noninterference.check_noninterference(entry_names)
+                )
+
+    if "donation" in prongs:
+        from ringpop_tpu.analysis import donation
+
+        run_donation = True
+        if scoped_rel is not None:
+            run_donation = any(
+                r.startswith(donation.SOURCES) for r in scoped_rel
+            )
+        if run_donation:
+            with stopwatch(prong_seconds, "donation"):
+                all_findings.extend(donation.check_against_manifest())
 
     if "retrace" in prongs:
         from ringpop_tpu.analysis import retrace
 
         path = Path(args.budget) if args.budget else None
-        all_findings.extend(retrace.check_against_manifest(path=path))
+        with stopwatch(prong_seconds, "retrace"):
+            all_findings.extend(retrace.check_against_manifest(path=path))
 
     if "cost" in prongs:
         from ringpop_tpu.analysis import cost
@@ -197,10 +243,22 @@ def main(argv: Optional[List[str]] = None) -> int:
         # --budget names the RETRACE manifest; the cost prong always
         # reads the repo-root COST_BUDGET.json here (the script exposes
         # its own --budget for alternate paths)
-        all_findings.extend(cost.check_against_manifest())
+        with stopwatch(prong_seconds, "cost"):
+            all_findings.extend(cost.check_against_manifest())
 
     if args.format == "json":
-        print(fmod.render_json(all_findings))
+        # per-prong wall time rides in the JSON output so the tier-1
+        # analysis budget stays observable (ISSUE 15 satellite)
+        print(
+            fmod.render_json(
+                all_findings,
+                meta={
+                    "prong_seconds": {
+                        k: round(v, 3) for k, v in prong_seconds.items()
+                    }
+                },
+            )
+        )
     else:
         print(fmod.render_text(all_findings))
     return 1 if all_findings else 0

@@ -21,6 +21,10 @@ block-until-ready   ``block_until_ready`` only in obs (device sync in
 callback-in-device  no ``io_callback/pure_callback/debug_callback`` or
                     ``jax.debug.print`` in device modules (the scanned
                     tick must stay gate-equivalence-safe)
+stale-ref-across-   no bare ``x = self.state`` binding read after the
+donation            state was passed to a donating dispatch — the exact
+                    PR-7/PR-8 aliasing hazard (donated buffers are dead;
+                    ``device_get``/``host_copy_states`` first)
 assert-on-traced    no ``assert`` over traced values inside jit contexts
                     (trace-time only; raises on a concrete tracer)
 ==================  =====================================================
@@ -688,6 +692,199 @@ class CallbackInDeviceRule(Rule):
                 )
 
 
+class StaleRefAcrossDonationRule(Rule):
+    name = "stale-ref-across-donation"
+    summary = (
+        "a bare device-state binding held live across a donating dispatch "
+        "reads donated buffers (the PR-7/PR-8 aliasing hazard) — snapshot "
+        "via device_get/host_copy_states before dispatching"
+    )
+    scope = "models/sim/, models/route/, parallel/, fuzz/"
+
+    # carry attributes whose buffers a donating dispatch invalidates
+    _STATE_ATTRS = {"state", "rstate"}
+
+    def applies(self, mod: ModuleInfo) -> bool:
+        return _in_device_paths(mod, DEVICE_PATHS + ("fuzz/",))
+
+    # -- module-level: which factories build donating jits ----------------
+
+    @staticmethod
+    def _donating_factories(mod: ModuleInfo) -> Set[str]:
+        """Module-level functions whose body jits with ``donate_argnums``
+        (storm._tick_fn / plane._routed_fns / mesh._storm_tick_fn), plus
+        names bound directly to such a jit."""
+        out: Set[str] = set()
+        for node in mod.tree.body:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                for sub in ast.walk(node):
+                    if isinstance(sub, ast.Call) and any(
+                        k.arg == "donate_argnums" for k in sub.keywords
+                    ):
+                        out.add(node.name)
+                        break
+            elif isinstance(node, ast.Assign) and isinstance(
+                node.value, ast.Call
+            ):
+                if any(
+                    k.arg == "donate_argnums"
+                    for k in node.value.keywords
+                ):
+                    for t in node.targets:
+                        if isinstance(t, ast.Name):
+                            out.add(t.id)
+        return out
+
+    @staticmethod
+    def _donating_attrs(cls: ast.ClassDef, factories: Set[str]) -> Set[str]:
+        """``self.X`` attributes a class binds from a donating factory
+        (``self._tick = _tick_fn(...)``; tuple unpacking included:
+        ``self._tick, self._scanned = _routed_fns(...)``)."""
+        out: Set[str] = set()
+        for node in ast.walk(cls):
+            if not isinstance(node, ast.Assign):
+                continue
+            call = node.value
+            if not (
+                isinstance(call, ast.Call)
+                and isinstance(call.func, ast.Name)
+                and call.func.id in factories
+            ):
+                continue
+            for t in node.targets:
+                targets = t.elts if isinstance(t, ast.Tuple) else [t]
+                for el in targets:
+                    if (
+                        isinstance(el, ast.Attribute)
+                        and isinstance(el.value, ast.Name)
+                        and el.value.id == "self"
+                    ):
+                        out.add(el.attr)
+        return out
+
+    # -- per-method linear scan -------------------------------------------
+
+    def _check_method(
+        self, mod: ModuleInfo, fn: ast.AST, donating: Set[str]
+    ) -> Iterator[Finding]:
+        # pass 1 — bare snapshots: `alias = <chain>.state` with NO
+        # wrapping call (a call — device_get, host_copy_states,
+        # np.asarray, ... — breaks the zero-copy aliasing and is the
+        # sanctioned idiom).  _own_nodes walks in tree order, not line
+        # order, so snapshot/dispatch pairing is by line comparison.
+        snapshots: Dict[str, Tuple[int, str]] = {}  # name -> (line, chain)
+        for node in _own_nodes(fn):
+            if isinstance(node, ast.Assign) and isinstance(
+                node.value, ast.Attribute
+            ):
+                chain = _attr_chain(node.value)
+                if chain and chain.rsplit(".", 1)[-1] in self._STATE_ATTRS:
+                    for t in node.targets:
+                        # FIRST binding wins (walk order is tree order,
+                        # not line order): a later re-snapshot must not
+                        # hide that the name was stale at the dispatch —
+                        # post-dispatch rebinds are handled by the
+                        # rebinds list below
+                        if isinstance(t, ast.Name) and (
+                            t.id not in snapshots
+                            or node.lineno < snapshots[t.id][0]
+                        ):
+                            snapshots[t.id] = (node.lineno, chain)
+        if not snapshots:
+            return
+        # pass 2 — donating dispatches and the snapshot names whose
+        # buffers each one invalidates
+        dispatches: List[Tuple[int, Set[str]]] = []  # (end line, dead names)
+        for node in _own_nodes(fn):
+            if not (
+                isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and isinstance(node.func.value, ast.Name)
+                and node.func.value.id == "self"
+                and node.func.attr in donating
+            ):
+                continue
+            call_line = node.lineno
+            dead: Set[str] = set()
+            arg_chains: Set[str] = set()
+            for arg in node.args:
+                for sub in ast.walk(arg):
+                    if isinstance(sub, ast.Name):
+                        if (
+                            sub.id in snapshots
+                            and snapshots[sub.id][0] < call_line
+                        ):
+                            dead.add(sub.id)
+                    elif isinstance(sub, ast.Attribute):
+                        chain = _attr_chain(sub)
+                        if chain:
+                            arg_chains.add(chain)
+            # a snapshot whose source chain is itself dispatched
+            # (`pre = self.state` ... `self._tick(self.state, ...)`)
+            # aliases the same donated buffers
+            for name, (line, chain) in snapshots.items():
+                if chain in arg_chains and line < call_line:
+                    dead.add(name)
+            if dead:
+                dispatches.append(
+                    (getattr(node, "end_lineno", node.lineno), dead)
+                )
+        if not dispatches:
+            return
+        # a Load strictly after the dispatch with no intervening rebind
+        # is the stale read.  Line order approximates execution order —
+        # a read textually before the dispatch inside a loop is a
+        # (documented) false negative, never a false positive.
+        rebinds: List[Tuple[int, str]] = []
+        for node in _own_nodes(fn):
+            if isinstance(node, ast.Assign):
+                for t in node.targets:
+                    if isinstance(t, ast.Name) and t.id in snapshots:
+                        rebinds.append((node.lineno, t.id))
+        reported: Set[str] = set()
+        for node in _own_nodes(fn):
+            if not (
+                isinstance(node, ast.Name)
+                and isinstance(node.ctx, ast.Load)
+                and node.id not in reported
+            ):
+                continue
+            for line, dead in dispatches:
+                if node.id in dead and node.lineno > line and not any(
+                    name == node.id and line < rb < node.lineno
+                    for rb, name in rebinds
+                ):
+                    reported.add(node.id)
+                    yield self.finding(
+                        mod,
+                        node,
+                        (
+                            f"'{node.id}' aliases device state donated "
+                            f"to a dispatch at line {line} — its "
+                            "buffers are dead; host-copy first "
+                            "(device_get / host_copy_states) or "
+                            "re-snapshot after the dispatch"
+                        ),
+                    )
+                    break
+
+    def check(self, mod: ModuleInfo) -> Iterator[Finding]:
+        factories = self._donating_factories(mod)
+        if not factories:
+            return
+        for cls in ast.walk(mod.tree):
+            if not isinstance(cls, ast.ClassDef):
+                continue
+            donating = self._donating_attrs(cls, factories)
+            if not donating:
+                continue
+            for node in cls.body:
+                if isinstance(
+                    node, (ast.FunctionDef, ast.AsyncFunctionDef)
+                ):
+                    yield from self._check_method(mod, node, donating)
+
+
 class AssertOnTracedRule(Rule):
     name = "assert-on-traced"
     summary = (
@@ -721,6 +918,7 @@ ALL_RULES: List[Rule] = [
     MutableDefaultRule(),
     BlockUntilReadyRule(),
     CallbackInDeviceRule(),
+    StaleRefAcrossDonationRule(),
     AssertOnTracedRule(),
 ]
 

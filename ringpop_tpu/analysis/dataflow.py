@@ -1,0 +1,451 @@
+"""jaxpr dataflow slicing: the shared recursive walker under jaxgate.
+
+This module is the ONE place in the codebase that knows how to traverse
+a ClosedJaxpr through ``pjit`` / ``scan`` / ``while`` / ``cond`` /
+``pallas_call`` sub-jaxprs (ISSUE 15).  Two consumers ride it:
+
+- the **hash-taint auditor** (jaxpr_audit.py) — a :class:`Visitor` whose
+  per-equation hook reimplements the round-8 uint32 taint discipline
+  bit-for-bit (findings text and format unchanged; the existing
+  tests/analysis suite pins the refactor), and
+- the **non-interference slicer** (:func:`slice_reachability`,
+  noninterference.py) — label-set propagation from chosen input leaves
+  to every output leaf, with witness chains naming the equations the
+  flow went through.
+
+Two traversal fidelities, selected per consumer:
+
+``precise=False`` (audit mode) reproduces the historical walk exactly:
+positional invar mapping where the inner/outer layouts line up, fully
+conservative treatment of ``while`` bodies and ``pallas_call`` kernels,
+and NO loop fixpoint — sub-jaxprs are walked once.
+
+``precise=True`` (slice mode) additionally maps ``while`` bodies through
+``cond_nconsts``/``body_nconsts``, maps ``cond`` branches past the
+predicate, and runs ``scan``/``while`` carries to a FIXPOINT so taint
+that crosses loop iterations (input -> carry -> next-iteration output)
+is seen.  Control dependence is modeled: a tainted ``cond`` predicate or
+``while`` condition taints every output of the equation — a value that
+steers control flow steers the values it selects.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional, Sequence, Tuple
+
+__all__ = [
+    "SubJaxpr",
+    "sub_jaxprs",
+    "Visitor",
+    "walk",
+    "Witness",
+    "witness_chain",
+    "slice_reachability",
+]
+
+
+@dataclasses.dataclass(frozen=True)
+class SubJaxpr:
+    """One sub-jaxpr of an equation, plus how values cross its boundary.
+
+    ``in_map[i]`` is the index into ``eqn.invars`` feeding inner invar
+    ``i`` (None: no trivially positional correspondence — values cross
+    the boundary conservatively).  ``out_positional`` says the inner
+    outvars line up positionally with ``eqn.outvars`` (prefix-wise).
+    ``carry_feedback`` lists ``(inner_out_idx, inner_in_idx)`` pairs fed
+    back across loop iterations (scan/while carries; empty unless the
+    walker runs in precise mode).  ``control`` marks a sub-jaxpr whose
+    OUTPUT steers the equation's control flow (a while condition): its
+    result taints every equation output in precise mode.
+    """
+
+    label: str
+    jaxpr: object  # ClosedJaxpr or (open) Jaxpr
+    in_map: Optional[List[int]]
+    out_positional: bool = True
+    carry_feedback: Tuple[Tuple[int, int], ...] = ()
+    control: bool = False
+
+    def open_(self) -> Tuple[object, Sequence]:
+        """(open jaxpr, consts) — consts only when the sub was closed."""
+        if hasattr(self.jaxpr, "jaxpr"):
+            return self.jaxpr.jaxpr, self.jaxpr.consts
+        return self.jaxpr, ()
+
+
+def sub_jaxprs(eqn, precise: bool = False) -> List[SubJaxpr]:
+    """The sub-jaxprs of ``eqn`` with boundary mappings.
+
+    ``precise=False`` reproduces jaxpr_audit's historical traversal
+    table exactly (while/cond-mismatch/pallas conservative, no
+    feedback); ``precise=True`` adds the while/scan/cond structure the
+    slicer needs.
+    """
+    import jax
+
+    prim = eqn.primitive.name
+    params = eqn.params
+    out: List[SubJaxpr] = []
+
+    def positional(j) -> Optional[List[int]]:
+        n_inner = len(j.jaxpr.invars if hasattr(j, "jaxpr") else j.invars)
+        if n_inner == len(eqn.invars):
+            return list(range(len(eqn.invars)))
+        return None
+
+    if prim in ("pjit", "closed_call", "core_call", "xla_call", "remat"):
+        j = params.get("jaxpr") or params.get("call_jaxpr")
+        if j is not None:
+            out.append(SubJaxpr(prim, j, positional(j)))
+    elif prim == "scan":
+        j = params["jaxpr"]
+        feedback: Tuple[Tuple[int, int], ...] = ()
+        if precise:
+            nc = params.get("num_consts", 0)
+            feedback = tuple(
+                (i, nc + i) for i in range(params.get("num_carry", 0))
+            )
+        out.append(
+            SubJaxpr(prim, j, positional(j), carry_feedback=feedback)
+        )
+    elif prim == "while":
+        cond_j = params["cond_jaxpr"]
+        body_j = params["body_jaxpr"]
+        if not precise:
+            out.append(SubJaxpr("while_cond", cond_j, None))
+            out.append(SubJaxpr("while_body", body_j, None))
+        else:
+            cn = params.get("cond_nconsts", 0)
+            bn = params.get("body_nconsts", 0)
+            n_carry = len(eqn.invars) - cn - bn
+            cond_map = list(range(cn)) + [
+                cn + bn + i for i in range(n_carry)
+            ]
+            body_map = [cn + i for i in range(bn)] + [
+                cn + bn + i for i in range(n_carry)
+            ]
+            out.append(
+                SubJaxpr(
+                    "while_cond",
+                    cond_j,
+                    cond_map,
+                    out_positional=False,
+                    control=True,
+                )
+            )
+            out.append(
+                SubJaxpr(
+                    "while_body",
+                    body_j,
+                    body_map,
+                    carry_feedback=tuple(
+                        (i, bn + i) for i in range(n_carry)
+                    ),
+                )
+            )
+    elif prim == "cond":
+        for k, branch in enumerate(params["branches"]):
+            n_inner = len(branch.jaxpr.invars)
+            mapping = (
+                list(range(1, len(eqn.invars)))
+                if n_inner == len(eqn.invars) - 1
+                else None
+            )
+            out.append(SubJaxpr(f"cond_branch{k}", branch, mapping))
+    elif prim in ("custom_jvp_call", "custom_vjp_call", "custom_jvp_call_jaxpr"):
+        j = params.get("call_jaxpr") or params.get("fun_jaxpr")
+        if j is not None:
+            out.append(SubJaxpr(prim, j, positional(j)))
+    else:
+        # generic fallback (pallas_call kernels, checkpoint, ...): find
+        # any jaxpr-valued param and walk it with constant-only seeding
+        for key, val in params.items():
+            if isinstance(val, (jax.core.ClosedJaxpr, jax.core.Jaxpr)):
+                out.append(
+                    SubJaxpr(f"{prim}.{key}", val, None, out_positional=False)
+                )
+            elif isinstance(val, (tuple, list)):
+                for k, item in enumerate(val):
+                    if isinstance(
+                        item, (jax.core.ClosedJaxpr, jax.core.Jaxpr)
+                    ):
+                        out.append(
+                            SubJaxpr(
+                                f"{prim}.{key}[{k}]",
+                                item,
+                                None,
+                                out_positional=False,
+                            )
+                        )
+    return out
+
+
+class Visitor:
+    """Per-equation hooks driven by :func:`walk`.
+
+    A visitor defines the abstract value propagated through the jaxpr
+    (``bottom`` + ``join`` form the lattice), seeds values at constvars
+    and literals, and computes each equation's output values — emitting
+    findings as a side effect if it wants.  ``measure`` maps a value to
+    something hashable so the walker's loop fixpoints can detect
+    convergence without comparing witnesses.
+    """
+
+    bottom = None
+    precise = False  # traversal fidelity (see module docstring)
+    fixpoint = False  # iterate scan/while carries to a fixpoint
+
+    def join(self, a, b):
+        raise NotImplementedError
+
+    def measure(self, val):
+        return val
+
+    def seed_constvar(self, var, const):
+        return self.bottom
+
+    def literal(self, lit):
+        return self.bottom
+
+    def enter_eqn(self, eqn, stack: Tuple[str, ...], in_vals: List) -> None:
+        """Called once per equation before sub-jaxpr recursion."""
+
+    def eqn_out(
+        self,
+        eqn,
+        stack: Tuple[str, ...],
+        in_vals: List,
+        subs: List[SubJaxpr],
+        sub_out_vals: List[List],
+    ) -> List:
+        raise NotImplementedError
+
+
+def walk(
+    jaxpr,
+    consts: Sequence,
+    stack: Tuple[str, ...],
+    in_vals: Sequence,
+    visitor: Visitor,
+) -> List:
+    """Propagate ``visitor`` values through one (open) jaxpr.
+
+    Returns the values at ``jaxpr.outvars``.  The recursion through
+    sub-jaxprs and the optional carry fixpoint live here — consumers
+    only see per-equation hooks.
+    """
+    import jax
+
+    env: Dict[object, object] = {}
+    for var, val in zip(jaxpr.invars, in_vals):
+        env[var] = val
+    for var, const in zip(jaxpr.constvars, consts):
+        env[var] = visitor.seed_constvar(var, const)
+
+    def val_of(v):
+        if isinstance(v, jax.core.Literal):
+            return visitor.literal(v)
+        return env.get(v, visitor.bottom)
+
+    def walk_sub(sub: SubJaxpr, cur_in: List) -> List:
+        inner, inner_consts = sub.open_()
+        n_inner = len(inner.invars)
+        if sub.in_map is not None:
+            inner_in = [
+                cur_in[sub.in_map[i]]
+                if i < len(sub.in_map)
+                else visitor.bottom
+                for i in range(n_inner)
+            ]
+        else:
+            inner_in = [visitor.bottom] * n_inner
+        while True:
+            ov = walk(
+                inner,
+                inner_consts,
+                stack + (sub.label,),
+                inner_in,
+                visitor,
+            )
+            if not (visitor.fixpoint and sub.carry_feedback):
+                return ov
+            changed = False
+            for oi, ii in sub.carry_feedback:
+                if oi >= len(ov) or ii >= n_inner:
+                    continue
+                joined = visitor.join(inner_in[ii], ov[oi])
+                if visitor.measure(joined) != visitor.measure(
+                    inner_in[ii]
+                ):
+                    inner_in[ii] = joined
+                    changed = True
+            if not changed:
+                # soundness: write the converged carry values back into
+                # the equation's input view, so sibling subs walked
+                # AFTER this one (a while condition) see taint that only
+                # enters the carry on a later iteration
+                if sub.in_map is not None:
+                    for _oi, ii in sub.carry_feedback:
+                        if ii < len(sub.in_map):
+                            cur_in[sub.in_map[ii]] = inner_in[ii]
+                return ov
+
+    for eqn in jaxpr.eqns:
+        cur_in = [val_of(v) for v in eqn.invars]
+        visitor.enter_eqn(eqn, stack, cur_in)
+        subs = sub_jaxprs(eqn, precise=visitor.precise)
+        sub_out_vals: List[Optional[List]] = [None] * len(subs)
+        if visitor.fixpoint:
+            # loop bodies first (their fixpoint updates cur_in's carry
+            # view), then control/other subs against the updated carries
+            order = sorted(
+                range(len(subs)), key=lambda i: not subs[i].carry_feedback
+            )
+        else:
+            order = list(range(len(subs)))
+        for i in order:
+            sub_out_vals[i] = walk_sub(subs[i], cur_in)
+        outs = visitor.eqn_out(eqn, stack, cur_in, subs, sub_out_vals)
+        for var, val in zip(eqn.outvars, outs):
+            if isinstance(var, jax.core.DropVar):
+                continue
+            env[var] = val
+    return [val_of(v) for v in jaxpr.outvars]
+
+
+# ---------------------------------------------------------------------------
+# the non-interference slicer: label-set reachability with witness chains
+
+
+@dataclasses.dataclass(frozen=True)
+class Witness:
+    """One hop of an input->output flow: the equation that carried it.
+
+    Witnesses form a shared-structure linked list back toward the seed
+    (``prev``); :func:`witness_chain` renders one as the human-readable
+    eqn chain a finding prints.  Join keeps the FIRST witness per label,
+    so chains stay stable (and memory bounded) across loop fixpoints.
+    """
+
+    prim: str
+    loc: str  # "/".join(stack) at the carrying equation
+    prev: Optional["Witness"] = None
+
+
+def witness_chain(w: Optional[Witness], limit: int = 8) -> str:
+    """Render a witness as ``seed-side -> ... -> output-side`` text."""
+    hops: List[str] = []
+    while w is not None:
+        loc = w.loc or "<top>"
+        hops.append(f"{w.prim}@{loc}")
+        w = w.prev
+    hops.reverse()
+    if len(hops) > limit:
+        head = limit // 2
+        tail = limit - head
+        omitted = len(hops) - limit
+        hops = hops[:head] + [f"... ({omitted} eqns) ..."] + hops[-tail:]
+    return " -> ".join(hops) if hops else "<direct>"
+
+
+class _SliceVisitor(Visitor):
+    """val = {label: Witness}.  Conservative per-equation propagation:
+    with no sub-jaxprs every output sees every input (primitive
+    semantics are not modeled — a scatter's indices legitimately steer
+    its output); positionally mapped sub-jaxprs keep their per-position
+    separation, which is what makes the slice precise where it matters
+    (the scanned state carry)."""
+
+    bottom: Dict = {}
+    precise = True
+    fixpoint = True
+
+    def join(self, a, b):
+        if not b:
+            return a
+        if not a:
+            return b
+        merged = dict(a)
+        for k, v in b.items():
+            merged.setdefault(k, v)
+        return merged
+
+    def measure(self, val):
+        return frozenset(val)
+
+    def eqn_out(self, eqn, stack, in_vals, subs, sub_out_vals):
+        n_out = len(eqn.outvars)
+        prim = eqn.primitive.name
+        loc = "/".join(stack)
+
+        def extend(val):
+            if not val:
+                return self.bottom
+            return {
+                k: Witness(prim, loc, prev=w) for k, w in val.items()
+            }
+
+        if not subs:
+            flowed = self.bottom
+            for v in in_vals:
+                flowed = self.join(flowed, v)
+            out = extend(flowed)
+            return [out] * n_out
+
+        outs: List[Dict] = [self.bottom] * n_out
+        spill = self.bottom  # joins into every output
+        mapped_in: set = set()
+        for sub, ov in zip(subs, sub_out_vals):
+            if sub.in_map is not None:
+                mapped_in.update(sub.in_map)
+            if sub.control or not sub.out_positional:
+                for v in ov:
+                    spill = self.join(spill, v)
+            else:
+                for i in range(min(n_out, len(ov))):
+                    outs[i] = self.join(outs[i], ov[i])
+            # zero-iteration identity: a while that never runs (and a
+            # length-0 scan) returns its INITIAL carry, so carry inputs
+            # reach the matching outputs even when the body overwrites
+            # the slot — dropping this would let an obs-tainted carry
+            # slip out unlabeled
+            if sub.carry_feedback and sub.in_map is not None:
+                for oi, ii in sub.carry_feedback:
+                    if oi < n_out and ii < len(sub.in_map):
+                        outs[oi] = self.join(
+                            outs[oi], extend(in_vals[sub.in_map[ii]])
+                        )
+        # equation inputs no sub-jaxpr consumed positionally (a cond
+        # predicate, pallas operands) flow conservatively to every out
+        for i, v in enumerate(in_vals):
+            if i not in mapped_in:
+                spill = self.join(spill, v)
+        if spill:
+            spill = extend(spill)
+            outs = [self.join(o, spill) for o in outs]
+        return outs
+
+
+def slice_reachability(
+    closed, seed_labels: Sequence[Optional[str]]
+) -> List[Dict[str, Witness]]:
+    """Input->output reachability over a ClosedJaxpr.
+
+    ``seed_labels[i]`` labels flattened input leaf ``i`` (None: not
+    tracked).  Returns, per flattened output leaf, ``{label: Witness}``
+    for every seeded input that can reach it — transitively, through
+    every sub-jaxpr, with loop carries run to a fixpoint.
+    """
+    jaxpr = closed.jaxpr
+    if len(seed_labels) != len(jaxpr.invars):
+        raise ValueError(
+            f"seed_labels has {len(seed_labels)} entries for "
+            f"{len(jaxpr.invars)} jaxpr inputs"
+        )
+    visitor = _SliceVisitor()
+    in_vals = [
+        {lab: Witness("<input>", "")} if lab is not None else {}
+        for lab in seed_labels
+    ]
+    return walk(jaxpr, closed.consts, (), in_vals, visitor)

@@ -1,0 +1,463 @@
+"""jaxgate prong: donation/aliasing sanitizer against DONATION_BUDGET.json.
+
+The two worst bugs the dynamic suites ever caught — PR 8's XLA-cache
+donation mis-execution on CPU, PR 7's live-device-state aliasing flake
+across donating dispatches — were both donation/aliasing bugs no other
+prong could see.  This prong pins the donation surface statically:
+
+- every jitted driver that donates its carry (single-sourced through
+  ``storm.donate_state_argnums`` — the PR-8 CPU backend gate lives
+  THERE, not here) is compiled at toy shapes and the executable's
+  ``input_output_alias`` map is extracted from the optimized HLO;
+- a donated leaf that no output aliases is a **silently dropped
+  donation** (rule ``donation-dropped``): the caller pays the API cost
+  of donation (its buffers are dead after the call) without the
+  in-place win — almost always a shape/dtype mismatch between the
+  donated leaf and every output, which the finding names;
+- the expected alias map is pinned in a committed
+  ``DONATION_BUDGET.json`` diffed like the retrace/cost budgets.  On
+  CPU, ``donate_state_argnums()`` returns ``()`` (the PR-8 backend
+  gate), so the committed CPU manifest shows every entry with an EMPTY
+  alias map — the gate is visible manifest data, not a special case in
+  this checker.  A chip session banks a TPU manifest side by side via
+  ``--budget``.
+
+Regenerate with ``scripts/check_donation_budget.py --write`` after an
+INTENTIONAL donation-surface change; ``--write`` refuses entries that
+failed to compile or that drop donations.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import re
+from pathlib import Path
+from typing import Callable, Dict, Iterable, List, Optional, Sequence, Tuple
+
+from ringpop_tpu.analysis.findings import Finding
+
+MANIFEST_NAME = "DONATION_BUDGET.json"
+
+_ALIAS_ENTRY_RE = re.compile(
+    r"\{\s*([0-9,\s]*)\}:\s*\(([0-9]+),\s*\{([0-9,\s]*)\}"
+)
+
+
+def _alias_map_text(hlo_text: str) -> str:
+    """The brace-balanced body of ``input_output_alias={...}`` in an
+    optimized HLO module header ('' when the executable aliases nothing)."""
+    marker = "input_output_alias={"
+    start = hlo_text.find(marker)
+    if start < 0:
+        return ""
+    i = start + len(marker)
+    depth = 1
+    out = []
+    while i < len(hlo_text) and depth:
+        c = hlo_text[i]
+        if c == "{":
+            depth += 1
+        elif c == "}":
+            depth -= 1
+            if not depth:
+                break
+        out.append(c)
+        i += 1
+    return "".join(out)
+
+
+def parse_alias_map(hlo_text: str) -> List[Tuple[Tuple[int, ...], int]]:
+    """``[(output_index, param_number), ...]`` from optimized HLO text.
+
+    jit flattens pytrees, so ``param_number`` is the flattened input
+    leaf index and the output index tuple is almost always one level
+    deep; the raw tuple is preserved for the manifest either way."""
+    body = _alias_map_text(hlo_text)
+    out: List[Tuple[Tuple[int, ...], int]] = []
+    for m in _ALIAS_ENTRY_RE.finditer(body):
+        out_idx = tuple(
+            int(x) for x in m.group(1).replace(",", " ").split()
+        )
+        out.append((out_idx, int(m.group(2))))
+    return out
+
+
+def audit_jit(fn, args: Tuple, donate_argnums: Sequence[int]) -> dict:
+    """Compile one donating jit and report its donation outcome.
+
+    Returns ``{donated_params, aliased_params, aliases, dropped}`` where
+    ``dropped`` lists ``{param, shape, dtype}`` for every donated leaf no
+    output aliases.  ``fn`` must already carry its donation config (this
+    helper never adds one) — ``donate_argnums`` only says which
+    positional args the config covers, so the flattened leaf indices can
+    be recovered.
+    """
+    import jax
+
+    compiled = fn.lower(*args).compile()
+    aliases = parse_alias_map(compiled.as_text())
+    aliased_params = {p for _, p in aliases}
+
+    donated_idx: Dict[int, object] = {}  # flattened leaf index -> leaf
+    offset = 0
+    for i, arg in enumerate(args):
+        leaves = jax.tree_util.tree_flatten(arg)[0]
+        if i in donate_argnums:
+            for j, leaf in enumerate(leaves):
+                donated_idx[offset + j] = leaf
+        offset += len(leaves)
+
+    dropped = []
+    for p in sorted(set(donated_idx) - aliased_params):
+        leaf = donated_idx[p]
+        dropped.append(
+            {
+                "param": p,
+                "shape": list(getattr(leaf, "shape", ())),
+                "dtype": str(getattr(leaf, "dtype", "?")),
+            }
+        )
+    return {
+        "donated_params": len(donated_idx),
+        "aliased_params": len(aliased_params & set(donated_idx)),
+        # JSON-stable: one "out{...} <- param N" string per alias row
+        "aliases": sorted(
+            "out{%s} <- param %d" % (",".join(map(str, o)), p)
+            for o, p in aliases
+        ),
+        "dropped": dropped,
+    }
+
+
+# ---------------------------------------------------------------------------
+# entry registry: every donating jitted driver in the repo
+
+
+@dataclasses.dataclass(frozen=True)
+class DonationEntry:
+    name: str
+    build: Callable[[], Tuple[Callable, Tuple]]  # () -> (jitted fn, args)
+
+
+def _scalable_fixture(t: int = 2):
+    import jax
+
+    from ringpop_tpu.models.sim import engine_scalable as es
+    from ringpop_tpu.models.sim import storm
+
+    params = es.resolve_scalable_params(
+        es.ScalableParams(n=8, u=128), jax.default_backend()
+    )
+    state = es.init_state(params, seed=0)
+    one = es.ChurnInputs.quiet(8)
+    stacked = jax.tree_util.tree_map(
+        lambda x: jax.numpy.broadcast_to(x, (t,) + x.shape), one
+    )
+    return storm, params, state, one, stacked
+
+
+def _entry_scalable_tick() -> Tuple[Callable, Tuple]:
+    storm, params, state, one, _ = _scalable_fixture()
+    return storm._tick_fn(params), (state, one)
+
+
+def _entry_scalable_scan() -> Tuple[Callable, Tuple]:
+    storm, params, state, _, stacked = _scalable_fixture()
+    return storm._scanned_fn(params), (state, stacked)
+
+
+def _routed_fixture(t: int = 2):
+    import jax
+
+    from ringpop_tpu.models.route import plane
+    from ringpop_tpu.models.sim import engine_scalable as es
+
+    rs = plane.RoutedStorm(
+        n=8,
+        route=plane.RouteParams(
+            n=8,
+            replica_points=4,
+            bucket_bits=2,
+            queries_per_tick=16,
+            key_space=64,
+            max_changed=4,
+            max_dirty=4,
+        ),
+        replica_points=4,
+    )
+    one = es.ChurnInputs.quiet(8)
+    stacked = jax.tree_util.tree_map(
+        lambda x: jax.numpy.broadcast_to(x, (t,) + x.shape), one
+    )
+    carry = (rs.cluster.state, rs.rstate)
+    static = (rs.buckets, rs.reps, rs.cdf)
+    return rs, carry, one, stacked, static
+
+
+def _entry_routed_tick() -> Tuple[Callable, Tuple]:
+    rs, carry, one, _, static = _routed_fixture()
+    return rs._tick, (carry, one) + static
+
+
+def _entry_routed_scan() -> Tuple[Callable, Tuple]:
+    rs, carry, _, stacked, static = _routed_fixture()
+    return rs._scanned, (carry, stacked) + static
+
+
+def _entry_mesh_storm_tick() -> Tuple[Callable, Tuple]:
+    """The sharded storm's donating SPMD tick on a 1-device mesh — the
+    routing program is identical at any shard count, and the alias map
+    must hold under explicit shardings too (round 14)."""
+    import jax
+
+    from ringpop_tpu.models.sim import engine_scalable as es
+    from ringpop_tpu.parallel import mesh as pmesh
+
+    params = es.resolve_scalable_params(
+        es.ScalableParams(n=8, u=128), jax.default_backend()
+    )
+    mesh = pmesh.make_mesh(1)
+    fn = pmesh._storm_tick_fn(params, mesh, (True, True), None)
+    state = es.init_state(params, seed=0)
+    return fn, (state, es.ChurnInputs.quiet(8))
+
+
+DEFAULT_ENTRIES: List[DonationEntry] = [
+    DonationEntry("scalable-tick", _entry_scalable_tick),
+    DonationEntry("scalable-scan", _entry_scalable_scan),
+    DonationEntry("routed-tick", _entry_routed_tick),
+    DonationEntry("routed-scan", _entry_routed_scan),
+    DonationEntry("mesh-storm-tick", _entry_mesh_storm_tick),
+]
+
+# tier-1 cheap subset (seconds warm under the persistent XLA cache);
+# the full registry runs via scripts/check_donation_budget.py / --prong
+CHEAP_ENTRIES: Tuple[str, ...] = ("scalable-tick", "routed-tick")
+
+# module suffixes that can move the donation surface — the
+# --changed-only gate (any analysis/ change re-runs everything)
+SOURCES: Tuple[str, ...] = (
+    "models/sim/storm.py",
+    "models/sim/engine_scalable.py",
+    "models/route/plane.py",
+    "parallel/mesh.py",
+    "analysis/",
+)
+
+
+def collect(entry_names: Optional[Iterable[str]] = None) -> Dict[str, dict]:
+    """Compile each donating driver and extract its donation outcome;
+    an entry that fails to build/compile yields ``{"error": ...}``."""
+    from ringpop_tpu.models.sim.storm import donate_state_argnums
+
+    donate = donate_state_argnums()
+    by_name = {e.name: e for e in DEFAULT_ENTRIES}
+    wanted = sorted(by_name if entry_names is None else set(entry_names))
+    out: Dict[str, dict] = {}
+    for name in wanted:
+        e = by_name.get(name)
+        if e is None:
+            out[name] = {"error": "unknown donation entry"}
+            continue
+        try:
+            fn, args = e.build()
+            out[name] = audit_jit(fn, args, donate)
+        except Exception as exc:
+            out[name] = {"error": f"{type(exc).__name__}: {exc}"}
+    return out
+
+
+def compare_to_manifest(
+    actual: Dict[str, dict], manifest: dict
+) -> List[Finding]:
+    findings: List[Finding] = []
+
+    def finding(rule, name, msg):
+        findings.append(
+            Finding(
+                rule=rule,
+                path=f"<entry:{name}>",
+                line=0,
+                message=msg,
+                prong="donation",
+            )
+        )
+
+    expected = manifest.get("entries", {})
+    for name, exp in sorted(expected.items()):
+        act = actual.get(name)
+        if act is None:
+            finding(
+                "donation-budget", name, "entry in manifest but not measured"
+            )
+            continue
+        if "error" in act:
+            finding(
+                "donation-failure",
+                name,
+                f"entry failed to compile: {act['error']}",
+            )
+            continue
+        for d in act["dropped"]:
+            finding(
+                "donation-dropped",
+                name,
+                (
+                    "donated leaf param %d (%s[%s]) is not consumed by any "
+                    "input_output_alias — the donation is silently dropped "
+                    "(no output matches its shape/dtype); drop the leaf "
+                    "from the donated carry or fix the mismatch"
+                )
+                % (
+                    d["param"],
+                    d["dtype"],
+                    ",".join(map(str, d["shape"])),
+                ),
+            )
+        for key in ("donated_params", "aliased_params", "aliases"):
+            if act.get(key) != exp.get(key):
+                finding(
+                    "donation-budget",
+                    name,
+                    (
+                        f"{key} changed: measured {act.get(key)!r} vs "
+                        f"manifest {exp.get(key)!r} — regenerate with "
+                        "scripts/check_donation_budget.py --write if "
+                        "intentional"
+                    ),
+                )
+    for name in sorted(set(actual) - set(expected)):
+        act = actual[name]
+        if "error" in act:
+            finding(
+                "donation-failure",
+                name,
+                f"entry failed to compile: {act['error']}",
+            )
+        else:
+            finding(
+                "donation-budget",
+                name,
+                (
+                    "entry has no manifest entry — regenerate with "
+                    "scripts/check_donation_budget.py --write"
+                ),
+            )
+    return findings
+
+
+def manifest_path(root: Optional[Path] = None) -> Path:
+    if root is None:
+        root = Path(__file__).resolve().parents[2]
+    return root / MANIFEST_NAME
+
+
+def load_manifest(path: Optional[Path] = None) -> dict:
+    with open(path or manifest_path()) as f:
+        return json.load(f)
+
+
+def write_manifest(
+    actual: Dict[str, dict], path: Optional[Path] = None
+) -> Path:
+    """Commit the donation outcome.  REFUSES failed entries AND dropped
+    donations — a manifest must never bless a silent drop."""
+    import jax
+
+    from ringpop_tpu.models.sim.storm import donate_state_argnums
+
+    broken = {
+        n: e["error"] for n, e in actual.items() if "error" in e
+    }
+    if broken:
+        raise ValueError(
+            f"refusing to write a manifest with failed entries: {broken}"
+        )
+    dropping = {
+        n: e["dropped"] for n, e in actual.items() if e.get("dropped")
+    }
+    if dropping:
+        raise ValueError(
+            "refusing to write a manifest with dropped donations "
+            f"(fix the shape/dtype mismatch instead): {dropping}"
+        )
+    p = path or manifest_path()
+    doc = {
+        "version": 1,
+        "backend": jax.default_backend(),
+        "jax_version": jax.__version__,
+        # the PR-8 CPU gate, recorded as DATA: () on CPU means every
+        # entry below legitimately aliases nothing
+        "donate_argnums": list(donate_state_argnums()),
+        "note": (
+            "jaxgate donation budget: expected input_output_alias "
+            "surface of every donating jitted driver at toy shapes (see "
+            "ringpop_tpu/analysis/donation.py).  Regenerate with "
+            "scripts/check_donation_budget.py --write after an "
+            "INTENTIONAL donation-surface change."
+        ),
+        "entries": actual,
+    }
+    with open(p, "w") as f:
+        json.dump(doc, f, indent=2, sort_keys=True)
+        f.write("\n")
+    return p
+
+
+def check_against_manifest(
+    entry_names: Optional[Iterable[str]] = None,
+    path: Optional[Path] = None,
+) -> List[Finding]:
+    """The gate: compile + diff.  Each backend banks its OWN manifest
+    (the CPU one pins the PR-8 donation-off gate as empty alias maps) —
+    and a backend mismatch is a LOUD finding, not a silent skip: the
+    mismatch case is precisely a donating backend (TPU) running against
+    the donation-off CPU manifest, where a dropped donation would
+    otherwise sail through green."""
+    import jax
+
+    try:
+        manifest = load_manifest(path)
+    except FileNotFoundError:
+        return [
+            Finding(
+                rule="donation-budget",
+                path=MANIFEST_NAME,
+                line=0,
+                message=(
+                    "manifest missing — generate with "
+                    "scripts/check_donation_budget.py --write"
+                ),
+                prong="donation",
+            )
+        ]
+    backend = jax.default_backend()
+    if manifest.get("backend") != backend:
+        return [
+            Finding(
+                rule="donation-budget",
+                path=MANIFEST_NAME,
+                line=0,
+                message=(
+                    "manifest was banked on backend "
+                    f"{manifest.get('backend')!r} but this run is on "
+                    f"{backend!r} — donation surfaces do not transfer "
+                    "across backends; bank one for this backend with "
+                    "scripts/check_donation_budget.py --write --budget "
+                    f"DONATION_BUDGET_{backend.upper()}.json"
+                ),
+                prong="donation",
+            )
+        ]
+    explicit_subset = entry_names is not None
+    actual = collect(entry_names)
+    if explicit_subset:
+        sliced = dict(manifest)
+        sliced["entries"] = {
+            k: v
+            for k, v in manifest.get("entries", {}).items()
+            if k in actual
+        }
+        return compare_to_manifest(actual, sliced)
+    return compare_to_manifest(actual, manifest)

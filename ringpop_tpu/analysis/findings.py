@@ -116,10 +116,13 @@ def render_text(findings: Iterable[Finding]) -> str:
     return "\n".join(out)
 
 
-def render_json(findings: Iterable[Finding]) -> str:
+def render_json(
+    findings: Iterable[Finding], meta: Optional[dict] = None
+) -> str:
     fs = sorted(findings, key=Finding.sort_key)
-    return json.dumps(
-        {"findings": [f.as_dict() for f in fs], "count": len(fs)},
-        indent=2,
-        sort_keys=True,
-    )
+    doc = {"findings": [f.as_dict() for f in fs], "count": len(fs)}
+    if meta:
+        # extra top-level keys (e.g. the CLI's per-prong wall clocks);
+        # findings/count always win on collision
+        doc = {**meta, **doc}
+    return json.dumps(doc, indent=2, sort_keys=True)

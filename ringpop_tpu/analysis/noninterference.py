@@ -1,0 +1,357 @@
+"""jaxgate prong: static non-interference proof for the obs planes.
+
+The repo's core correctness contract is *gate-equivalence neutrality*:
+every observability plane — flight recorder (``ev_buf``/``ev_head``/
+``ev_drops``), latency histograms (``hist``), rumor wavefronts
+(``first_heard``) — and every per-tick metrics struct must be bitwise
+invisible to the trajectory.  The n=64 tier-1 / n=1k slow A/B suites
+*sample* that property dynamically; this prong PROVES the dataflow half
+of it statically, per traced entry point:
+
+    no obs-only input leaf reaches any trajectory output leaf.
+
+Field classes come from ONE registry per engine
+(``engine.SIM_TRAJECTORY_FIELDS`` / ``SIM_OBS_ONLY_FIELDS``,
+``engine_scalable.SCALABLE_*``, ``plane.ROUTE_*`` — the repo-scan gate
+tests/analysis/test_state_registry.py keeps them total and disjoint).
+The entry points are the jaxpr prong's registry
+(jaxpr_audit.DEFAULT_ENTRIES): each is traced, its flattened input
+leaves labeled from the state registries, and the transitive def-use
+slice (analysis/dataflow.py, loop carries to a fixpoint) is checked —
+an obs leaf reaching a trajectory leaf is a finding that names the
+offending equation chain.
+
+Metrics structs (``*Metrics``) are classified as observability SINKS:
+obs state may flow into them.  They are still trajectory-DERIVED in the
+dynamic gates (bitwise-compared across obs on/off), so a mask that
+starts reading an obs plane shows up there; what this prong pins is the
+state-to-state dataflow the PR-7/PR-8 class of bug lives in.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, Iterable, List, Optional, Sequence, Tuple
+
+from ringpop_tpu.analysis import dataflow
+from ringpop_tpu.analysis.findings import Finding
+
+# kinds a leaf can carry
+KIND_TRAJ = "trajectory"
+KIND_OBS = "obs-only"
+KIND_METRICS = "metrics"
+KIND_OTHER = "other"
+KIND_UNCLASSIFIED = "unclassified"
+
+
+@dataclasses.dataclass(frozen=True)
+class Label:
+    kind: str
+    path: str  # e.g. "SimState.hist" or "arg1"
+
+
+def state_registries() -> Dict[str, Tuple[frozenset, frozenset]]:
+    """class name -> (trajectory fields, obs-only fields); the single
+    sources live next to the state classes themselves."""
+    from ringpop_tpu.models.route import plane
+    from ringpop_tpu.models.sim import engine, engine_scalable as es
+
+    return {
+        "SimState": (
+            engine.SIM_TRAJECTORY_FIELDS,
+            engine.SIM_OBS_ONLY_FIELDS,
+        ),
+        "ScalableState": (
+            es.SCALABLE_TRAJECTORY_FIELDS,
+            es.SCALABLE_OBS_ONLY_FIELDS,
+        ),
+        "RouteState": (
+            plane.ROUTE_TRAJECTORY_FIELDS,
+            plane.ROUTE_OBS_ONLY_FIELDS,
+        ),
+    }
+
+
+def _is_namedtuple(x) -> bool:
+    return isinstance(x, tuple) and hasattr(x, "_fields")
+
+
+def label_tree(x, regs: Dict[str, Tuple[frozenset, frozenset]], path: str,
+               kind: str = KIND_OTHER):
+    """Structure-identical pytree with a :class:`Label` at every leaf.
+
+    Registered state classes label their fields from the registry;
+    ``*Metrics`` namedtuples become metrics sinks; everything nested
+    under a classified field inherits its class (a RingState inside
+    ``RouteState.ring`` is trajectory)."""
+    if x is None:
+        return None
+    if _is_namedtuple(x):
+        cls = type(x).__name__
+        if cls in regs:
+            traj, obs = regs[cls]
+            parts = []
+            for f, v in zip(x._fields, x):
+                if f in obs:
+                    k = KIND_OBS
+                elif f in traj:
+                    k = KIND_TRAJ
+                else:
+                    k = KIND_UNCLASSIFIED
+                parts.append(label_tree(v, regs, f"{cls}.{f}", k))
+            return type(x)(*parts)
+        sub_kind = KIND_METRICS if cls.endswith("Metrics") else kind
+        return type(x)(
+            *(
+                label_tree(v, regs, f"{path or cls}.{f}", sub_kind)
+                for f, v in zip(x._fields, x)
+            )
+        )
+    if isinstance(x, (tuple, list)):
+        return type(x)(
+            label_tree(v, regs, f"{path}[{i}]", kind)
+            for i, v in enumerate(x)
+        )
+    if isinstance(x, dict):
+        return {
+            k: label_tree(v, regs, f"{path}[{k!r}]", kind)
+            for k, v in x.items()
+        }
+    return Label(kind, path)
+
+
+def _flatten_labels(labels) -> List[Label]:
+    import jax
+
+    return jax.tree_util.tree_flatten(
+        labels, is_leaf=lambda v: isinstance(v, Label)
+    )[0]
+
+
+def check_entry(
+    name: str, fn, args: Tuple, cache_as: Optional[str] = None
+) -> List[Finding]:
+    """Prove non-interference for one traced entry point.
+
+    ``cache_as`` names a REGISTERED entry whose trace may be shared with
+    the jaxpr prong (jaxpr_audit.trace_entry) — ad-hoc callers (the
+    mutation tests' doctored entries) leave it None and trace fresh."""
+    import jax
+
+    regs = state_registries()
+    findings: List[Finding] = []
+    in_labels = _flatten_labels(label_tree(tuple(args), regs, "args"))
+    for lab in in_labels:
+        if lab.kind == KIND_UNCLASSIFIED:
+            findings.append(
+                Finding(
+                    rule="unclassified-state-field",
+                    path=f"<entry:{name}>",
+                    line=0,
+                    message=(
+                        f"state field {lab.path} is in neither the "
+                        "trajectory nor the obs-only registry — classify "
+                        "it next to the state class (see "
+                        "engine.SIM_TRAJECTORY_FIELDS)"
+                    ),
+                    prong="noninterference",
+                )
+            )
+    if not any(lab.kind == KIND_OBS for lab in in_labels):
+        return findings  # nothing to prove: no obs plane in this entry
+
+    try:
+        if cache_as is not None:
+            from ringpop_tpu.analysis import jaxpr_audit as ja
+
+            closed, out_shape = ja.trace_entry(cache_as, fn, args)
+        else:
+            closed, out_shape = jax.make_jaxpr(fn, return_shape=True)(
+                *args
+            )
+    except Exception as e:
+        findings.append(
+            Finding(
+                rule="trace-failure",
+                path=f"<entry:{name}>",
+                line=0,
+                message=(
+                    f"entry point failed to trace: {type(e).__name__}: {e}"
+                ),
+                prong="noninterference",
+            )
+        )
+        return findings
+
+    if len(in_labels) != len(closed.jaxpr.invars):
+        findings.append(
+            Finding(
+                rule="trace-failure",
+                path=f"<entry:{name}>",
+                line=0,
+                message=(
+                    f"label/trace mismatch: {len(in_labels)} labeled input "
+                    f"leaves vs {len(closed.jaxpr.invars)} jaxpr inputs"
+                ),
+                prong="noninterference",
+            )
+        )
+        return findings
+
+    seeds = [
+        lab.path if lab.kind == KIND_OBS else None for lab in in_labels
+    ]
+    reach = dataflow.slice_reachability(closed, seeds)
+    out_labels = _flatten_labels(label_tree(out_shape, regs, "out"))
+    if len(out_labels) != len(reach):
+        findings.append(
+            Finding(
+                rule="trace-failure",
+                path=f"<entry:{name}>",
+                line=0,
+                message=(
+                    f"label/trace mismatch: {len(out_labels)} labeled "
+                    f"output leaves vs {len(reach)} jaxpr outputs"
+                ),
+                prong="noninterference",
+            )
+        )
+        return findings
+
+    for out_lab, reached in zip(out_labels, reach):
+        if out_lab.kind != KIND_TRAJ or not reached:
+            continue
+        for src, witness in sorted(reached.items()):
+            findings.append(
+                Finding(
+                    rule="obs-interference",
+                    path=f"<entry:{name}>",
+                    line=0,
+                    message=(
+                        f"obs-only input {src} reaches trajectory output "
+                        f"{out_lab.path} — the observability plane leaks "
+                        "into the gate-compared state; eqn chain: "
+                        f"{dataflow.witness_chain(witness)}"
+                    ),
+                    prong="noninterference",
+                )
+            )
+    return findings
+
+
+# entry names that carry an obs plane at trace time — the tier-1
+# cheap-gate subset and the default documentation set.  Entries outside
+# this list are still scanned by a full run (they prove vacuous: no obs
+# input leaves), so a NEW obs-carrying entry is picked up automatically.
+OBS_ENTRY_NAMES: Tuple[str, ...] = (
+    "engine-tick-scan-flight-recorder",
+    "engine-tick-scan-histograms",
+    "engine-scalable-tick-wavefront",
+    "engine-scalable-tick-histograms",
+    "route-tick-histograms",
+    "fuzz-scenario-scan-full",
+)
+
+# module suffixes feeding each obs-carrying entry — the --changed-only
+# touched-module -> affected-entry-point mapping (satellite: a scoped
+# run only re-proves the entries a changed module can influence; any
+# change under analysis/ re-proves everything).
+ENTRY_SOURCES: Dict[str, Tuple[str, ...]] = {
+    "engine-tick-scan-flight-recorder": (
+        "models/sim/engine.py",
+        "models/sim/flight.py",
+        "models/sim/gating.py",
+        "ops/",
+    ),
+    "engine-tick-scan-histograms": (
+        "models/sim/engine.py",
+        "models/sim/gating.py",
+        "ops/",
+    ),
+    "engine-scalable-tick-wavefront": (
+        "models/sim/engine_scalable.py",
+        "ops/",
+    ),
+    "engine-scalable-tick-histograms": (
+        "models/sim/engine_scalable.py",
+        "ops/",
+    ),
+    "route-tick-histograms": ("models/route/", "ops/"),
+    "fuzz-scenario-scan-full": (
+        "models/sim/engine.py",
+        "models/sim/flight.py",
+        "models/sim/gating.py",
+        "fuzz/executor.py",
+        "ops/",
+    ),
+}
+
+# any touched file here re-proves every entry (the analysis itself or a
+# state registry changed)
+GLOBAL_SOURCES: Tuple[str, ...] = (
+    "analysis/",
+    "models/sim/engine.py",
+    "models/sim/engine_scalable.py",
+    "models/route/plane.py",
+)
+
+
+def entries_for_changed(rel_paths: Iterable[str]) -> List[str]:
+    """Affected entry names for a set of changed package-relative paths
+    (e.g. ``models/sim/flight.py``).  Empty list = prong can be skipped."""
+    rels = list(rel_paths)
+    if any(r.startswith(GLOBAL_SOURCES) for r in rels):
+        return list(OBS_ENTRY_NAMES)
+    out = []
+    for name, sources in ENTRY_SOURCES.items():
+        if any(r.startswith(sources) for r in rels):
+            out.append(name)
+    return out
+
+
+def check_noninterference(
+    entry_names: Optional[Sequence[str]] = None,
+) -> List[Finding]:
+    """The prong: prove non-interference for the registered entries.
+
+    ``entry_names=None`` scans the WHOLE jaxpr registry — entries with
+    no obs input leaves prove vacuously without paying a trace.  A
+    subset (tier-1 cheap gate, --changed-only) names entries explicitly.
+    """
+    from ringpop_tpu.analysis import jaxpr_audit as ja
+
+    by_name = {ep.name: ep for ep in ja.DEFAULT_ENTRIES}
+    names = (
+        list(by_name) if entry_names is None else list(entry_names)
+    )
+    findings: List[Finding] = []
+    for name in names:
+        ep = by_name.get(name)
+        if ep is None:
+            findings.append(
+                Finding(
+                    rule="trace-failure",
+                    path=f"<entry:{name}>",
+                    line=0,
+                    message="unknown entry point",
+                    prong="noninterference",
+                )
+            )
+            continue
+        try:
+            fn, args = ep.build()
+        except Exception as e:
+            findings.append(
+                Finding(
+                    rule="trace-failure",
+                    path=f"<entry:{name}>",
+                    line=0,
+                    message=(
+                        f"entry point setup failed: {type(e).__name__}: {e}"
+                    ),
+                    prong="noninterference",
+                )
+            )
+            continue
+        findings.extend(check_entry(name, fn, args, cache_as=name))
+    return findings

@@ -1,0 +1,143 @@
+"""The jaxgate prong registry — the ONE place a prong is declared.
+
+CLI help, ``--prong all`` expansion, the default prong set,
+``--list-rules`` output and the README "Static analysis" prong table all
+derive from :data:`PRONGS` (tests/analysis/test_prong_registry.py pins
+the README table against it), so they cannot drift from each other.
+
+Adding a prong = adding a :class:`ProngSpec` here plus its runner arm in
+``__main__`` — a registered prong with no runner arm is caught by
+``tests/analysis/test_prong_registry.py`` (a source-level
+dispatch-coverage check), so the divergence cannot reach a merged tree.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, Tuple
+
+__all__ = ["ProngSpec", "PRONGS", "DEFAULT_PRONGS", "ALL_PRONGS"]
+
+
+@dataclasses.dataclass(frozen=True)
+class ProngSpec:
+    name: str
+    summary: str  # one line, shown by --list-rules and pinned in README
+    rules: Tuple[str, ...]  # finding rule ids this prong can emit
+    default: bool  # in the default CLI set (cheap: no entry-point compiles)
+    ci: str  # how tier-1 exercises it
+
+
+PRONGS: Dict[str, ProngSpec] = {
+    spec.name: spec
+    for spec in (
+        ProngSpec(
+            name="ast",
+            summary=(
+                "AST lint over ringpop_tpu/: tick purity, dtype "
+                "discipline, host-sync hygiene, donation aliasing"
+            ),
+            # the concrete rule list lives in astlint.ALL_RULES (it
+            # carries per-rule scope/summary); these are the extras the
+            # lint driver itself can emit
+            rules=("syntax-error", "unreadable-file"),
+            default=True,
+            ci="tests/analysis/test_repo_clean.py::test_ast_prong_repo_clean",
+        ),
+        ProngSpec(
+            name="jaxpr",
+            summary=(
+                "traced-graph audit of every registered entry point: "
+                "callback-free scanned ticks, uint32 hash-taint discipline"
+            ),
+            rules=(
+                "callback-primitive",
+                "wide-dtype-on-hash-path",
+                "trace-failure",
+            ),
+            default=True,
+            ci=(
+                "tests/analysis/test_repo_clean.py::"
+                "test_jaxpr_prong_entry_points_clean"
+            ),
+        ),
+        ProngSpec(
+            name="kernels",
+            summary=(
+                "every pallas kernel under ops/ has a registered twin "
+                "and a live gate test (toolkit.TWIN_REGISTRY)"
+            ),
+            rules=(
+                "unregistered-kernel",
+                "missing-kernel-entry",
+                "missing-twin-entry",
+                "missing-gate-test",
+                "stale-registry-row",
+            ),
+            default=True,
+            ci="tests/analysis/test_kernel_coverage.py",
+        ),
+        ProngSpec(
+            name="noninterference",
+            summary=(
+                "dataflow slice per entry point: no obs-only input leaf "
+                "(flight recorder / histograms / wavefront) reaches a "
+                "trajectory output leaf"
+            ),
+            rules=(
+                "obs-interference",
+                "unclassified-state-field",
+                "trace-failure",
+            ),
+            default=True,
+            ci="tests/analysis/test_noninterference.py",
+        ),
+        ProngSpec(
+            name="donation",
+            summary=(
+                "donating jitted drivers compile to the committed "
+                "input_output_alias surface; dropped donations are "
+                "findings (DONATION_BUDGET.json)"
+            ),
+            rules=(
+                "donation-dropped",
+                "donation-budget",
+                "donation-failure",
+            ),
+            default=False,  # compiles entry points; CI runs the cheap subset
+            ci=(
+                "tests/analysis/test_donation_budget.py + "
+                "scripts/check_donation_budget.py"
+            ),
+        ),
+        ProngSpec(
+            name="retrace",
+            summary=(
+                "fresh-jit cache-count probes vs ANALYSIS_BUDGET.json "
+                "(silent-retrace detector)"
+            ),
+            rules=("retrace-budget", "probe-failure"),
+            default=False,  # compiles entry points; CI runs the cheap subset
+            ci=(
+                "tests/analysis/test_retrace.py + "
+                "scripts/check_retrace_budget.py"
+            ),
+        ),
+        ProngSpec(
+            name="cost",
+            summary=(
+                "XLA static cost/memory analysis of compiled entry "
+                "points vs COST_BUDGET.json (chip-free perf gate)"
+            ),
+            rules=("cost-budget", "cost-failure"),
+            default=False,  # compiles entry points; CI runs the cheap subset
+            ci=(
+                "tests/analysis/test_cost_budget.py + "
+                "scripts/check_cost_budget.py"
+            ),
+        ),
+    )
+}
+
+DEFAULT_PRONGS = tuple(s.name for s in PRONGS.values() if s.default)
+ALL_PRONGS = tuple(PRONGS)

@@ -164,6 +164,33 @@ def _to_instance_major(a):  # jaxgate: host — post-run numpy transpose
     return np.moveaxis(np.asarray(a), 0, 1)
 
 
+# obs-only planes per engine state class — the ISSUE-15 single-source
+# registries (the noninterference analysis prong proves these fields
+# cannot feed the trajectory; the executor drains exactly these)
+_OBS_FIELDS = {
+    "SimState": engine.SIM_OBS_ONLY_FIELDS,
+    "ScalableState": es.SCALABLE_OBS_ONLY_FIELDS,
+}
+
+
+def split_obs(state):
+    """Partition an engine state into (trajectory view, obs planes).
+
+    The obs-plane names come from the single-source field registries
+    next to the state classes, so a renamed/added telemetry field breaks
+    HERE (and in the registry gate) instead of silently vanishing from
+    the drained streams.  The trajectory view has the obs planes set to
+    None — the shape invariant checks compare."""
+    obs_names = _OBS_FIELDS.get(type(state).__name__, frozenset())
+    obs = {
+        f: getattr(state, f)
+        for f in obs_names
+        if getattr(state, f) is not None
+    }
+    traj = state._replace(**{f: None for f in obs_names})
+    return traj, obs
+
+
 def _stack_states(states: Sequence[Any]) -> Any:
     return jax.tree.map(lambda *xs: jnp.stack(xs), *states)
 
@@ -280,9 +307,11 @@ class FullFuzzExecutor(_FuzzExecutorBase):
     def _decode(self, final_state):
         from ringpop_tpu.obs import events as obs_events
 
-        bufs = np.asarray(final_state.ev_buf)
-        heads = np.asarray(final_state.ev_head)
-        drops = np.asarray(final_state.ev_drops)
+        # drained planes named by the single-source obs registry
+        _, obs = split_obs(final_state)
+        bufs = np.asarray(obs["ev_buf"])
+        heads = np.asarray(obs["ev_head"])
+        drops = np.asarray(obs["ev_drops"])
         streams = tuple(
             obs_events.decode_events(bufs[b], heads[b], drops[b])
             for b in range(bufs.shape[0])

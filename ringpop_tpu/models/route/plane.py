@@ -127,6 +127,17 @@ class RouteState(NamedTuple):
     hist: Optional[jax.Array] = None
 
 
+# Single-source field classification (ISSUE 15): trajectory vs obs-only,
+# consumed by the noninterference analysis prong exactly like
+# engine.SIM_TRAJECTORY_FIELDS (see the note there).  The ring
+# representations and the traffic rng ARE trajectory: the route counters
+# the gate-equivalence suites compare bitwise derive from them.  A new
+# RouteState field MUST land in exactly one set (tier-1 gate:
+# tests/analysis/test_state_registry.py).
+ROUTE_OBS_ONLY_FIELDS = frozenset({"hist"})
+ROUTE_TRAJECTORY_FIELDS = frozenset({"ring", "flat_ring", "mask", "rng"})
+
+
 class RouteCarry(NamedTuple):
     """The checkpointed routing-plane carry: everything in
     :class:`RouteState` that is not a pure function of it.  The ring —

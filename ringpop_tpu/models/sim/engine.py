@@ -301,6 +301,48 @@ class SimState(NamedTuple):
     hist: Optional[jax.Array] = None
 
 
+# Single-source field classification (ISSUE 15): every SimState field is
+# either TRAJECTORY (part of the protocol state the gate-equivalence
+# suites compare bitwise) or OBS-ONLY (a write-only telemetry plane that
+# must be invisible to the trajectory).  The noninterference analysis
+# prong (analysis/noninterference.py) proves STATICALLY, per traced
+# entry point, that no obs-only input leaf reaches any trajectory output
+# leaf — the structural form of the property the n=64/n=1k A/B suites
+# sample dynamically.  A new field added to SimState MUST be classified
+# in exactly one of these sets (tier-1 repo-scan gate:
+# tests/analysis/test_state_registry.py).
+SIM_OBS_ONLY_FIELDS = frozenset(
+    {"ev_buf", "ev_head", "ev_drops", "first_heard", "hist"}
+)
+# spelled out (NOT derived as the complement) so that adding a SimState
+# field without deciding its class fails the registry gate loudly
+SIM_TRAJECTORY_FIELDS = frozenset(
+    {
+        "tick_index",
+        "proc_alive",
+        "ready",
+        "gossip_on",
+        "partition",
+        "known",
+        "status",
+        "inc",
+        "ch_active",
+        "ch_status",
+        "ch_inc",
+        "ch_source",
+        "ch_source_inc",
+        "ch_pb",
+        "susp_deadline",
+        "perm_inv",
+        "iter_pos",
+        "rng",
+        "checksum",
+        "rec_bytes",
+        "rec_len",
+    }
+)
+
+
 class TickInputs(NamedTuple):
     """Per-tick event-schedule inputs (the fault-injection plane)."""
 

@@ -236,6 +236,35 @@ class ScalableState(NamedTuple):
     hist: Optional[jax.Array] = None
 
 
+# Single-source field classification (ISSUE 15): trajectory vs obs-only,
+# consumed by the noninterference analysis prong exactly like
+# engine.SIM_TRAJECTORY_FIELDS / SIM_OBS_ONLY_FIELDS (see the note
+# there).  A new ScalableState field MUST land in exactly one set
+# (tier-1 gate: tests/analysis/test_state_registry.py).
+SCALABLE_OBS_ONLY_FIELDS = frozenset({"first_heard", "hist"})
+SCALABLE_TRAJECTORY_FIELDS = frozenset(
+    {
+        "tick_index",
+        "proc_alive",
+        "gossip_on",
+        "partition",
+        "truth_status",
+        "truth_inc",
+        "r_active",
+        "r_delta",
+        "r_birth",
+        "heard",
+        "susp_subject",
+        "susp_since",
+        "defame_slot",
+        "defame_by",
+        "base_sum",
+        "rng",
+        "checksum",
+    }
+)
+
+
 # ScalableState fields indexed by NODE along axis 0 — the single source
 # for the mesh's P("nodes") shardings (parallel/mesh.py) and the sharded
 # checkpoint split (models/sim/recovery.py).  Decided by NAME, not shape:

@@ -113,7 +113,15 @@ def donate_state_argnums() -> tuple:
     tests/models/test_recovery.py's cadence tests, which flake within
     minutes if donation is re-enabled under the cache).  A host-RAM
     copy per tick is noise at CPU test/bench scales, so correctness
-    wins; TPU keeps the in-place path."""
+    wins; TPU keeps the in-place path.
+
+    Since round 17 the whole donation surface is statically pinned:
+    every driver jitting with this policy is compiled by the `donation`
+    analysis prong and its input_output_alias map diffed against the
+    committed DONATION_BUDGET.json (this gate shows up there as data —
+    empty alias maps on CPU), and astlint's stale-ref-across-donation
+    rule catches live bindings held across these dispatches.  README
+    "Donation hazards" is the one write-up."""
     import jax as _jax
 
     return () if _jax.default_backend() == "cpu" else (0,)

@@ -28,6 +28,7 @@ are untouched, so other instances keep their unwrapped handles.
 
 from __future__ import annotations
 
+import contextlib
 import time
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
@@ -37,6 +38,20 @@ from ringpop_tpu.obs.histograms import (
     DEFAULT_QS,
     compute_protocol_delay,
 )
+
+
+@contextlib.contextmanager
+def stopwatch(sink: Dict[str, float], key: str):
+    """Accumulate the wall time of a ``with`` block into ``sink[key]``.
+
+    The host-side sibling of :class:`DispatchTimer` for code that is not
+    a dispatch (the analysis CLI's per-prong wall clocks, host phases of
+    bench plumbing): seconds, monotonic, additive across re-entries."""
+    t0 = time.perf_counter()
+    try:
+        yield
+    finally:
+        sink[key] = sink.get(key, 0.0) + (time.perf_counter() - t0)
 
 
 def fence(value: Any) -> Any:

@@ -639,7 +639,8 @@ def _storm_tick_fn(params, mesh: Mesh, structure_key, plane_key=None):
         out_shardings=(st_sh, _storm_metrics_shardings(mesh)),
         # the round-10 in-place heard-mask update, kept intact under the
         # collective plane (backend-gated: CPU stays copy-safe — see
-        # storm.donate_state_argnums)
+        # storm.donate_state_argnums; alias surface pinned as the
+        # donation prong's mesh-storm-tick entry, DONATION_BUDGET.json)
         donate_argnums=donate_state_argnums(),
     )
 

@@ -1,0 +1,80 @@
+#!/usr/bin/env python
+"""Diff the donation/aliasing surface of the donating jitted drivers
+against DONATION_BUDGET.json — the static half of the PR-7/PR-8
+donation-hazard defenses.
+
+Compiles every donating driver (storm tick/scan, routed tick/scan, the
+sharded storm tick) at toy shapes and compares the executables'
+``input_output_alias`` maps to the committed manifest (see
+ringpop_tpu/analysis/donation.py).  A donated leaf no output aliases is
+a silently dropped donation and ALWAYS a finding; the CPU manifest pins
+the PR-8 donation-off backend gate as expected-empty alias maps.
+
+Usage::
+
+    python scripts/check_donation_budget.py            # diff, exit 1 on drift
+    python scripts/check_donation_budget.py --write    # regenerate manifest
+    python scripts/check_donation_budget.py --entries scalable-tick,routed-tick
+
+``--write`` REFUSES to commit a manifest containing entries that failed
+to compile or that drop donations — a broken or lossy donation surface
+is a finding, not a budget.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parents[1]))
+
+from ringpop_tpu.analysis import donation  # noqa: E402
+from ringpop_tpu.analysis.findings import render_text  # noqa: E402
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument(
+        "--write",
+        action="store_true",
+        help="compile the donating drivers and (re)write DONATION_BUDGET.json",
+    )
+    parser.add_argument(
+        "--budget",
+        default=None,
+        help="manifest path (default: DONATION_BUDGET.json at repo root)",
+    )
+    parser.add_argument(
+        "--entries",
+        default=None,
+        help="comma-separated entry-name subset (diff mode only)",
+    )
+    args = parser.parse_args(argv)
+    path = Path(args.budget) if args.budget else None
+    names = (
+        [n.strip() for n in args.entries.split(",") if n.strip()]
+        if args.entries
+        else None
+    )
+
+    if args.write:
+        if names is not None:
+            parser.error("--write regenerates the FULL manifest; drop --entries")
+        actual = donation.collect()
+        out = donation.write_manifest(actual, path)
+        donated = sum(e.get("donated_params", 0) for e in actual.values())
+        aliased = sum(e.get("aliased_params", 0) for e in actual.values())
+        print(
+            "wrote %s (%d entries, %d donated / %d aliased params)"
+            % (out, len(actual), donated, aliased)
+        )
+        return 0
+
+    findings = donation.check_against_manifest(entry_names=names, path=path)
+    print(render_text(findings))
+    return 1 if findings else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,56 @@
+"""BAD: bare device-state bindings read after a donating dispatch."""
+import functools
+
+import jax
+
+
+@functools.lru_cache(maxsize=None)
+def _tick_fn(params):
+    return jax.jit(lambda st, inp: st, donate_argnums=(0,))
+
+
+@functools.lru_cache(maxsize=None)
+def _routed_fns(params):
+    f = jax.jit(lambda c, inp: c, donate_argnums=(0,))
+    return f, f
+
+
+class Cluster:
+    def __init__(self, params):
+        self.params = params
+        self.state = None
+        self._tick = _tick_fn(params)
+
+    def step(self, inputs):
+        pre = self.state  # bare alias of the donated carry
+        self.state = self._tick(pre, inputs)
+        return pre.checksum  # stale read: pre's buffers were donated
+
+    def step_then_resnapshot(self, inputs):
+        pre = self.state
+        self.state = self._tick(pre, inputs)
+        out = pre.checksum  # stale read — a LATER re-snapshot is no alibi
+        pre = self.state
+        return out, pre
+
+    def step_via_attr(self, inputs):
+        snap = self.state
+        # the carry is dispatched through the ATTRIBUTE, not the alias —
+        # snap still aliases the same donated buffers
+        self.state = self._tick(self.state, inputs)
+        return snap
+
+
+class Routed:
+    def __init__(self, params):
+        self.state = None
+        self.rstate = None
+        # tuple unpacking from a donating factory
+        self._tick, self._scanned = _routed_fns(params)
+
+    def window(self, inputs):
+        rpre = self.rstate
+        (self.state, self.rstate), m = self._tick(
+            (self.state, rpre), inputs
+        )
+        return m, rpre  # stale read of the routed half of the carry

@@ -1,0 +1,51 @@
+"""GOOD: snapshots host-copied (or re-bound) before/after the dispatch."""
+import functools
+
+import jax
+
+
+@functools.lru_cache(maxsize=None)
+def _tick_fn(params):
+    return jax.jit(lambda st, inp: st, donate_argnums=(0,))
+
+
+def _plain_fn(params):
+    return jax.jit(lambda st, inp: st)  # no donation: aliases stay live
+
+
+class Cluster:
+    def __init__(self, params):
+        self.params = params
+        self.state = None
+        self._tick = _tick_fn(params)
+
+    def step(self, inputs):
+        # sanctioned: the snapshot is a HOST copy, not a device alias
+        pre = jax.device_get(self.state)
+        self.state = self._tick(self.state, inputs)
+        return pre
+
+    def step_rebound(self, inputs):
+        pre = self.state
+        self.state = self._tick(pre, inputs)
+        pre = self.state  # re-snapshot after the dispatch
+        return pre
+
+    def step_before(self, inputs):
+        pre = self.state
+        out = pre.checksum  # read BEFORE the dispatch: buffers still live
+        self.state = self._tick(pre, inputs)
+        return out
+
+
+class NonDonating:
+    def __init__(self, params):
+        self.state = None
+        self._tick = _plain_fn(params)
+
+    def step(self, inputs):
+        # bounded-parity replay pattern (SimCluster): legal because this
+        # driver's tick does NOT donate
+        pre = self.state
+        self.state = self._tick(pre, inputs)
+        return pre

@@ -1,0 +1,239 @@
+"""Unit coverage for the jaxpr dataflow slicer (analysis/dataflow.py).
+
+Small synthetic programs with KNOWN flows: the slicer must see exactly
+the edges that exist — through scan carries (including flows that only
+appear after one loop iteration), cond branches and predicates, while
+bodies — and must NOT invent edges between independent dataflows (a
+spurious edge here would make the noninterference prong cry wolf on
+every obs plane in the repo).
+"""
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from ringpop_tpu.analysis import dataflow
+
+
+def _reach(fn, args, seeds):
+    closed = jax.make_jaxpr(fn)(*args)
+    return dataflow.slice_reachability(closed, seeds)
+
+
+def _labels(reach):
+    return [frozenset(r) for r in reach]
+
+
+class TestPlainFlows:
+    def test_independent_args_stay_separate(self):
+        def fn(a, b):
+            return a + 1, b * 2
+
+        reach = _reach(fn, (jnp.ones(3), jnp.ones(3)), ["A", "B"])
+        assert _labels(reach) == [frozenset({"A"}), frozenset({"B"})]
+
+    def test_mixing_eqn_merges_labels(self):
+        def fn(a, b):
+            return a + b
+
+        reach = _reach(fn, (jnp.ones(3), jnp.ones(3)), ["A", "B"])
+        assert _labels(reach) == [frozenset({"A", "B"})]
+
+    def test_unseeded_inputs_are_invisible(self):
+        def fn(a, b):
+            return a + b, b
+
+        reach = _reach(fn, (jnp.ones(3), jnp.ones(3)), ["A", None])
+        assert _labels(reach) == [frozenset({"A"}), frozenset()]
+
+    def test_witness_chain_names_the_eqns(self):
+        def fn(a):
+            return (a * 2 + 1).sum()
+
+        reach = _reach(fn, (jnp.ones(3),), ["A"])
+        chain = dataflow.witness_chain(reach[0]["A"])
+        assert "<input>" in chain
+        assert "mul" in chain and "add" in chain and "reduce_sum" in chain
+
+    def test_witness_chain_truncates_long_flows(self):
+        def fn(a):
+            for _ in range(40):
+                a = a + 1
+            return a
+
+        reach = _reach(fn, (jnp.ones(3),), ["A"])
+        chain = dataflow.witness_chain(reach[0]["A"], limit=8)
+        assert "eqns) ..." in chain
+        assert chain.count("->") <= 10
+
+
+class TestScan:
+    def test_carry_positions_stay_separate(self):
+        # two independent carry lanes: taint must not jump lanes
+        def fn(a, b, xs):
+            def body(c, x):
+                ca, cb = c
+                return (ca + x, cb * 2), ca.sum()
+
+            return jax.lax.scan(body, (a, b), xs)
+
+        reach = _reach(
+            fn,
+            (jnp.ones(3), jnp.ones(3), jnp.ones((4, 3))),
+            ["A", "B", None],
+        )
+        labels = _labels(reach)
+        assert labels[0] == frozenset({"A"})  # final carry a
+        assert labels[1] == frozenset({"B"})  # final carry b
+        assert labels[2] == frozenset({"A"})  # ys from ca only
+
+    def test_cross_iteration_flow_needs_the_fixpoint(self):
+        # lane swap each iteration: A reaches BOTH final carries only
+        # via the second iteration — a single body pass cannot see it
+        def fn(a, b, xs):
+            def body(c, x):
+                ca, cb = c
+                return (cb, ca + x), x.sum()
+
+            return jax.lax.scan(body, (a, b), xs)
+
+        reach = _reach(
+            fn,
+            (jnp.ones(3), jnp.ones(3), jnp.ones((4, 3))),
+            ["A", "B", None],
+        )
+        labels = _labels(reach)
+        assert labels[0] == frozenset({"A", "B"})
+        assert labels[1] == frozenset({"A", "B"})
+
+    def test_xs_reach_carry_and_ys(self):
+        def fn(c0, xs):
+            def body(c, x):
+                return c + x, c
+
+            return jax.lax.scan(body, c0, xs)
+
+        reach = _reach(fn, (jnp.ones(3), jnp.ones((4, 3))), ["C", "X"])
+        labels = _labels(reach)
+        assert labels[0] == frozenset({"C", "X"})
+        # ys emit the PRE-update carry, which from iteration 2 on holds
+        # xs taint — the fixpoint must surface it
+        assert labels[1] == frozenset({"C", "X"})
+
+
+class TestCondAndWhile:
+    def test_cond_branches_map_positionally(self):
+        def fn(p, a, b):
+            return jax.lax.cond(
+                p, lambda x, y: (x + 1, y), lambda x, y: (x, y * 2), a, b
+            )
+
+        reach = _reach(
+            fn, (jnp.bool_(True), jnp.ones(3), jnp.ones(3)), [None, "A", "B"]
+        )
+        labels = _labels(reach)
+        assert labels[0] == frozenset({"A"})
+        assert labels[1] == frozenset({"B"})
+
+    def test_tainted_predicate_reaches_every_output(self):
+        # control dependence: a value that picks the branch steers both
+        # outputs even without a data edge
+        def fn(p, a, b):
+            return jax.lax.cond(
+                p, lambda x, y: (x + 1, y), lambda x, y: (x, y * 2), a, b
+            )
+
+        reach = _reach(
+            fn, (jnp.bool_(True), jnp.ones(3), jnp.ones(3)), ["P", None, None]
+        )
+        labels = _labels(reach)
+        assert labels[0] == frozenset({"P"})
+        assert labels[1] == frozenset({"P"})
+
+    def test_zero_iteration_while_returns_its_initial_carry(self):
+        # the body OVERWRITES the tainted slot — but a while that never
+        # runs returns the initial carry, so the taint must still be
+        # reported on the output (review round: soundness hole)
+        def fn(n, a):
+            def cond(c):
+                return c[0] < n
+
+            def body(c):
+                return c[0] + 1, jnp.zeros_like(c[1])
+
+            return jax.lax.while_loop(cond, body, (jnp.int32(0), a))
+
+        reach = _reach(fn, (jnp.int32(0), jnp.ones(3)), [None, "A"])
+        assert "A" in reach[1]
+
+    def test_late_carry_taint_reaches_the_loop_condition(self):
+        # taint enters the cond-read slot only AFTER one iteration
+        # (b -> a via the body); the condition then steers every carry,
+        # so B must spill to the untainted lane too (review round:
+        # control sub must be walked AFTER the body fixpoint)
+        def fn(a, b, z):
+            def cond(c):
+                return c[0].sum() < 10.0
+
+            def body(c):
+                ca, cb, cz = c
+                return cb, cb, cz + 1.0
+
+            return jax.lax.while_loop(cond, body, (a, b, z))
+
+        reach = _reach(
+            fn,
+            (jnp.ones(3), jnp.ones(3), jnp.ones(3)),
+            [None, "B", None],
+        )
+        assert "B" in reach[2]  # via the condition, not a data edge
+
+    def test_while_carry_lanes_and_condition(self):
+        def fn(n, a, b):
+            def cond(c):
+                return c[0] < n
+
+            def body(c):
+                i, x, y = c
+                return i + 1, x + 1.0, y
+
+            return jax.lax.while_loop(cond, body, (jnp.int32(0), a, b))
+
+        reach = _reach(
+            fn, (jnp.int32(5), jnp.ones(3), jnp.ones(3)), ["N", "A", "B"]
+        )
+        labels = _labels(reach)
+        # N steers the loop condition -> reaches every carry out; the
+        # x/y lanes otherwise stay separate
+        assert labels[1] == frozenset({"A", "N"})
+        assert labels[2] == frozenset({"B", "N"})
+
+
+class TestSliceApi:
+    def test_seed_arity_mismatch_raises(self):
+        closed = jax.make_jaxpr(lambda a, b: a + b)(
+            jnp.ones(3), jnp.ones(3)
+        )
+        with pytest.raises(ValueError, match="seed_labels"):
+            dataflow.slice_reachability(closed, ["A"])
+
+    def test_audit_and_precise_sub_jaxprs_share_one_table(self):
+        # the historical (audit) table and the precise table come from
+        # ONE function — while is conservative there, mapped here
+        def fn(n, a):
+            return jax.lax.while_loop(
+                lambda c: c[0] < n, lambda c: (c[0] + 1, c[1]), (n, a)
+            )
+
+        closed = jax.make_jaxpr(fn)(jnp.int32(3), jnp.ones(2))
+        (eqn,) = [
+            e for e in closed.jaxpr.eqns if e.primitive.name == "while"
+        ]
+        audit = dataflow.sub_jaxprs(eqn, precise=False)
+        precise = dataflow.sub_jaxprs(eqn, precise=True)
+        assert [s.label for s in audit] == ["while_cond", "while_body"]
+        assert [s.label for s in precise] == ["while_cond", "while_body"]
+        assert all(s.in_map is None for s in audit)
+        assert all(s.in_map is not None for s in precise)
+        assert precise[1].carry_feedback  # body carries feed back
+        assert precise[0].control  # the condition steers control

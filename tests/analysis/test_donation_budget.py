@@ -1,0 +1,166 @@
+"""The donation prong: cheap-probe tier-1 gate + mutation proofs.
+
+Tier-1 wiring mirrors the cost gate: a cheap subset of the donating
+drivers is compiled (seconds warm under the persistent XLA cache) and
+diffed against the committed DONATION_BUDGET.json slice.  The PR-8 CPU
+backend gate is VISIBLE manifest data here: ``donate_argnums`` is []
+and every entry's alias map is empty on the CPU backend — the checker
+has no backend special case.
+
+Mutation proofs: a deliberately shape-mismatched donation is a
+``donation-dropped`` finding; a doctored manifest makes the script exit
+non-zero; ``--write`` refuses failed compiles AND dropped donations.
+"""
+
+import json
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from ringpop_tpu.analysis import donation
+from ringpop_tpu.analysis.findings import render_text
+
+pytestmark = pytest.mark.skipif(
+    jax.default_backend()
+    != donation.load_manifest().get("backend", "cpu"),
+    reason="manifest banked on a different backend",
+)
+
+
+def test_cheap_probe_subset_matches_committed_manifest():
+    findings = donation.check_against_manifest(
+        entry_names=donation.CHEAP_ENTRIES
+    )
+    assert findings == [], "\n" + render_text(findings)
+
+
+def test_manifest_pins_the_cpu_donation_off_gate():
+    """On CPU the PR-8 gate (storm.donate_state_argnums() == ()) must be
+    recorded as data: no donated params, empty alias maps."""
+    manifest = donation.load_manifest()
+    if manifest["backend"] != "cpu":
+        pytest.skip("CPU-manifest shape check")
+    assert manifest["donate_argnums"] == []
+    for name, entry in manifest["entries"].items():
+        assert entry["donated_params"] == 0, name
+        assert entry["aliases"] == [], name
+    # every registered donating driver is in the manifest
+    assert set(manifest["entries"]) == {
+        e.name for e in donation.DEFAULT_ENTRIES
+    }
+
+
+# -- mutation proofs --------------------------------------------------------
+
+
+def _dropping_jit():
+    # donated [8] f32 input, but the only output is a scalar — no
+    # output matches, so XLA cannot alias and the donation is dropped
+    return jax.jit(lambda x: x[:2].sum(), donate_argnums=(0,))
+
+
+def test_shape_mismatched_donation_is_a_dropped_finding(recwarn):
+    rec = donation.audit_jit(
+        _dropping_jit(), (jnp.zeros((8,), jnp.float32),), (0,)
+    )
+    assert rec["donated_params"] == 1 and rec["aliased_params"] == 0
+    assert rec["dropped"] == [
+        {"param": 0, "shape": [8], "dtype": "float32"}
+    ]
+    findings = donation.compare_to_manifest(
+        {"m": rec}, {"entries": {"m": rec}}
+    )
+    assert [f.rule for f in findings] == ["donation-dropped"]
+    assert "float32[8]" in findings[0].message
+    assert "silently dropped" in findings[0].message
+
+
+def test_matching_donation_aliases_and_is_clean(recwarn):
+    jf = jax.jit(lambda x, y: (x + 1, y.sum()), donate_argnums=(0,))
+    rec = donation.audit_jit(
+        jf, (jnp.zeros((4,), jnp.uint32), jnp.ones(3)), (0,)
+    )
+    assert rec["aliases"] == ["out{0} <- param 0"]
+    assert rec["dropped"] == []
+    findings = donation.compare_to_manifest(
+        {"m": rec}, {"entries": {"m": rec}}
+    )
+    assert findings == []
+
+
+def test_doctored_manifest_drifts(tmp_path):
+    manifest = donation.load_manifest()
+    doc = json.loads(json.dumps(manifest))  # deep copy
+    name = donation.CHEAP_ENTRIES[0]
+    doc["entries"][name]["aliases"] = ["out{0} <- param 0"]
+    doc["entries"][name]["aliased_params"] = 1
+    p = tmp_path / "DONATION_BUDGET.json"
+    p.write_text(json.dumps(doc))
+    findings = donation.check_against_manifest(
+        entry_names=[name], path=p
+    )
+    assert any(f.rule == "donation-budget" for f in findings)
+
+
+def test_doctored_manifest_script_exits_nonzero(tmp_path, capsys):
+    import importlib.util
+    from pathlib import Path
+
+    spec = importlib.util.spec_from_file_location(
+        "check_donation_budget",
+        Path(__file__).resolve().parents[2]
+        / "scripts"
+        / "check_donation_budget.py",
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+
+    manifest = donation.load_manifest()
+    doc = json.loads(json.dumps(manifest))
+    name = donation.CHEAP_ENTRIES[0]
+    doc["entries"][name]["donated_params"] = 99
+    p = tmp_path / "DONATION_BUDGET.json"
+    p.write_text(json.dumps(doc))
+    rc = mod.main(
+        ["--budget", str(p), "--entries", name]
+    )
+    assert rc == 1
+    assert "donation-budget" in capsys.readouterr().out
+
+
+def test_write_refuses_failures_and_drops(tmp_path, recwarn):
+    with pytest.raises(ValueError, match="failed entries"):
+        donation.write_manifest(
+            {"broken": {"error": "boom"}}, tmp_path / "d.json"
+        )
+    rec = donation.audit_jit(
+        _dropping_jit(), (jnp.zeros((8,), jnp.float32),), (0,)
+    )
+    with pytest.raises(ValueError, match="dropped donations"):
+        donation.write_manifest({"m": rec}, tmp_path / "d.json")
+
+
+def test_backend_mismatch_is_loud_not_a_silent_pass(tmp_path):
+    """A TPU session running against the CPU manifest (the one case
+    where donation is LIVE) must fail with a bank-your-own message, not
+    exit green with nothing compiled."""
+    doc = json.loads(json.dumps(donation.load_manifest()))
+    doc["backend"] = "definitely-not-this-backend"
+    p = tmp_path / "DONATION_BUDGET.json"
+    p.write_text(json.dumps(doc))
+    findings = donation.check_against_manifest(path=p)
+    assert len(findings) == 1
+    assert findings[0].rule == "donation-budget"
+    assert "banked on backend" in findings[0].message
+    assert "--write" in findings[0].message
+
+
+def test_unknown_entry_and_missing_manifest_are_findings(tmp_path):
+    out = donation.collect(["no-such-entry"])
+    assert out["no-such-entry"]["error"] == "unknown donation entry"
+    findings = donation.check_against_manifest(
+        path=tmp_path / "missing.json"
+    )
+    assert [f.rule for f in findings] == ["donation-budget"]
+    assert "manifest missing" in findings[0].message

@@ -1,0 +1,166 @@
+"""The noninterference prong: repo-clean gate + mutation proofs.
+
+The gate proves, for every obs-carrying entry point, that no obs-only
+input leaf (flight recorder / histograms / wavefront) reaches a
+trajectory output leaf — the static form of the gate-equivalence
+property the n=64/n=1k A/B suites sample.  The mutation tests prove the
+prong CAN fail: a seeded obs->trajectory edge (the ISSUE-15 example — a
+histogram count folded into a suspicion deadline) and an unclassified
+state field each produce a named finding.
+"""
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from ringpop_tpu.analysis import jaxpr_audit as ja
+from ringpop_tpu.analysis import noninterference as ni
+from ringpop_tpu.analysis.findings import render_text
+
+BY_NAME = {ep.name: ep for ep in ja.DEFAULT_ENTRIES}
+
+
+# -- repo-clean gate --------------------------------------------------------
+
+
+@pytest.mark.parametrize("name", ni.OBS_ENTRY_NAMES)
+def test_obs_entry_is_noninterfering(name):
+    fn, args = BY_NAME[name].build()
+    findings = ni.check_entry(name, fn, args)
+    assert findings == [], "\n" + render_text(findings)
+
+
+def test_every_obs_carrying_entry_is_in_the_cheap_subset():
+    """OBS_ENTRY_NAMES must stay exhaustive: an entry whose inputs carry
+    obs-only leaves but which is missing from the subset would make the
+    tier-1 gate silently partial."""
+    regs = ni.state_registries()
+    for ep in ja.DEFAULT_ENTRIES:
+        fn, args = ep.build()
+        labels = ni._flatten_labels(ni.label_tree(tuple(args), regs, "args"))
+        has_obs = any(lab.kind == ni.KIND_OBS for lab in labels)
+        assert has_obs == (ep.name in ni.OBS_ENTRY_NAMES), (
+            f"{ep.name}: obs leaves={has_obs} but cheap-subset membership "
+            f"={ep.name in ni.OBS_ENTRY_NAMES} — update "
+            "noninterference.OBS_ENTRY_NAMES (and ENTRY_SOURCES)"
+        )
+
+
+# -- mutation proofs --------------------------------------------------------
+
+
+def test_seeded_obs_to_trajectory_leak_is_caught():
+    """The ISSUE-15 acceptance mutation: a histogram count folded into a
+    suspicion deadline must fail with a named, eqn-located finding."""
+    fn, args = BY_NAME["engine-tick-scan-histograms"].build()
+
+    def doctored(state, inputs):
+        st, metrics = fn(state, inputs)
+        return st._replace(
+            susp_deadline=st.susp_deadline
+            + st.hist[0, 0].astype(jnp.int32)
+        ), metrics
+
+    findings = ni.check_entry("doctored", doctored, args)
+    assert any(f.rule == "obs-interference" for f in findings)
+    msg = next(
+        f.message for f in findings if f.rule == "obs-interference"
+    )
+    assert "SimState.hist" in msg
+    assert "SimState.susp_deadline" in msg
+    assert "eqn chain:" in msg and "add@" in msg
+
+
+def test_flight_recorder_leak_is_caught():
+    """Same proof on the flight-recorder plane: the event head count
+    steering the rng chain is an interference."""
+    fn, args = BY_NAME["engine-tick-scan-flight-recorder"].build()
+
+    def doctored(state, inputs):
+        st, metrics = fn(state, inputs)
+        return st._replace(
+            iter_pos=st.iter_pos + st.ev_head.astype(jnp.int32)
+        ), metrics
+
+    findings = ni.check_entry("doctored-flight", doctored, args)
+    assert any(
+        f.rule == "obs-interference"
+        and "SimState.ev_head" in f.message
+        and "SimState.iter_pos" in f.message
+        for f in findings
+    ), "\n" + render_text(findings)
+
+
+def test_obs_to_obs_and_obs_to_metrics_flows_are_allowed():
+    """Obs planes legitimately read themselves (append offsets) — only
+    trajectory outputs are protected; metrics are observability sinks."""
+    fn, args = BY_NAME["engine-tick-scan-histograms"].build()
+
+    def doctored(state, inputs):
+        st, metrics = fn(state, inputs)
+        # obs -> obs: fine
+        st = st._replace(hist=st.hist + jnp.uint32(1))
+        # obs -> metrics: fine (metrics are obs sinks by classification)
+        metrics = metrics._replace(
+            dirty_rows=metrics.dirty_rows
+            + st.hist[0, 0].astype(jnp.int32)
+        )
+        return st, metrics
+
+    findings = ni.check_entry("doctored-ok", doctored, args)
+    assert findings == [], "\n" + render_text(findings)
+
+
+def test_unclassified_state_field_is_a_finding():
+    regs = ni.state_registries()
+    traj, obs = regs["SimState"]
+    doctored = dict(regs)
+    doctored["SimState"] = (traj - {"checksum"}, obs)
+
+    from ringpop_tpu.models.sim import engine
+
+    params = engine.SimParams(n=4, hash_impl="scan")
+    params = engine.resolve_auto_parity(params, jax.default_backend())
+    state = engine.init_state(
+        params, seed=0, universe=ja._toy_universe(4)
+    )
+    labels = ni._flatten_labels(
+        ni.label_tree((state,), doctored, "args")
+    )
+    assert any(lab.kind == ni.KIND_UNCLASSIFIED for lab in labels)
+    # and through the public checker (monkeypatch-free: a local registry
+    # copy exercised via label_tree is the same code path check_entry
+    # walks; the finding text points at the fix)
+    import unittest.mock as mock
+
+    with mock.patch.object(ni, "state_registries", lambda: doctored):
+        findings = ni.check_noninterference(
+            ["engine-tick-scan-histograms"]
+        )
+    assert any(
+        f.rule == "unclassified-state-field"
+        and "SimState.checksum" in f.message
+        and "SIM_TRAJECTORY_FIELDS" in f.message
+        for f in findings
+    ), "\n" + render_text(findings)
+
+
+# -- changed-only mapping ---------------------------------------------------
+
+
+def test_entries_for_changed_maps_modules_to_entries():
+    assert ni.entries_for_changed(["models/route/plane.py"]) == list(
+        ni.OBS_ENTRY_NAMES
+    )  # a state-registry module re-proves everything
+    assert ni.entries_for_changed(["models/sim/flight.py"]) == [
+        "engine-tick-scan-flight-recorder",
+        "fuzz-scenario-scan-full",
+    ]
+    assert ni.entries_for_changed(["fuzz/executor.py"]) == [
+        "fuzz-scenario-scan-full"
+    ]
+    assert ni.entries_for_changed(["obs/recorder.py"]) == []
+    # any analysis/ change re-proves everything
+    assert ni.entries_for_changed(["analysis/dataflow.py"]) == list(
+        ni.OBS_ENTRY_NAMES
+    )

@@ -1,0 +1,109 @@
+"""The prong registry is the single source (ISSUE 15 satellite): CLI
+help, ``--prong all``, ``--list-rules`` and the README prong table all
+derive from ``analysis/prongs.py`` and cannot drift."""
+
+import json
+import re
+from pathlib import Path
+
+from ringpop_tpu.analysis.prongs import ALL_PRONGS, DEFAULT_PRONGS, PRONGS
+
+REPO_ROOT = Path(__file__).resolve().parents[2]
+
+
+def test_registry_shape():
+    assert set(DEFAULT_PRONGS) <= set(ALL_PRONGS)
+    # cheap-by-default contract: the prongs that compile entry points
+    # are opt-in
+    assert set(ALL_PRONGS) - set(DEFAULT_PRONGS) == {
+        "retrace",
+        "cost",
+        "donation",
+    }
+    for spec in PRONGS.values():
+        assert spec.rules, spec.name
+        assert spec.summary and spec.ci
+
+
+def test_cli_dispatch_covers_every_registered_prong():
+    """__main__ must have a runner arm for each registry entry — a prong
+    declared but never dispatched would silently no-op."""
+    src = (
+        REPO_ROOT / "ringpop_tpu" / "analysis" / "__main__.py"
+    ).read_text()
+    for name in ALL_PRONGS:
+        assert f'"{name}" in prongs' in src, (
+            f"prong {name!r} is registered but __main__ never runs it"
+        )
+
+
+def test_list_rules_prints_every_prong_and_rule(capsys):
+    from ringpop_tpu.analysis.__main__ import main
+
+    assert main(["--list-rules"]) == 0
+    out = capsys.readouterr().out
+    for spec in PRONGS.values():
+        assert f"{spec.name} prong" in out
+        for rule in spec.rules:
+            assert rule in out
+
+
+def test_every_registered_prong_name_is_accepted(monkeypatch, capsys):
+    """Each registry name parses; scoped to an empty diff so the slow
+    prongs do no real work (their scoping gates skip them)."""
+    from ringpop_tpu.analysis import __main__ as cli
+
+    monkeypatch.setattr(cli, "_changed_files", lambda: [])
+    for name in ALL_PRONGS:
+        if name in ("retrace", "cost"):
+            continue  # no --changed-only gate (their scripts scope them)
+        assert (
+            cli.main(["--prong", name, "--changed-only"]) == 0
+        ), name
+        capsys.readouterr()
+
+
+def test_readme_prong_table_matches_registry():
+    """The README table rows carry each prong's name and its EXACT
+    registry summary — edit analysis/prongs.py and README together."""
+    readme = (REPO_ROOT / "README.md").read_text()
+    rows = {
+        m.group(1): m.group(2).strip()
+        for m in re.finditer(
+            r"^\| `([a-z]+)` \| (?:yes|opt-in) \| (.+) \|$",
+            readme,
+            re.M,
+        )
+    }
+    assert set(rows) == set(ALL_PRONGS), (
+        "README prong table rows != registry: "
+        f"{sorted(set(rows) ^ set(ALL_PRONGS))}"
+    )
+    for name, spec in PRONGS.items():
+        assert rows[name] == spec.summary, (
+            f"README summary for {name!r} drifted from "
+            "analysis/prongs.py — update them together"
+        )
+    # default/opt-in column tracks the registry too
+    for name, spec in PRONGS.items():
+        flag = "yes" if spec.default else "opt-in"
+        assert f"| `{name}` | {flag} |" in readme
+
+
+def test_json_output_records_per_prong_wall_time(capsys):
+    from ringpop_tpu.analysis.__main__ import main
+
+    rc = main(
+        [
+            "--prong",
+            "ast",
+            "--format",
+            "json",
+            "ringpop_tpu/analysis/findings.py",
+        ]
+    )
+    doc = json.loads(capsys.readouterr().out)
+    assert rc == 0
+    assert "prong_seconds" in doc
+    assert set(doc["prong_seconds"]) == {"ast"}
+    assert doc["prong_seconds"]["ast"] >= 0

@@ -22,6 +22,10 @@ CASES = {
     "block-until-ready": ("block_until_ready", "ringpop_tpu/api/fx.py"),
     "callback-in-device": ("callback_in_device", "ringpop_tpu/ops/fx.py"),
     "assert-on-traced": ("assert_on_traced", "ringpop_tpu/models/sim/fx.py"),
+    "stale-ref-across-donation": (
+        "stale_ref_across_donation",
+        "ringpop_tpu/models/sim/fx.py",
+    ),
 }
 
 EXPECTED_BAD_COUNTS = {
@@ -33,6 +37,7 @@ EXPECTED_BAD_COUNTS = {
     "block-until-ready": 1,
     "callback-in-device": 2,
     "assert-on-traced": 1,
+    "stale-ref-across-donation": 4,
 }
 
 

@@ -1,0 +1,90 @@
+"""Registry gate (ISSUE 15 satellite): every engine-state field is
+classified exactly once as trajectory or obs-only.
+
+A new field added to SimState/ScalableState/RouteState without a
+classification fails HERE with a how-to-fix message — which is what
+keeps the noninterference prong's proof meaningful (an unclassified
+field would otherwise be invisible to it until trace time).
+"""
+
+import pytest
+
+from ringpop_tpu.models.route import plane
+from ringpop_tpu.models.sim import engine, engine_scalable as es
+
+REGISTRIES = [
+    (
+        engine.SimState,
+        engine.SIM_TRAJECTORY_FIELDS,
+        engine.SIM_OBS_ONLY_FIELDS,
+        "models/sim/engine.py (SIM_TRAJECTORY_FIELDS / SIM_OBS_ONLY_FIELDS)",
+    ),
+    (
+        es.ScalableState,
+        es.SCALABLE_TRAJECTORY_FIELDS,
+        es.SCALABLE_OBS_ONLY_FIELDS,
+        "models/sim/engine_scalable.py (SCALABLE_TRAJECTORY_FIELDS / "
+        "SCALABLE_OBS_ONLY_FIELDS)",
+    ),
+    (
+        plane.RouteState,
+        plane.ROUTE_TRAJECTORY_FIELDS,
+        plane.ROUTE_OBS_ONLY_FIELDS,
+        "models/route/plane.py (ROUTE_TRAJECTORY_FIELDS / "
+        "ROUTE_OBS_ONLY_FIELDS)",
+    ),
+]
+
+
+@pytest.mark.parametrize(
+    "cls,traj,obs,where", REGISTRIES, ids=[r[0].__name__ for r in REGISTRIES]
+)
+def test_every_field_classified_exactly_once(cls, traj, obs, where):
+    fields = set(cls._fields)
+    unclassified = fields - traj - obs
+    assert not unclassified, (
+        f"{cls.__name__} field(s) {sorted(unclassified)} are classified "
+        f"neither trajectory nor obs-only.  Fix: add each to exactly one "
+        f"of the registry sets in {where} — obs-only ONLY if the field is "
+        "write-only within the tick (nothing the protocol reads), else "
+        "trajectory.  The noninterference analysis prong then proves the "
+        "obs case statically."
+    )
+    overlap = traj & obs
+    assert not overlap, (
+        f"{cls.__name__} field(s) {sorted(overlap)} are classified BOTH "
+        f"trajectory and obs-only — remove each from one set in {where}"
+    )
+    stale = (traj | obs) - fields
+    assert not stale, (
+        f"registry in {where} names non-existent field(s) "
+        f"{sorted(stale)} — remove them (the state class changed)"
+    )
+
+
+def test_registries_match_the_prong_view():
+    """analysis/noninterference.py consumes exactly these registries."""
+    from ringpop_tpu.analysis import noninterference as ni
+
+    regs = ni.state_registries()
+    assert set(regs) == {"SimState", "ScalableState", "RouteState"}
+    for cls, traj, obs, _ in REGISTRIES:
+        assert regs[cls.__name__] == (traj, obs)
+
+
+def test_executor_split_obs_rides_the_registry():
+    """fuzz.executor.split_obs partitions by the same single source."""
+    import jax.numpy as jnp
+
+    from ringpop_tpu.fuzz import executor as fex
+
+    params = es.ScalableParams(n=4, u=128, wavefront=True)
+    state = es.init_state(params, seed=0)
+    traj, obs = fex.split_obs(state)
+    assert set(obs) == {"first_heard"}  # hist off -> absent
+    assert traj.first_heard is None and traj.hist is None
+    assert traj.heard is state.heard  # trajectory planes untouched
+    assert (
+        jnp.asarray(obs["first_heard"]).shape
+        == state.first_heard.shape
+    )

@@ -37,10 +37,11 @@ if (
 ):
     _util_boot.force_host_device_count(8)
 # Round-13 note: buffer donation is DISABLED on the CPU backend
-# (storm.donate_state_argnums) — executables deserialized from the
-# persistent compilation cache below mis-execute donation when other
-# dispatches interleave, silently corrupting warm-run trajectories.
-# If donation is ever re-enabled on CPU, the cadence tests in
+# (storm.donate_state_argnums) — cache-deserialized executables
+# mis-execute donation when other dispatches interleave.  Full write-up
+# + the machine-checked defenses (DONATION_BUDGET.json, the donation
+# analysis prong, astlint stale-ref-across-donation): README "Donation
+# hazards".  If re-enabled on CPU, the cadence tests in
 # tests/models/test_recovery.py flake within a few runs.
 os.environ["JAX_PLATFORMS"] = "cpu"  # for any subprocesses tests may spawn
 
