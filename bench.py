@@ -130,6 +130,27 @@ def _profile_ctx(phase: str, recorder=None):
     return _ctx()
 
 
+def _xprof_capture(phase: str, run, recorder=None):
+    """Flag-gated (BENCH_XPROF=1) per-op time attribution for a bench
+    phase: one extra profiled window through obs.xprof.capture — the
+    top-K ops by self-time land as an ``xprof.capture`` runlog row and
+    on stdout, next to (not inside) the measured wall clocks.  The
+    mesh-observatory companion to BENCH_PROFILE's raw trace capture
+    (round 17)."""
+    if os.environ.get("BENCH_XPROF") != "1":
+        return None
+    from ringpop_tpu.obs import xprof
+
+    d = os.path.join(
+        os.environ.get("BENCH_RUNLOG_DIR") or ".", "xprof-%s" % phase
+    )
+    row = xprof.capture(
+        run, d, phase=phase, warmup=0, repeats=1, recorder=recorder
+    )
+    print(xprof.render_table(row))
+    return row
+
+
 def _mode_rate(
     n: int,
     ticks: int,
@@ -343,6 +364,11 @@ def _scalable_rate(
         recorder.record_phase(
             "measure[scalable:%s]" % sc.params.perm_impl, elapsed
         )
+    _xprof_capture(
+        "scalable-%s" % sc.params.perm_impl,
+        lambda: sc.run(sched),
+        recorder=recorder,
+    )
     return n * ticks / elapsed, elapsed, sc
 
 
@@ -431,6 +457,11 @@ def _mesh_rate(
                 node_ticks_per_sec=round(rates[s], 1),
             )
     top = ladder[-1]
+    # per-op attribution at the top rung (the storm/sched of the last
+    # ladder iteration): the chips' interconnect ops show up by name
+    _xprof_capture(
+        "mesh-%d" % top, lambda: storm.run(sched), recorder=recorder
+    )
     out["mesh_node_ticks_per_sec"] = {
         str(s): round(r, 1) for s, r in rates.items()
     }
@@ -683,6 +714,11 @@ def _full_rate(n: int, ticks: int, fused_tick: str, recorder=None):
             n=n,
         )
         jax.block_until_ready(sim.state)
+    _xprof_capture(
+        "full-%s" % sim.params.fused_tick,
+        lambda: sim.run(sched),
+        recorder=recorder,
+    )
     return n * ticks / elapsed, elapsed, sim
 
 

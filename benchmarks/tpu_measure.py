@@ -24,6 +24,11 @@ once and capture every chip-gated number in a single session —
      forced host devices (utils.util.pin_cpu_platform is the one
      routed place for that flag) so the phase is rehearsable on
      tunnel-less images.
+  I. round-17 mesh observatory: the per-shard exchange telemetry
+     plane drained on the real interconnect (measured wire bytes vs
+     the analytic traffic model, the check_traffic_model.py path) and
+     an xprof capture of the sharded storm window (per-HLO-op time
+     attribution via obs.xprof).
 
 Each phase is independently guarded; results stream as JSON lines and the
 combined dict lands in RESULTS_TPU_r06.json (TPU_MEASURE_OUT to override).
@@ -967,6 +972,125 @@ def phase_observatory(results: dict) -> None:
         )
 
 
+def phase_mesh_observatory(results: dict) -> None:
+    """Round-17 mesh observatory on-chip: (a) the per-shard exchange
+    telemetry plane (ScalableParams.exchange_metrics) drained after a
+    short sharded storm — measured wire bytes reconciled against the
+    analytic cross-shard traffic model on the real interconnect, the
+    same path scripts/check_traffic_model.py gates on CPU; and (b) an
+    xprof capture over the sharded storm window
+    (obs.xprof.capture) so the chip session banks per-HLO-op time
+    attribution next to the wall clocks — keyed, where op names allow,
+    to COST_BUDGET entry names."""
+    import sys
+
+    import jax
+
+    sys.path.insert(
+        0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    )
+
+    from ringpop_tpu.models.sim import engine_scalable as es
+    from ringpop_tpu.models.sim.storm import StormSchedule
+    from ringpop_tpu.obs import exchange_stats as oxs
+    from ringpop_tpu.obs import xprof as obs_xprof
+    from ringpop_tpu.parallel import mesh as pmesh
+
+    on_tpu = jax.default_backend() == "tpu"
+    devs = len(jax.devices())
+    shards = 1 << max(0, devs.bit_length() - 1)
+    if shards < 2:
+        results["mesh_observatory_drain"] = {
+            "error": "need >= 2 devices, have %d" % devs
+        }
+        return
+    n_per = int(
+        os.environ.get(
+            "TPU_MEASURE_OBSERVATORY_N_PER_SHARD",
+            "1000000" if on_tpu else "8192",
+        )
+    )
+    n, u, ticks = n_per * shards, 512, 8
+
+    storm = None
+    if _todo(results, "mesh_observatory_drain"):
+        try:
+            params = es.ScalableParams(
+                n=n, u=u, exchange_metrics=shards
+            )
+            storm = pmesh.ShardedStorm(
+                n=n, mesh=pmesh.make_mesh(shards), params=params, seed=0
+            )
+            sched = StormSchedule.churn_storm(
+                ticks, n, fraction=0.10, fail_tick=2, seed=0
+            )
+            storm.run(sched)
+            jax.block_until_ready(storm.state)
+            drained = storm.drain_exchange_metrics(reset=False)
+            rec = oxs.reconcile(drained["totals"], n=n, w=u // 32)
+            rec["cpu_rehearsal"] = not on_tpu  # NOT a chip number off-TPU
+            results["mesh_observatory_drain"] = rec
+        except Exception as e:
+            results["mesh_observatory_drain"] = {"error": str(e)[:300]}
+        print(
+            json.dumps(
+                {"mesh_observatory_drain": results["mesh_observatory_drain"]}
+            ),
+            flush=True,
+        )
+
+    if _todo(results, "mesh_observatory_xprof"):
+        try:
+            if storm is None:
+                params = es.ScalableParams(
+                    n=n, u=u, exchange_metrics=shards
+                )
+                storm = pmesh.ShardedStorm(
+                    n=n,
+                    mesh=pmesh.make_mesh(shards),
+                    params=params,
+                    seed=0,
+                )
+            sched = StormSchedule.churn_storm(
+                ticks, n, fraction=0.10, fail_tick=2, seed=0
+            )
+            trace_dir = os.path.join(
+                os.path.dirname(os.path.abspath(OUT_PATH)) or ".",
+                "xprof-mesh-observatory",
+            )
+            row = obs_xprof.capture(
+                lambda: storm.run(sched),
+                trace_dir,
+                phase="mesh-observatory-%dx%d" % (shards, n_per),
+                warmup=1,
+                repeats=1,
+                shards=shards,
+                n=n,
+            )
+            print(obs_xprof.render_table(row), flush=True)
+            # the full per-op table lives in the runlog/trace artifacts;
+            # the sweep result keeps the headline + top ops
+            results["mesh_observatory_xprof"] = {
+                "phase": row["phase"],
+                "ok": row["ok"],
+                "wall_s": row["wall_s"],
+                "num_trace_files": row["num_trace_files"],
+                "total_self_us": row["total_self_us"],
+                "top_ops": row["ops"][:5],
+                "trace_dir": row["trace_dir"],
+                "error": row.get("error"),
+                "cpu_rehearsal": not on_tpu,
+            }
+        except Exception as e:
+            results["mesh_observatory_xprof"] = {"error": str(e)[:300]}
+        print(
+            json.dumps(
+                {"mesh_observatory_xprof": results["mesh_observatory_xprof"]}
+            ),
+            flush=True,
+        )
+
+
 def phase_fused_full(results: dict) -> None:
     """Round-16 fused full-fidelity tick on-chip: the full [N, N]
     engine's fused (pallas streaming kernels) vs xla-twin vs classic
@@ -1346,6 +1470,7 @@ def main() -> int:
         ("weak_scaling", phase_weak_scaling),
         ("route", phase_route),
         ("observatory", phase_observatory),
+        ("mesh_observatory", phase_mesh_observatory),
         ("fused_full", phase_fused_full),
         ("ckpt", phase_ckpt),
         ("epidemic_100k", phase_epidemic_100k),

@@ -65,7 +65,11 @@ TRACED_ENTRIES: Dict[str, Set[str]] = {
     # the round-14 shard_map'd exchange plane: the plane body and its
     # row-routing helper are the repo's first explicitly-collective
     # traced code (all_to_all / all_gather / ppermute-class primitives)
-    "parallel/mesh.py": {"make_exchange_plane", "_route_rows"},
+    "parallel/mesh.py": {
+        "make_exchange_plane",
+        "_route_rows",
+        "_route_rows_stats",
+    },
     "ops/fused_checksum.py": {"membership_checksums", "fused_hash_rows"},
     # the round-16 kernel toolkit + fused full-tick ops: the shared
     # row-streaming scaffold and both fused sites are traced from

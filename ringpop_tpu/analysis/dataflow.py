@@ -2,7 +2,8 @@
 
 This module is the ONE place in the codebase that knows how to traverse
 a ClosedJaxpr through ``pjit`` / ``scan`` / ``while`` / ``cond`` /
-``pallas_call`` sub-jaxprs (ISSUE 15).  Two consumers ride it:
+``shard_map`` / ``pallas_call`` sub-jaxprs (ISSUE 15).  Two consumers
+ride it:
 
 - the **hash-taint auditor** (jaxpr_audit.py) — a :class:`Visitor` whose
   per-equation hook reimplements the round-8 uint32 taint discipline
@@ -153,6 +154,21 @@ def sub_jaxprs(eqn, precise: bool = False) -> List[SubJaxpr]:
                 else None
             )
             out.append(SubJaxpr(f"cond_branch{k}", branch, mapping))
+    elif prim == "shard_map":
+        # the boundary is positional 1:1 (in_names/out_names reshard,
+        # they don't reorder), so slice mode keeps per-position
+        # separation — without this the round-17 telemetry planes
+        # entering the exchange plane would conservatively taint the
+        # heard tile coming out.  Audit mode keeps its historical
+        # conservative fallback (pinned findings text).
+        j = params.get("jaxpr")
+        if j is not None:
+            if precise:
+                out.append(SubJaxpr(prim, j, positional(j)))
+            else:
+                out.append(
+                    SubJaxpr(f"{prim}.jaxpr", j, None, out_positional=False)
+                )
     elif prim in ("custom_jvp_call", "custom_vjp_call", "custom_jvp_call_jaxpr"):
         j = params.get("call_jaxpr") or params.get("fun_jaxpr")
         if j is not None:

@@ -339,6 +339,7 @@ def _entry_engine_scalable_tick(
     perm_impl: str = "auto",
     fused_exchange: str = "auto",
     histograms: bool = False,
+    exchange_metrics: int = 0,
 ) -> Tuple[Callable, Tuple]:
     from ringpop_tpu.models.sim import engine_scalable as es
 
@@ -349,6 +350,7 @@ def _entry_engine_scalable_tick(
         perm_impl=perm_impl,
         fused_exchange=fused_exchange,
         histograms=histograms,
+        exchange_metrics=exchange_metrics,
     )
     state = es.init_state(params, seed=0)
     inputs = es.ChurnInputs.quiet(8)
@@ -386,7 +388,7 @@ def _entry_exchange(impl: str) -> Tuple[Callable, Tuple]:
     return fused, _exchange_args()
 
 
-def _plane_fixture(n: int = 8):
+def _plane_fixture(n: int = 8, metrics: bool = False):
     """1-device mesh + exchange plane at toy shapes — the mesh axis is
     logical (shard_map traces identically at any device count), so the
     entries run under both the 1-device CLI env and the 8-device test
@@ -394,17 +396,17 @@ def _plane_fixture(n: int = 8):
     from ringpop_tpu.parallel import mesh as pmesh
 
     mesh = pmesh.make_mesh(1)
-    return pmesh.make_exchange_plane(mesh, "xla", n=n)
+    return pmesh.make_exchange_plane(mesh, "xla", n=n, metrics=metrics)
 
 
-def _plane_args(n: int = 8, w: int = 4, seed: int = 3):
+def _plane_args(n: int = 8, w: int = 4, seed: int = 3, metrics: bool = False):
     import jax.numpy as jnp
     import numpy as np
 
     rng = np.random.default_rng(seed)
     heard, _pull, _push, r_delta = _exchange_args(n, w, seed)
     perm = rng.permutation(n).astype(np.int32)
-    return (
+    args = (
         heard,
         r_delta,
         jnp.asarray(
@@ -413,6 +415,16 @@ def _plane_args(n: int = 8, w: int = 4, seed: int = 3):
         jnp.asarray(rng.random(n) < 0.7),  # direct_ok
         jnp.asarray(perm),  # partner0
         jnp.asarray(np.argsort(perm).astype(np.int32)),  # inv_base
+    )
+    if not metrics:
+        return args
+    from ringpop_tpu.ops import exchange as exch
+
+    # the round-17 telemetry plane threads the [S, ...] counter and
+    # histogram planes through the shard_map body (S=1 here)
+    return args + (
+        exch.init_exchange_counters(1),
+        exch.init_exchange_hist(1),
     )
 
 
@@ -429,17 +441,42 @@ def _entry_exchange_plane() -> Tuple[Callable, Tuple]:
     return fn, _plane_args()
 
 
-def _entry_engine_scalable_tick_shardmap() -> Tuple[Callable, Tuple]:
+def _entry_exchange_plane_metrics() -> Tuple[Callable, Tuple]:
+    """The round-17 telemetry-carrying plane flavor: same routing and
+    fused kernel as ``exchange-plane`` plus the write-only counter /
+    histogram bumps — the bumps live INSIDE the shard_map body, so they
+    must hold the same callback-free / uint32 gates (one float sneaking
+    into the cap-utilization log2 pricing would surface here)."""
+    plane = _plane_fixture(metrics=True)
+
+    def fn(heard, r_delta, active_words, ok, fwd, inv, exch_c, exch_h):
+        return plane(heard, r_delta, active_words, ok, fwd, inv, exch_c, exch_h)
+
+    return fn, _plane_args(metrics=True)
+
+
+def _entry_engine_scalable_tick_shardmap(
+    metrics: bool = False,
+) -> Tuple[Callable, Tuple]:
     """The sharded storm tick with the exchange seam filled by the
     shard_map plane — the program ShardedStorm compiles under a mesh
     (ISSUE 10 acceptance: the sharded tick holds the same callback-free
-    / uint32 discipline as every single-device shape)."""
+    / uint32 discipline as every single-device shape).  ``metrics=True``
+    pairs the telemetry-carrying plane with
+    ``ScalableParams.exchange_metrics`` — the shape ShardedStorm
+    actually compiles when the mesh observatory is on, and the entry the
+    noninterference prong slices to prove the counter planes never
+    reach the trajectory."""
     from ringpop_tpu.models.sim import engine_scalable as es
 
     params = es.ScalableParams(
-        n=8, u=128, perm_impl="sortless", fused_exchange="xla"
+        n=8,
+        u=128,
+        perm_impl="sortless",
+        fused_exchange="xla",
+        exchange_metrics=1 if metrics else 0,
     )
-    plane = _plane_fixture()
+    plane = _plane_fixture(metrics=metrics)
     state = es.init_state(params, seed=0)
     inputs = es.ChurnInputs.quiet(8)
 
@@ -787,6 +824,26 @@ DEFAULT_ENTRIES: List[EntryPoint] = [
     EntryPoint(
         "engine-scalable-tick-shardmap",
         _entry_engine_scalable_tick_shardmap,
+    ),
+    # the round-17 mesh observatory: the telemetry-carrying plane, the
+    # sharded tick compiled around it, and the single-device analytic
+    # twin (exchange_metrics without a plane) all hold the same gates —
+    # instrumentation must not buy its visibility with a callback or a
+    # widened hash lane
+    EntryPoint(
+        "exchange-plane-metrics", _entry_exchange_plane_metrics
+    ),
+    EntryPoint(
+        "engine-scalable-tick-shardmap-metrics",
+        lambda: _entry_engine_scalable_tick_shardmap(metrics=True),
+    ),
+    EntryPoint(
+        "engine-scalable-tick-exchange-metrics",
+        lambda: _entry_engine_scalable_tick(
+            perm_impl="sortless",
+            fused_exchange="xla",
+            exchange_metrics=4,
+        ),
     ),
     EntryPoint("fused-checksum-xla", lambda: _entry_fused_checksum("xla")),
     EntryPoint(

@@ -250,6 +250,13 @@ OBS_ENTRY_NAMES: Tuple[str, ...] = (
     "engine-scalable-tick-histograms",
     "route-tick-histograms",
     "fuzz-scenario-scan-full",
+    # round-17 mesh observatory: ScalableState.exch/exch_hist are
+    # obs-only — both the shard_map'd plane shape and the single-device
+    # analytic twin must prove the counter planes never reach the
+    # trajectory.  (exchange-plane-metrics itself takes bare arrays, no
+    # registered state class, so it proves vacuously and stays out.)
+    "engine-scalable-tick-shardmap-metrics",
+    "engine-scalable-tick-exchange-metrics",
 )
 
 # module suffixes feeding each obs-carrying entry — the --changed-only
@@ -277,6 +284,15 @@ ENTRY_SOURCES: Dict[str, Tuple[str, ...]] = {
         "ops/",
     ),
     "route-tick-histograms": ("models/route/", "ops/"),
+    "engine-scalable-tick-shardmap-metrics": (
+        "models/sim/engine_scalable.py",
+        "parallel/mesh.py",
+        "ops/",
+    ),
+    "engine-scalable-tick-exchange-metrics": (
+        "models/sim/engine_scalable.py",
+        "ops/",
+    ),
     "fuzz-scenario-scan-full": (
         "models/sim/engine.py",
         "models/sim/flight.py",

@@ -82,6 +82,10 @@ _TRAJECTORY_NEUTRAL_PARAMS = frozenset(
         # toggled resume (fixup_sim_state / fixup_scalable_state /
         # RoutedStorm._rebuild_route_state)
         "histograms",
+        # per-shard exchange telemetry plane (round 17): write-only like
+        # the histograms — a resume may toggle or re-shard freely and
+        # fixup_scalable_state re-zeroes the counters
+        "exchange_metrics",
         # round-10 scalable hot path: both knobs are bit-identical by
         # the gate-equivalence tests (tests/models/test_scalable_perm.py),
         # and drivers pin backend-resolved values at construction — a

@@ -184,6 +184,17 @@ class ScalableParams(NamedTuple):
     # off by default (the per-tick [N, U] bit expansion is real
     # bandwidth at 1M nodes, same cost class as wavefront).
     histograms: bool = False
+    # Per-shard exchange telemetry (round 17, the mesh observatory): 0 =
+    # off; S > 0 carries ScalableState.exch/exch_hist — per-shard
+    # push/pull row counts, a2a-vs-fallback trips, destination-shard
+    # spread, and cap-utilization histograms for an S-shard exchange
+    # plane (ops.exchange.EXCH_COUNTERS / EXCH_HIST_TRACKS; S must
+    # divide n).  Under a mesh S must equal the mesh size and the
+    # shard_map plane accumulates in-body; single-device runs model the
+    # SAME S-shard routing analytically (the bitwise twin the drain
+    # tests compare against).  Write-only, trajectory-neutral, off by
+    # default — the obs-plane pattern (wavefront/histograms).
+    exchange_metrics: int = 0
 
 
 class ScalableState(NamedTuple):
@@ -234,6 +245,13 @@ class ScalableState(NamedTuple):
     # write-only within the tick (drained by
     # ScalableCluster.drain_histograms)
     hist: Optional[jax.Array] = None
+    # per-shard exchange telemetry plane (ScalableParams.exchange_metrics
+    # = S only, else None): [S, len(EXCH_COUNTERS)] uint32 counters and
+    # [S, len(EXCH_HIST_TRACKS), NBUCKETS] cap-utilization histograms
+    # (ops/exchange.py layout; drained by drain_exchange_metrics on
+    # ScalableCluster / ShardedStorm).  Write-only within the tick.
+    exch: Optional[jax.Array] = None
+    exch_hist: Optional[jax.Array] = None
 
 
 # Single-source field classification (ISSUE 15): trajectory vs obs-only,
@@ -241,7 +259,9 @@ class ScalableState(NamedTuple):
 # engine.SIM_TRAJECTORY_FIELDS / SIM_OBS_ONLY_FIELDS (see the note
 # there).  A new ScalableState field MUST land in exactly one set
 # (tier-1 gate: tests/analysis/test_state_registry.py).
-SCALABLE_OBS_ONLY_FIELDS = frozenset({"first_heard", "hist"})
+SCALABLE_OBS_ONLY_FIELDS = frozenset(
+    {"first_heard", "hist", "exch", "exch_hist"}
+)
 SCALABLE_TRAJECTORY_FIELDS = frozenset(
     {
         "tick_index",
@@ -286,6 +306,18 @@ NODE_SHARDED_FIELDS = frozenset(
         "checksum",
     }
 )
+
+
+# ScalableState fields indexed by SHARD along axis 0 (the round-17
+# exchange-telemetry planes): sharded P("nodes") on a mesh whose size
+# equals params.exchange_metrics — each device carries its own [1, ...]
+# counter slice so the shard_map plane bumps purely locally — and
+# replicated otherwise (the single-device twin models S shards on one
+# device; a GSPMD run with a mismatched S keeps the plane whole).
+# Consumed by parallel.mesh.scalable_state_shardings; NOT in
+# NODE_SHARDED_FIELDS, so checkpoints keep the tiny planes in the
+# common file at any shard count.
+SHARD_SHARDED_FIELDS = frozenset({"exch", "exch_hist"})
 
 
 class ScalableMetrics(NamedTuple):
@@ -627,9 +659,21 @@ def init_state(params: ScalableParams, seed: int = 0) -> ScalableState:
         from ringpop_tpu.ops import histogram as hg
 
         hist = hg.init(len(SCALABLE_HIST_TRACKS))
+    exch = exch_hist = None
+    if params.exchange_metrics:
+        s = int(params.exchange_metrics)
+        if s < 1 or n % s:
+            raise ValueError(
+                "exchange_metrics=%d must be a positive divisor of n=%d "
+                "(it models an S-shard exchange plane)" % (s, n)
+            )
+        exch = _exchange.init_exchange_counters(s)
+        exch_hist = _exchange.init_exchange_hist(s)
     return ScalableState(
         first_heard=first_heard,
         hist=hist,
+        exch=exch,
+        exch_hist=exch_hist,
         tick_index=jnp.int32(0),
         proc_alive=jnp.ones(n, bool),
         gossip_on=jnp.ones(n, bool),
@@ -886,6 +930,96 @@ def farmhash_truth_checksum(
     )[0]
 
 
+def _exchange_obs_update(
+    exch: jax.Array,  # [S, len(EXCH_COUNTERS)] uint32
+    exch_hist: jax.Array,  # [S, len(EXCH_HIST_TRACKS), NBUCKETS] uint32
+    direct_ok: jax.Array,  # [N] bool
+    partner0: jax.Array,  # [N] int32 — push destination (fwd PRP)
+    inv_base: jax.Array,  # [N] int32 — pull destination (inverse PRP)
+    n: int,
+) -> tuple[jax.Array, jax.Array]:
+    """The single-device twin of the mesh plane's in-body telemetry
+    bumps: model the S-shard routing of THIS tick's permutation
+    analytically and accumulate the same per-shard counters bitwise
+    (ops.exchange.EXCH_COUNTERS order; the drain tests compare the two
+    planes row-for-row).  Every quantity is mask-independent except the
+    delivered-row counts, which use exactly the direct_ok mask that
+    drives the trajectory — the flight-recorder discipline.  The a2a-
+    vs-fallback split prices the DEFAULT cap (exchange_cap), which is
+    what the plane uses unless a test forces an override."""
+    from ringpop_tpu.ops import histogram as hg
+
+    s = exch.shape[0]
+    local = n // s
+    shard_ids = jnp.arange(s, dtype=jnp.int32)
+
+    def _bucket_counts(dest):
+        # [S, S] all_to_all bucket occupancy: rows of source shard src
+        # addressed to destination shard dest//local (routing is
+        # mask-independent — the plane routes every row, masking only
+        # zeroes payloads)
+        ds = (dest // jnp.int32(local)).reshape(s, local)
+        return jnp.sum(
+            (ds[:, :, None] == shard_ids[None, None, :]).astype(jnp.int32),
+            axis=1,
+        )
+
+    cnt_pull = _bucket_counts(inv_base)  # pull: row p -> inv[p]
+    cnt_push = _bucket_counts(partner0)  # push: row j -> partner0[j]
+    cap = jnp.int32(_exchange.exchange_cap(local, s))
+    # pmax-agreed in the plane: one global verdict per direction
+    ovf_pull = jnp.any(cnt_pull > cap)
+    ovf_push = jnp.any(cnt_push > cap)
+    # receiver-side delivered rows: pulls accepted under the receiver's
+    # own direct_ok; pushes delivered to row fwd[j] under sender j's ok
+    # (ok[inv_base[r]] is row r's sender)
+    # every sum pins dtype=uint32: under x64 jnp.sum would widen to
+    # uint64 and break the scan carry (exch is a uint32 plane)
+    pull_rows = jnp.sum(
+        direct_ok.reshape(s, local).astype(jnp.uint32),
+        axis=1,
+        dtype=jnp.uint32,
+    )
+    push_rows = jnp.sum(
+        direct_ok[inv_base].reshape(s, local).astype(jnp.uint32),
+        axis=1,
+        dtype=jnp.uint32,
+    )
+    one = jnp.ones((s,), jnp.uint32)
+    bump = jnp.stack(
+        [
+            one,  # ticks
+            one * (~ovf_pull).astype(jnp.uint32),  # a2a_pull
+            one * (~ovf_push).astype(jnp.uint32),  # a2a_push
+            one * ovf_pull.astype(jnp.uint32),  # fallback_pull
+            one * ovf_push.astype(jnp.uint32),  # fallback_push
+            pull_rows,
+            push_rows,
+            jnp.sum(
+                (cnt_pull > 0).astype(jnp.uint32),
+                axis=1,
+                dtype=jnp.uint32,
+            ),
+            jnp.sum(
+                (cnt_push > 0).astype(jnp.uint32),
+                axis=1,
+                dtype=jnp.uint32,
+            ),
+        ],
+        axis=1,
+    )
+    track_pull = _exchange.EXCH_HIST_TRACKS.index("cap_util_pull")
+    track_push = _exchange.EXCH_HIST_TRACKS.index("cap_util_push")
+    all_on = jnp.ones((s,), bool)
+
+    def _bump_hist(h, cp, cq):
+        h = hg.record(h, track_pull, cp, all_on)
+        return hg.record(h, track_push, cq, all_on)
+
+    exch_hist = jax.vmap(_bump_hist)(exch_hist, cnt_pull, cnt_push)
+    return exch + bump, exch_hist
+
+
 def tick(
     state: ScalableState,
     inputs: ChurnInputs,
@@ -918,6 +1052,13 @@ def tick(
     hist = state.hist if params.histograms else None
     if hist is not None:
         from ringpop_tpu.ops import histogram as hg
+
+    # per-shard exchange telemetry plane (round 17): accumulated at the
+    # gossip-exchange site below — in the shard_map plane's body under a
+    # mesh, by the analytic S-shard twin inline — and attached at the
+    # end.  Same straight-line, write-only discipline as hist.
+    exch = state.exch if params.exchange_metrics else None
+    exch_hist = state.exch_hist if params.exchange_metrics else None
 
     # ---- fault plane ---------------------------------------------------
     revived = inputs.revive & ~state.proc_alive
@@ -1094,14 +1235,30 @@ def tick(
         # below.  Delta accounting follows the fused shape (d_direct
         # from the plane, indirect diff summed separately) — exact mod
         # 2^32 either way.
-        new_heard, d_direct = exchange_plane(
-            state.heard,
-            state.r_delta,
-            active_words,
-            direct_ok,
-            partner0,
-            inv_base,
-        )
+        if exch is not None:
+            # metrics-carrying plane (make_exchange_plane(metrics=True)):
+            # the telemetry bumps happen INSIDE the shard_map body, where
+            # the routing stats are already local — the driver pairs the
+            # plane flavor with params.exchange_metrics (ShardedStorm)
+            new_heard, d_direct, exch, exch_hist = exchange_plane(
+                state.heard,
+                state.r_delta,
+                active_words,
+                direct_ok,
+                partner0,
+                inv_base,
+                exch,
+                exch_hist,
+            )
+        else:
+            new_heard, d_direct = exchange_plane(
+                state.heard,
+                state.r_delta,
+                active_words,
+                direct_ok,
+                partner0,
+                inv_base,
+            )
         fused_ex = "plane"
     else:
         pulled = (
@@ -1133,6 +1290,13 @@ def tick(
                 impl=fused_ex,
                 want_counts=False,
             )
+    if exch is not None and exchange_plane is None:
+        # analytic S-shard twin of the plane's in-body bumps (the GSPMD
+        # and single-device paths) — bitwise-equal counters by
+        # construction, pinned in tests/parallel/test_shard_exchange.py
+        exch, exch_hist = _exchange_obs_update(
+            exch, exch_hist, direct_ok, partner0, inv_base, n
+        )
     heard_after_direct = new_heard
 
     # indirect rounds (the ping-req fanout) + probe evidence: only nodes
@@ -1447,6 +1611,8 @@ def tick(
     state = state._replace(checksum=checksum, rng=_fold(rng, 0x5CA1E))
     if hist is not None:
         state = state._replace(hist=hist)
+    if exch is not None:
+        state = state._replace(exch=exch, exch_hist=exch_hist)
 
     active_words2 = _pack_mask(state.r_active)
     n_active = jnp.sum(state.r_active.astype(jnp.int32))

@@ -196,6 +196,20 @@ def fixup_scalable_state(
         )
     elif not params.histograms and state.hist is not None:
         state = state._replace(hist=None)
+    # per-shard exchange telemetry plane: same contract, plus a resume
+    # under a DIFFERENT shard count re-zeroes (counter rows are keyed by
+    # shard id — a foreign bucketization would mislabel the wire)
+    if params.exchange_metrics:
+        s = int(params.exchange_metrics)
+        if state.exch is None or int(state.exch.shape[0]) != s:
+            from ringpop_tpu.ops import exchange as _exchange
+
+            state = state._replace(
+                exch=_exchange.init_exchange_counters(s),
+                exch_hist=_exchange.init_exchange_hist(s),
+            )
+    elif state.exch is not None:
+        state = state._replace(exch=None, exch_hist=None)
     return state
 
 
@@ -363,6 +377,41 @@ class ScalableCluster(CheckpointableMixin):
 
             self.state = self.state._replace(
                 hist=hg.init(len(es.SCALABLE_HIST_TRACKS))
+            )
+        return summary
+
+    # -- exchange telemetry (ScalableParams.exchange_metrics) -------------
+
+    def drain_exchange_metrics(self, reset: bool = True, statsd=None):
+        """Drain the per-shard exchange telemetry counters through the
+        shared host half (obs.exchange_stats.drain) — the single-device
+        twin of ShardedStorm.drain_exchange_metrics, counting against
+        the DEFAULT exchange cap so per-shard rows sum bitwise to the
+        mesh driver's under identical trajectories."""
+        if self.state.exch is None:
+            raise ValueError(
+                "exchange telemetry is off — construct with "
+                "ScalableParams(exchange_metrics=<shards>)"
+            )
+        from ringpop_tpu.obs import exchange_stats as oxs
+        from ringpop_tpu.ops import exchange as _exchange
+
+        counters = np.asarray(self.state.exch)
+        hist = np.asarray(self.state.exch_hist)
+        s = int(counters.shape[0])
+        summary = oxs.drain(
+            counters,
+            hist,
+            w=int(self.state.heard.shape[1]),
+            local_rows=self.params.n // s,
+            source="sim.engine_scalable",
+            recorder=self.recorder,
+            statsd=statsd,
+        )
+        if reset:
+            self.state = self.state._replace(
+                exch=_exchange.init_exchange_counters(s),
+                exch_hist=_exchange.init_exchange_hist(s),
             )
         return summary
 

@@ -37,6 +37,16 @@ become durable and queryable:
   entry points (fenced, donation-safe, compile/execute split via the
   jit-cache probe), ``perf.phase`` rows and the shared bench
   warm-then-measure loop (``timed_window``).
+- :mod:`ringpop_tpu.obs.exchange_stats` — host half of the round-17
+  mesh exchange telemetry (ops.exchange counter/histogram planes):
+  exact wire-byte pricing, ``mesh.exchange.drain`` rows, the
+  ``sharded.exchange.*`` statsd keys, and the measured-vs-model
+  ``traffic_reconcile`` verdict every drained window ships.
+- :mod:`ringpop_tpu.obs.xprof` — profiler trace harness:
+  ``jax.profiler.trace`` capture with the warmup fenced outside the
+  span, per-HLO-op self-time tables fuzzily keyed to COST_BUDGET
+  entries, schema-gated ``xprof.capture`` rows (failures are rows,
+  never exceptions).
 """
 
 from ringpop_tpu.obs.recorder import (  # noqa: F401

@@ -102,6 +102,41 @@ HIST_TIMER_KEYS: Dict[str, str] = {
     "dirty_buckets": "sim.route.ring.dirty-buckets.dist",
 }
 
+# Mesh exchange telemetry (obs.exchange_stats.drain over the device-side
+# ExchangeMetrics counters, ISSUE 16): cross-shard SUMS emit as deltas
+# under ``sharded.exchange.*`` — the mesh-collective analog of the
+# reference's per-instance ringpop.<host_port>.* discipline; the shard
+# count rides as a gauge.  Keys are keyed by ExchangeMetrics field name
+# (lockstep pinned in tests/obs/test_statsd_bridge.py).
+EXCHANGE_KEY_MAP: Dict[str, Tuple[str, str]] = {
+    "ticks": ("increment", "sharded.exchange.ticks"),
+    "a2a_pull": ("increment", "sharded.exchange.a2a.pull"),
+    "a2a_push": ("increment", "sharded.exchange.a2a.push"),
+    "fallback_pull": ("increment", "sharded.exchange.fallback.pull"),
+    "fallback_push": ("increment", "sharded.exchange.fallback.push"),
+    "pull_rows": ("increment", "sharded.exchange.rows.pull"),
+    "push_rows": ("increment", "sharded.exchange.rows.push"),
+    "dest_shards_pull": ("increment", "sharded.exchange.spread.pull"),
+    "dest_shards_push": ("increment", "sharded.exchange.spread.push"),
+    "wire_bytes_pull": ("increment", "sharded.exchange.wire-bytes.pull"),
+    "wire_bytes_push": ("increment", "sharded.exchange.wire-bytes.push"),
+    "shards": ("gauge", "sharded.exchange.shards"),
+}
+
+# Cap-utilization histogram tracks (EXCH_HIST_TRACKS) -> timer keys for
+# emit_hist_summary (statsd ``|ms`` wire type, like HIST_TIMER_KEYS).
+EXCHANGE_HIST_KEYS: Dict[str, str] = {
+    "cap_util_pull": "sharded.exchange.cap-util.pull",
+    "cap_util_push": "sharded.exchange.cap-util.push",
+}
+
+# Profiler trace harness (obs.xprof): capture wall time emits as a TIMER
+# (|ms), the attributed-op count as a gauge.
+XPROF_KEY_MAP: Dict[str, Tuple[str, str]] = {
+    "wall_s": ("timing", "xprof.capture"),
+    "ops": ("gauge", "xprof.ops"),
+}
+
 # Recovery-plane lifecycle counters (models/sim/recovery.py): emitted by
 # CheckpointManager directly (they are per-event, not per-tick, so they
 # ride their own map rather than TICK_KEY_MAP).  The reference has no
@@ -163,6 +198,12 @@ class StatsdBridge:
 
             self._stat = _stat
 
+    def increment(self, key: str, value: int = 1) -> None:
+        """Emit one COUNTER delta under the bridge's fq-key scheme — the
+        public seam for driver-level aggregate counts (the mesh exchange
+        drain's summed ``sharded.exchange.*`` deltas)."""
+        self._stat("increment", key, int(value))
+
     def gauge(self, key: str, value) -> None:
         """Emit one gauge under the bridge's fq-key scheme — the public
         seam for driver-level one-shot stats (e.g. the mesh driver's
@@ -198,6 +239,32 @@ class StatsdBridge:
                 if v is not None:
                     self.timing("%s.%s" % (key, q), v)
                     emitted += 1
+        return emitted
+
+    def emit_exchange_drain(
+        self,
+        tot: Dict[str, Any],
+        key_map: Optional[Dict[str, Tuple[str, str]]] = None,
+    ) -> int:
+        """One drained exchange-telemetry window's cross-shard totals
+        (obs.exchange_stats.totals) -> ``sharded.exchange.*``: counters
+        emit only when nonzero (statsd increments are deltas), the shard
+        count always emits as a gauge.  Returns the number of
+        emissions."""
+        key_map = EXCHANGE_KEY_MAP if key_map is None else key_map
+        emitted = 0
+        for field, value in tot.items():
+            mapped = key_map.get(field)
+            if mapped is None:
+                continue
+            stat_type, key = mapped
+            if stat_type == "increment":
+                if value:
+                    self.increment(key, int(value))
+                    emitted += 1
+            else:
+                self._stat(stat_type, key, value)
+                emitted += 1
         return emitted
 
     def emit_tick(self, row: Any) -> int:

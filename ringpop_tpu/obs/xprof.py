@@ -1,0 +1,252 @@
+"""Profiler trace capture harness (ISSUE 16 tentpole c).
+
+Wraps ``jax.profiler.trace`` around the shared warm-then-measure loop
+(:func:`ringpop_tpu.obs.perf.timed_window`), then digests the captured
+Chrome-format trace into a per-op time-attribution table — top-K ops by
+self-time, fuzzily keyed to the COST_BUDGET entry names where an op name
+carries one — and stamps the artifact as an ``xprof.capture`` runlog row
+(schema-gated by scripts/check_metrics_schema.py).  Consumers:
+``BENCH_XPROF=1`` on bench.py's scalable/mesh/full phases and
+tpu_measure.py's ``mesh_observatory`` phase, so a chip session banks
+per-op attribution next to the wall clocks instead of re-deriving it
+from memory later.
+
+Everything here is defensive by contract: a backend without profiler
+support, an empty capture, or an unparseable trace file yields an
+``ok=False`` row with the failure reason — never an exception into the
+measurement run it rides.
+"""
+
+from __future__ import annotations
+
+import glob
+import gzip
+import json
+import os
+import re
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+XPROF_EVENT = "xprof.capture"
+# required xprof.capture row fields (lockstep-pinned by
+# scripts/check_metrics_schema.py and tests/obs/test_runlog_schema.py)
+XPROF_FIELDS = (
+    "phase",
+    "ok",
+    "wall_s",
+    "trace_dir",
+    "num_trace_files",
+    "total_self_us",
+    "ops",
+)
+DEFAULT_TOP_K = 10
+
+_TOKEN_RE = re.compile(r"[a-z0-9]+")
+
+
+def find_trace_files(trace_dir: str) -> List[str]:
+    """Chrome-format trace files under a ``jax.profiler.trace`` output
+    dir (``plugins/profile/<run>/*.trace.json.gz``), newest first."""
+    paths = glob.glob(
+        os.path.join(trace_dir, "**", "*.trace.json.gz"), recursive=True
+    )
+    return sorted(paths, key=lambda p: os.path.getmtime(p), reverse=True)
+
+
+def load_trace_events(path: str) -> List[Dict[str, Any]]:
+    """The ``traceEvents`` list of one (gzipped or plain) Chrome trace."""
+    opener = gzip.open if path.endswith(".gz") else open
+    with opener(path, "rt", encoding="utf-8", errors="replace") as f:
+        doc = json.load(f)
+    if isinstance(doc, list):  # bare event-array form
+        return doc
+    return list(doc.get("traceEvents", []))
+
+
+def op_table(
+    events: Sequence[Dict[str, Any]],
+    top_k: int = DEFAULT_TOP_K,
+    budget_entries: Optional[Sequence[str]] = None,
+) -> Tuple[List[Dict[str, Any]], float]:
+    """Aggregate complete ("X"-phase) events by name into the top-K
+    self-time table: ``[{"name", "self_us", "count", "budget_entry"},
+    ...]`` plus the total attributed microseconds.  Metadata events and
+    zero-duration markers drop out."""
+    agg: Dict[str, List[float]] = {}
+    for ev in events:
+        if ev.get("ph") != "X":
+            continue
+        dur = ev.get("dur")
+        name = ev.get("name")
+        if not name or not isinstance(dur, (int, float)) or dur <= 0:
+            continue
+        row = agg.setdefault(str(name), [0.0, 0])
+        row[0] += float(dur)
+        row[1] += 1
+    total = sum(v[0] for v in agg.values())
+    ranked = sorted(agg.items(), key=lambda kv: kv[1][0], reverse=True)
+    out = []
+    for name, (self_us, count) in ranked[: max(0, int(top_k))]:
+        out.append(
+            {
+                "name": name,
+                "self_us": round(self_us, 3),
+                "count": int(count),
+                "budget_entry": match_budget_entry(name, budget_entries),
+            }
+        )
+    return out, round(total, 3)
+
+
+def match_budget_entry(
+    op_name: str, entries: Optional[Sequence[str]]
+) -> Optional[str]:
+    """Fuzzy op-name -> COST_BUDGET entry-name key: the entry whose
+    token set overlaps the op name most (HLO op names carry fusion/op
+    hints like ``all-to-all`` or ``fusion.pallas_exchange``, budget
+    names read ``exchange-plane`` / ``engine-scalable-tick``).  None
+    when nothing overlaps — most ops are anonymous fusions."""
+    if not entries:
+        return None
+    op_tokens = set(_TOKEN_RE.findall(op_name.lower()))
+    if not op_tokens:
+        return None
+    best, best_score = None, 0
+    for entry in entries:
+        tokens = set(_TOKEN_RE.findall(entry.lower()))
+        score = len(op_tokens & tokens)
+        if score > best_score:
+            best, best_score = entry, score
+    return best
+
+
+def _budget_entry_names() -> List[str]:
+    try:
+        from ringpop_tpu.analysis import cost
+
+        manifest = cost.load_manifest()
+        return sorted((manifest or {}).get("entries", {}).keys())
+    except Exception:
+        return []
+
+
+def capture(
+    run: Callable[[], Any],
+    trace_dir: str,
+    *,
+    phase: str = "xprof",
+    warmup: int = 1,
+    repeats: int = 1,
+    top_k: int = DEFAULT_TOP_K,
+    recorder=None,
+    statsd=None,
+    **extra: Any,
+) -> Dict[str, Any]:
+    """Profile ``repeats`` fenced calls of ``run`` (after ``warmup``
+    unprofiled compile calls) under ``jax.profiler.trace(trace_dir)``,
+    digest the capture into the top-K op table, and stamp one
+    ``xprof.capture`` row on ``recorder`` / ``xprof.*`` statsd keys.
+    Returns the row dict (``ok=False`` + ``error`` on any capture or
+    parse failure; the measurement itself always completes)."""
+    from ringpop_tpu.obs import perf
+
+    os.makedirs(trace_dir, exist_ok=True)
+    row: Dict[str, Any] = {
+        "phase": phase,
+        "ok": False,
+        "wall_s": None,
+        "trace_dir": trace_dir,
+        "num_trace_files": 0,
+        "total_self_us": 0.0,
+        "ops": [],
+    }
+    row.update(extra)
+    # compile outside the profiled span: traces should attribute steady-
+    # state execution, not tracing/lowering
+    for _ in range(max(0, warmup)):
+        perf.fence(run())
+    try:
+        import jax
+
+        with jax.profiler.trace(trace_dir):
+            _, wall = perf.timed_window(run, warmup=0, repeats=repeats)
+        row["wall_s"] = wall
+    except Exception as e:
+        row["error"] = "profiler capture failed: %s" % (str(e)[:300],)
+        _emit(row, recorder, statsd)
+        return row
+    try:
+        files = find_trace_files(trace_dir)
+        row["num_trace_files"] = len(files)
+        if not files:
+            row["error"] = "no trace files captured under %s" % trace_dir
+            _emit(row, recorder, statsd)
+            return row
+        events: List[Dict[str, Any]] = []
+        for p in files:
+            events.extend(load_trace_events(p))
+        ops, total = op_table(
+            events, top_k=top_k, budget_entries=_budget_entry_names()
+        )
+        row["ops"] = ops
+        row["total_self_us"] = total
+        row["ok"] = True
+    except Exception as e:
+        row["error"] = "trace parse failed: %s" % (str(e)[:300],)
+    _emit(row, recorder, statsd)
+    return row
+
+
+def _emit(row: Dict[str, Any], recorder, statsd) -> None:
+    if recorder is not None:
+        recorder.record_event(XPROF_EVENT, **row)
+    if statsd is not None:
+        from ringpop_tpu.obs.statsd_bridge import XPROF_KEY_MAP
+
+        if row.get("wall_s") is not None:
+            stat_type, key = XPROF_KEY_MAP["wall_s"]
+            getattr(statsd, "timing")(key, float(row["wall_s"]) * 1e3)
+        stat_type, key = XPROF_KEY_MAP["ops"]
+        statsd.gauge(key, len(row.get("ops") or []))
+
+
+def render_table(row: Dict[str, Any]) -> str:
+    """Console rendering of one capture row — the bench's human view."""
+    lines = [
+        "xprof[%s]: ok=%s files=%d total_self=%.1fus"
+        % (
+            row.get("phase"),
+            row.get("ok"),
+            row.get("num_trace_files", 0),
+            row.get("total_self_us") or 0.0,
+        )
+    ]
+    if row.get("error"):
+        lines.append("  error: %s" % row["error"])
+    for op in row.get("ops") or []:
+        lines.append(
+            "  %10.1fus x%-5d %s%s"
+            % (
+                op["self_us"],
+                op["count"],
+                op["name"][:80],
+                (
+                    "  [%s]" % op["budget_entry"]
+                    if op.get("budget_entry")
+                    else ""
+                ),
+            )
+        )
+    return "\n".join(lines)
+
+
+__all__: List[str] = [
+    "DEFAULT_TOP_K",
+    "XPROF_EVENT",
+    "XPROF_FIELDS",
+    "capture",
+    "find_trace_files",
+    "load_trace_events",
+    "match_budget_entry",
+    "op_table",
+    "render_table",
+]

@@ -59,9 +59,11 @@ sharded configuration is bitwise-compared against
 from __future__ import annotations
 
 import math
+from typing import NamedTuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 SUB, LANE = 8, 128
 TILE = SUB * LANE  # rows per kernel tile
@@ -379,6 +381,147 @@ def cross_shard_traffic_bytes(
         out["interconnect_rows"] + out["interconnect_pos"]
     )
     return out
+
+
+# ---------------------------------------------------------------------------
+# Per-shard exchange telemetry (round 17): the measured twin of the
+# traffic model above.  Device half: a [S, len(EXCH_COUNTERS)] uint32
+# counter plane plus [S, len(EXCH_HIST_TRACKS), NBUCKETS] cap-utilization
+# histograms, carried through the scanned tick exactly like the
+# flight-recorder/histogram planes (write-only, OFF by default,
+# trajectory-neutral — ScalableParams.exchange_metrics).  Host half:
+# drain_exchange_counters turns the drained counters into
+# ExchangeMetrics rows with EXACT wire-byte totals (trips x static trip
+# size — the byte math stays on the host so uint32 counters never
+# overflow mid-scan).
+# ---------------------------------------------------------------------------
+
+# device counter layout, one row per shard (order IS the wire format —
+# the engine's inline twin and the mesh plane bump by this index table,
+# and the schema gate pins ExchangeMetrics._fields against it):
+# - ticks: instrumented exchange rounds accumulated
+# - a2a_pull/push: rounds routed through the capped all_to_all fast path
+# - fallback_pull/push: rounds that overflowed the cap and took the
+#   all-gather route (pmax-agreed, so every shard logs the same trip)
+# - pull_rows: rows this shard's receivers accepted under direct_ok
+# - push_rows: ok-masked push rows DELIVERED to this shard
+# - dest_shards_pull/push: destination-shard spread (distinct shards
+#   addressed this round, summed over rounds — /ticks = mean fan-out)
+EXCH_COUNTERS = (
+    "ticks",
+    "a2a_pull",
+    "a2a_push",
+    "fallback_pull",
+    "fallback_push",
+    "pull_rows",
+    "push_rows",
+    "dest_shards_pull",
+    "dest_shards_push",
+)
+
+# cap-utilization histogram tracks (ops/histogram.py log2 buckets): one
+# observation per (round, destination shard) — the occupancy of that
+# destination's all_to_all bucket BEFORE capping.  Mask- and
+# cap-independent (routing always routes all rows; masking only zeroes
+# payloads), so the single-device twin reproduces the plane bitwise.
+EXCH_HIST_TRACKS = ("cap_util_pull", "cap_util_push")
+
+
+class ExchangeMetrics(NamedTuple):
+    """One drained per-shard telemetry row (host ints, not arrays).
+
+    The device counters (EXCH_COUNTERS order) plus the shard id and the
+    derived EXACT wire-byte totals; the runlog ``mesh.exchange.drain``
+    event schema is pinned to ``ExchangeMetrics._fields`` by
+    scripts/check_metrics_schema.py + tests/obs/test_runlog_schema.py."""
+
+    shard: int
+    ticks: int
+    a2a_pull: int
+    a2a_push: int
+    fallback_pull: int
+    fallback_push: int
+    pull_rows: int
+    push_rows: int
+    dest_shards_pull: int
+    dest_shards_push: int
+    wire_bytes_pull: int
+    wire_bytes_push: int
+
+
+def init_exchange_counters(shards: int) -> jax.Array:
+    """Zeroed [shards, len(EXCH_COUNTERS)] uint32 device counter plane."""
+    return jnp.zeros((shards, len(EXCH_COUNTERS)), jnp.uint32)
+
+
+def init_exchange_hist(shards: int) -> jax.Array:
+    """Zeroed [shards, len(EXCH_HIST_TRACKS), NBUCKETS] uint32
+    cap-utilization histogram plane (ops/histogram.py buckets)."""
+    from ringpop_tpu.ops import histogram as hg
+
+    return jnp.zeros(
+        (shards, len(EXCH_HIST_TRACKS), hg.NBUCKETS), jnp.uint32
+    )
+
+
+def a2a_trip_bytes(w: int, shards: int, cap: int) -> int:
+    """EXACT wire bytes one shard moves per all_to_all routing trip, one
+    direction: the [S, cap, w] uint32 row payload plus the [S, cap]
+    int32 destination-position plane — a2a payload x cap, padding slots
+    included (they ride the wire; that is why the model charges the cap).
+    ``cross_shard_traffic_bytes`` charges exactly
+    ``2 directions x shards x this x (S-1)/S`` per tick — the identity
+    the reconciliation gate (scripts/check_traffic_model.py) checks."""
+    return shards * cap * (w * 4 + 4)
+
+
+def fallback_trip_bytes(local_rows: int, w: int, shards: int) -> int:
+    """EXACT bytes one shard RECEIVES per all-gather fallback trip, one
+    direction: the full [N, w] tiled gather (own tile included — the
+    (S-1)/S cross fraction is applied by the reconciliation, same as
+    for the a2a path)."""
+    return shards * local_rows * w * 4
+
+
+def drain_exchange_counters(
+    counters,  # [S, len(EXCH_COUNTERS)] uint32 (host array)
+    *,
+    w: int,
+    cap: "int | None",
+    local_rows: int,
+) -> "list[ExchangeMetrics]":
+    """Drained device counters -> per-shard ExchangeMetrics rows.
+
+    Wire bytes are computed HERE (trips x static per-trip size) so the
+    device plane stays uint32-safe over long scans; ``cap=None`` (the
+    inline/GSPMD twin, which never routes) prices the a2a trips at the
+    default :func:`exchange_cap` — the same cap the plane would use."""
+    counters = np.asarray(counters)
+    shards = counters.shape[0]
+    if counters.shape != (shards, len(EXCH_COUNTERS)):
+        raise ValueError(
+            "counters must be [S, %d], got %r"
+            % (len(EXCH_COUNTERS), counters.shape)
+        )
+    if cap is None:
+        cap = exchange_cap(local_rows, shards)
+    a2a_b = a2a_trip_bytes(w, shards, cap)
+    fb_b = fallback_trip_bytes(local_rows, w, shards)
+    col = {name: i for i, name in enumerate(EXCH_COUNTERS)}
+    rows = []
+    for s in range(shards):
+        c = {name: int(counters[s, i]) for name, i in col.items()}
+        rows.append(
+            ExchangeMetrics(
+                shard=s,
+                wire_bytes_pull=c["a2a_pull"] * a2a_b
+                + c["fallback_pull"] * fb_b,
+                wire_bytes_push=c["a2a_push"] * a2a_b
+                + c["fallback_push"] * fb_b,
+                **c,
+            )
+        )
+    return rows
 
 
 def measure_bandwidth(  # jaxgate: host — wall-clock probe, never traced

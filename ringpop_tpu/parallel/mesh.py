@@ -415,9 +415,13 @@ class ShardedSim(CheckpointableMixin):
 from ringpop_tpu.ops.exchange import exchange_cap  # noqa: E402
 
 
-def _route_rows(rows, dest_l, src_l, axis: str, cap: int):
-    """Deliver row ``g`` of the sharded array to global row ``dest[g]``
-    (``dest`` a permutation), inside a shard_map body.
+def _route_rows_stats(rows, dest_l, src_l, axis: str, cap: int):
+    """:func:`_route_rows` plus the routing statistics the telemetry
+    plane drains: returns ``(routed, counts, overflow)`` where
+    ``counts`` is this shard's [S] destination-bucket occupancy (before
+    capping — mask- and cap-independent) and ``overflow`` the pmax-
+    agreed fallback verdict.  The stats are byproducts of the routing
+    math itself, so the plain wrapper traces the identical program.
 
     Fast path: bucket local rows by destination shard, pad each bucket
     to the static ``cap``, one ``all_to_all`` for the row payloads plus
@@ -464,7 +468,15 @@ def _route_rows(rows, dest_l, src_l, axis: str, cap: int):
         full = jax.lax.all_gather(rows, axis, axis=0, tiled=True)
         return full[src_l]
 
-    return jax.lax.cond(overflow, gather_fallback, a2a, None)
+    routed = jax.lax.cond(overflow, gather_fallback, a2a, None)
+    return routed, counts, overflow
+
+
+def _route_rows(rows, dest_l, src_l, axis: str, cap: int):
+    """Deliver row ``g`` of the sharded array to global row ``dest[g]``
+    — the stats-free view of :func:`_route_rows_stats` (same traced
+    program; the unused stats fall to dead-code elimination)."""
+    return _route_rows_stats(rows, dest_l, src_l, axis, cap)[0]
 
 
 @functools.lru_cache(maxsize=None)
@@ -473,6 +485,7 @@ def make_exchange_plane(
     impl: str,
     cap: Optional[int] = None,
     n: Optional[int] = None,
+    metrics: bool = False,
 ):
     """The shard_map'd direct-round exchange plane for the scalable
     engine (the round-14 tentpole), matching the engine seam
@@ -549,7 +562,105 @@ def make_exchange_plane(
             h_l, pulled, pushed, r_delta, impl=impl
         )
 
-    return plane
+    if not metrics:
+        return plane
+
+    from ringpop_tpu.ops import histogram as hg
+
+    t_pull = _exch.EXCH_HIST_TRACKS.index("cap_util_pull")
+    t_push = _exch.EXCH_HIST_TRACKS.index("cap_util_push")
+
+    @functools.partial(
+        shard_map,
+        mesh=mesh,
+        in_specs=(
+            P(axis, None),  # heard
+            P(),  # r_delta (replicated rumor table)
+            P(),  # active_words
+            P(axis),  # direct_ok
+            P(axis),  # partner0
+            P(axis),  # inv_base
+            P(axis, None),  # exch counters [S, len(EXCH_COUNTERS)]
+            P(axis, None, None),  # exch_hist [S, tracks, NBUCKETS]
+        ),
+        out_specs=(
+            P(axis, None),
+            P(axis),
+            P(axis, None),
+            P(axis, None, None),
+        ),
+        check_rep=False,
+    )
+    def plane_metrics(
+        h_l, r_delta, active_words, ok_l, fwd_l, inv_l, exch_l, eh_l
+    ):
+        # identical trajectory math to `plane` above — same routing
+        # calls, same mask order — plus write-only counter/histogram
+        # bumps from the routing stats that are byproducts anyway
+        local = h_l.shape[0]
+        pulled, cnt_pull, ovf_pull = _route_rows_stats(
+            h_l, inv_l, fwd_l, axes, cap
+        )
+        pulled = (
+            jnp.where(ok_l[:, None], pulled, 0) & active_words[None, :]
+        )
+        pushed, cnt_push, ovf_push = _route_rows_stats(
+            jnp.where(ok_l[:, None], h_l, 0), fwd_l, inv_l, axes, cap
+        )
+        pushed = pushed & active_words[None, :]
+
+        one = jnp.uint32(1)
+        # every sum pins dtype=uint32: under x64 jnp.sum would widen
+        # to uint64 and break the scan carry (exch is a uint32 plane)
+        # pull rows materialised here = my own direct_ok count
+        pull_rows = jnp.sum(ok_l.astype(jnp.uint32), dtype=jnp.uint32)
+        # push rows RECEIVED here: psum each shard's ok-masked
+        # per-destination send tally, then read my own slot (shard id
+        # = axis_index folded over the mesh axes in P() split order)
+        dst = fwd_l // jnp.int32(local)
+        sent = jnp.sum(
+            jnp.where(
+                ok_l[:, None],
+                (
+                    dst[:, None]
+                    == jnp.arange(shards, dtype=jnp.int32)[None, :]
+                ).astype(jnp.uint32),
+                jnp.uint32(0),
+            ),
+            axis=0,
+            dtype=jnp.uint32,
+        )
+        recv = jax.lax.psum(sent, axes)
+        idx = jnp.int32(0)
+        for a in axes:
+            idx = idx * jnp.int32(mesh.shape[a]) + jax.lax.axis_index(a)
+        push_rows = recv[idx]
+        # EXCH_COUNTERS order is the wire format — keep in lockstep
+        bump = jnp.stack(
+            [
+                one,  # ticks
+                one * (~ovf_pull).astype(jnp.uint32),  # a2a_pull
+                one * (~ovf_push).astype(jnp.uint32),  # a2a_push
+                one * ovf_pull.astype(jnp.uint32),  # fallback_pull
+                one * ovf_push.astype(jnp.uint32),  # fallback_push
+                pull_rows,
+                push_rows,
+                jnp.sum((cnt_pull > 0), dtype=jnp.uint32),
+                jnp.sum((cnt_push > 0), dtype=jnp.uint32),
+            ]
+        )
+        eh0 = hg.record(
+            eh_l[0], t_pull, cnt_pull, jnp.ones_like(cnt_pull, bool)
+        )
+        eh0 = hg.record(
+            eh0, t_push, cnt_push, jnp.ones_like(cnt_push, bool)
+        )
+        new_h, d_direct = _exch.exchange_local(
+            h_l, pulled, pushed, r_delta, impl=impl
+        )
+        return new_h, d_direct, exch_l + bump[None, :], eh0[None]
+
+    return plane_metrics
 
 
 # node-indexed ScalableState fields (sharded); everything else — the
@@ -566,14 +677,25 @@ def scalable_state_shardings(mesh: Mesh, params):
 
     axis = _node_axis(mesh)
     abstract = jax.eval_shape(lambda: es.init_state(params))
+
+    def _spec(f):
+        a = getattr(abstract, f)
+        if f in _SCALABLE_NODE_FIELDS:
+            return P(axis, *([None] * (a.ndim - 1)))
+        # per-shard telemetry planes shard over the mesh axis only when
+        # their leading dim IS the mesh size (exchange_metrics=shards,
+        # the shard_map-plane mode); any other divisor replicates
+        if (
+            f in es.SHARD_SHARDED_FIELDS
+            and a is not None
+            and a.shape[0] == int(mesh.devices.size)
+        ):
+            return P(axis, *([None] * (a.ndim - 1)))
+        return P()
+
     return type(abstract)(
         **{
-            f: NamedSharding(
-                mesh,
-                P(axis, *([None] * (getattr(abstract, f).ndim - 1)))
-                if f in _SCALABLE_NODE_FIELDS
-                else P(),
-            )
+            f: NamedSharding(mesh, _spec(f))
             for f in abstract._fields
         }
     )
@@ -613,11 +735,14 @@ def _storm_sample_inputs(n: int, structure_key):
 
 def _storm_plane(mesh: Mesh, params, plane_key):
     """Resolve a ShardedStorm plane_key — None (gspmd modes) or
-    ``(kernel_impl, cap-or-None)`` — to the shared compiled plane."""
+    ``(kernel_impl, cap-or-None, metrics)`` — to the shared compiled
+    plane."""
     if plane_key is None:
         return None
-    impl, cap = plane_key
-    return make_exchange_plane(mesh, impl, cap=cap, n=params.n)
+    impl, cap, metrics = plane_key
+    return make_exchange_plane(
+        mesh, impl, cap=cap, n=params.n, metrics=metrics
+    )
 
 
 @functools.lru_cache(maxsize=None)
@@ -724,8 +849,17 @@ class ShardedStorm(CheckpointableMixin):
             if mode == "shard_map"
             else None
         )
+        # the metrics flag rides the plane key: the telemetry-carrying
+        # plane is a DIFFERENT shard_map program (8-in/4-out), cached
+        # separately in make_exchange_plane's lru table
         self._plane_key = (
-            (impl, exchange_cap_override) if mode == "shard_map" else None
+            (
+                impl,
+                exchange_cap_override,
+                bool(self.params.exchange_metrics),
+            )
+            if mode == "shard_map"
+            else None
         )
         # the params the ENGINE traces with: under the plane the seam
         # bypasses fused_exchange, but pin it to the per-shard kernel so
@@ -759,6 +893,17 @@ class ShardedStorm(CheckpointableMixin):
         if n % shards:
             raise ValueError(
                 "n=%d not divisible by mesh size %d" % (n, shards)
+            )
+        if mode == "shard_map" and self.params.exchange_metrics not in (
+            0,
+            shards,
+        ):
+            # the plane accumulates one counter row per MESH shard; a
+            # foreign bucket count would silently mislabel the wire
+            raise ValueError(
+                "exchange_metrics=%d must equal the mesh size (%d) under "
+                "the shard_map plane (or 0 to disable)"
+                % (self.params.exchange_metrics, shards)
             )
         self._st_sh = scalable_state_shardings(self.mesh, self.params)
         self.state = jax.device_put(
@@ -850,6 +995,47 @@ class ShardedStorm(CheckpointableMixin):
         if not bool(self.params.checksum_in_tick):
             return np.asarray(es.compute_checksums(self.state, self.params))
         return np.asarray(self.state.checksum)
+
+    # -- exchange telemetry (ScalableParams.exchange_metrics) -------------
+
+    def drain_exchange_metrics(self, reset: bool = True, statsd=None):
+        """Drain the per-shard exchange telemetry plane (counters +
+        cap-utilization histograms) through the shared host half
+        (obs.exchange_stats.drain): per-shard ``mesh.exchange.drain``
+        runlog rows on the attached recorder, ``sharded.exchange.*``
+        statsd keys, wire-byte totals for the traffic-model gate.
+        ``reset`` zeroes the device counters AFTER the sinks ran."""
+        if self.state.exch is None:
+            raise ValueError(
+                "exchange telemetry is off — construct with "
+                "ScalableParams(exchange_metrics=<mesh size>)"
+            )
+        from ringpop_tpu.obs import exchange_stats as oxs
+        from ringpop_tpu.ops import exchange as _exch
+
+        counters = np.asarray(self.state.exch)
+        hist = np.asarray(self.state.exch_hist)
+        s = int(counters.shape[0])
+        summary = oxs.drain(
+            counters,
+            hist,
+            w=int(self.state.heard.shape[1]),
+            cap=self.exchange_cap,
+            local_rows=self.params.n // s,
+            source="sim.engine_scalable[mesh]",
+            recorder=self.recorder,
+            statsd=statsd,
+        )
+        if reset:
+            self.state = self.state._replace(
+                exch=jax.device_put(
+                    _exch.init_exchange_counters(s), self._st_sh.exch
+                ),
+                exch_hist=jax.device_put(
+                    _exch.init_exchange_hist(s), self._st_sh.exch_hist
+                ),
+            )
+        return summary
 
     # -- checkpoint/resume (models/sim/recovery.py) -----------------------
     # Node-sharded fields (engine_scalable.NODE_SHARDED_FIELDS) split

@@ -111,6 +111,57 @@ ROUTE_EVENT_FIELDS = {
         "node_ticks_per_sec",
         "bitwise_equal",
     ),
+    # round-17 mesh observatory: every per-shard exchange drain row
+    # carries the full ExchangeMetrics counter set plus the window's
+    # identity — kept in lockstep with ops.exchange.ExchangeMetrics and
+    # obs.exchange_stats.EXCHANGE_DRAIN_EXTRAS by
+    # tests/obs/test_runlog_schema.py
+    "mesh.exchange.drain": (
+        "source",
+        "shards",
+        "w",
+        "cap",
+        "local_rows",
+        "shard",
+        "ticks",
+        "a2a_pull",
+        "a2a_push",
+        "fallback_pull",
+        "fallback_push",
+        "pull_rows",
+        "push_rows",
+        "dest_shards_pull",
+        "dest_shards_push",
+        "wire_bytes_pull",
+        "wire_bytes_push",
+    ),
+    # measured-vs-model reconciliation rows (obs.exchange_stats.reconcile
+    # + a source tag): both byte totals must ship so a logged window is
+    # auditable without rerunning the storm
+    "traffic_reconcile": (
+        "source",
+        "shards",
+        "n",
+        "w",
+        "cap",
+        "ticks",
+        "measured_interconnect",
+        "model_interconnect",
+        "ratio",
+        "fallback_trips",
+    ),
+    # profiler capture rows (obs.xprof.XPROF_FIELDS — pinned by
+    # tests/obs/test_runlog_schema.py): every capture names its phase
+    # and trace artifact even when the capture itself failed (ok=False)
+    "xprof.capture": (
+        "phase",
+        "ok",
+        "wall_s",
+        "trace_dir",
+        "num_trace_files",
+        "total_self_us",
+        "ops",
+    ),
 }
 
 

@@ -32,6 +32,16 @@ plus the position planes).  ``cross_to_local_ratio`` < 1 means the plane
 is local-bandwidth-bound (the kernel still dominates); >> 1 means
 interconnect-bound and the cap/slack sizing is the lever.
 
+Round 17 (the mesh observatory) adds MEASURED columns next to the model:
+``cross_shard_measured`` runs the sharded storm on small forced-host-
+device meshes with the exchange telemetry plane on
+(ScalableParams.exchange_metrics), drains the per-shard wire counters,
+and reports measured interconnect bytes / ratio-to-model from the SAME
+reconciliation path the traffic gate checks
+(obs.exchange_stats.reconcile; scripts/check_traffic_model.py) — the
+(S-1)/S cross-fraction claim as a number observed on the wire, not just
+derived from it.
+
 Writes PROF_EXCHANGE_ROOFLINE.json; CPU runs are explicitly marked
 (platform + peak_gbps null, interpret flag on the pallas rows) so nobody
 mistakes them for chip numbers.  PROF_ROOFLINE_FORCE_CPU=1 skips the TPU
@@ -45,6 +55,32 @@ import os
 import sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# the measured cross-shard rows need a multi-device mesh: force the
+# host-platform split before jax initializes (no-op for a TPU backend;
+# same lever as tests/conftest.py and scripts/check_traffic_model.py).
+# The flag spelling lives in utils/util.force_host_device_count alone
+# (round 14); loaded by FILE PATH because the package import pulls jax.
+if "jax" not in sys.modules:
+    import importlib.util as _ilu
+
+    _spec = _ilu.spec_from_file_location(
+        "_ringpop_util_boot",
+        os.path.join(
+            os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+            "ringpop_tpu",
+            "utils",
+            "util.py",
+        ),
+    )
+    _util_boot = _ilu.module_from_spec(_spec)
+    _spec.loader.exec_module(_util_boot)
+    if (
+        "xla_force_host_platform_device_count"
+        not in os.environ.get("XLA_FLAGS", "")
+        and "JAX_NUM_CPU_DEVICES" not in os.environ
+    ):
+        _util_boot.force_host_device_count(8)
 
 OUT = os.environ.get("PROF_EXCHANGE_OUT", "PROF_EXCHANGE_ROOFLINE.json")
 # v5e-class chip HBM peak; only attached to TPU measurements
@@ -140,6 +176,49 @@ def measure_shape(res: dict, n: int, u: int) -> None:
     res["shape_%dx%d" % (n, u)] = shape_res
 
 
+def measure_cross_shard(res: dict, n: int = 4096, u: int = 512) -> None:
+    """Measured interconnect bytes per mesh size (round 17): a short
+    telemetry-instrumented storm per shard count, drained and reconciled
+    against the analytic model.  Sized down from the bandwidth shapes —
+    the exchange cap scales with N/S, so the RATIO (the claim under
+    test) is shape-independent while the run stays seconds on CPU."""
+    import jax
+
+    from ringpop_tpu.models.sim import engine_scalable as es
+    from ringpop_tpu.obs import exchange_stats as oxs
+    from ringpop_tpu.parallel import mesh as pmesh
+
+    ticks = 4
+    out: dict = {"n": n, "u": u, "ticks": ticks}
+    for shards in (2, 4, 8):
+        key = "shards_%d" % shards
+        if n % shards or jax.local_device_count() < shards:
+            out[key] = {
+                "error": "need %d devices, have %d"
+                % (shards, jax.local_device_count())
+            }
+            continue
+        try:
+            params = es.ScalableParams(
+                n=n, u=u, exchange_metrics=shards
+            )
+            storm = pmesh.ShardedStorm(
+                n, mesh=pmesh.make_mesh(shards), params=params
+            )
+            if storm.exchange_mode != "shard_map":
+                out[key] = {
+                    "error": "exchange mode %r" % (storm.exchange_mode,)
+                }
+                continue
+            for _ in range(ticks):
+                storm.step()
+            drained = storm.drain_exchange_metrics(reset=False)
+            out[key] = oxs.reconcile(drained["totals"], n=n, w=u // 32)
+        except Exception as e:
+            out[key] = {"error": str(e)[:300]}
+    res["cross_shard_measured"] = out
+
+
 def main() -> int:
     from ringpop_tpu.utils.util import scrub_repo_pythonpath
 
@@ -178,6 +257,7 @@ def main() -> int:
         shapes.append((1_000_000, 512))
     for n, u in shapes:
         measure_shape(res, n, u)
+    measure_cross_shard(res)
     for key, sr in res.items():
         if not key.startswith("shape_") or not res.get("peak_gbps"):
             continue

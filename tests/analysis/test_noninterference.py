@@ -91,6 +91,28 @@ def test_flight_recorder_leak_is_caught():
     ), "\n" + render_text(findings)
 
 
+def test_exchange_telemetry_leak_is_caught():
+    """Round-17 mesh observatory: an exchange counter steering the tick
+    clock must fail — the exact leak class the bitwise telemetry-on/off
+    A/B gate (tests/parallel/test_exchange_telemetry.py) samples
+    dynamically."""
+    fn, args = BY_NAME["engine-scalable-tick-exchange-metrics"].build()
+
+    def doctored(state, inputs):
+        st, metrics = fn(state, inputs)
+        return st._replace(
+            tick_index=st.tick_index + st.exch[0, 0].astype(jnp.int32)
+        ), metrics
+
+    findings = ni.check_entry("doctored-exch", doctored, args)
+    assert any(
+        f.rule == "obs-interference"
+        and "ScalableState.exch" in f.message
+        and "ScalableState.tick_index" in f.message
+        for f in findings
+    ), "\n" + render_text(findings)
+
+
 def test_obs_to_obs_and_obs_to_metrics_flows_are_allowed():
     """Obs planes legitimately read themselves (append offsets) — only
     trajectory outputs are protected; metrics are observability sinks."""

@@ -89,6 +89,29 @@ def test_drift_detection_both_directions():
     assert any("no manifest entry" in f.message for f in findings)
 
 
+def test_probe_baseline_immune_to_suite_order_pollution():
+    # pjit caches key on the UNDERLYING callable: jitting the lru-shared
+    # exchange-plane fixture at an off-budget shape (what any earlier
+    # test in a full-suite run can do) used to pre-load the probe's
+    # wrapper with a foreign cache entry and shift every step count up
+    # — the round-12 test_all_probes_match_committed_manifest flake.
+    # run_probe now clears the jit caches per probe, so the canonical
+    # [1, 1, 2] sequence must survive deliberate pollution.
+    import jax
+
+    from ringpop_tpu.analysis import jaxpr_audit as ja
+
+    plane = ja._plane_fixture()
+    polluter = jax.jit(plane)
+    polluter(*ja._plane_args(8, 16, 5))  # off-budget [8,16] mask shape
+    assert polluter._cache_size() >= 1
+    probe = next(
+        p for p in retrace.DEFAULT_PROBES if p.name == "exchange-plane"
+    )
+    steps = retrace.run_probe(probe)
+    assert [s["cache_size"] for s in steps] == [1, 1, 2]
+
+
 def test_broken_probe_is_a_finding_not_a_crash(tmp_path):
     def boom():
         raise RuntimeError("entry point renamed")

@@ -1,0 +1,160 @@
+"""Measured-vs-model traffic gate (scripts/check_traffic_model.py +
+TRAFFIC_BUDGET.json).
+
+Tier-1 wiring mirrors test_cost_budget.py: a cheap-probe subset (the
+2-shard mesh at n=64) is measured and diffed against the committed
+manifest every run; the mutation tests prove the gate FIRES on a
+doctored manifest and on a measured-vs-model break."""
+
+import importlib.util as ilu
+import json
+import os
+from pathlib import Path
+
+import jax
+import pytest
+
+# one cheap config (2-shard mesh, n=64, 8 ticks — seconds warm); the
+# full 2/4/8 sweep belongs to the script / the mesh telemetry tests
+CHEAP_TRAFFIC_ENTRIES = ("mesh-s2-n64",)
+
+
+def _script():
+    spec = ilu.spec_from_file_location(
+        "check_traffic_model",
+        os.path.join(
+            os.path.dirname(__file__), "..", "..", "scripts",
+            "check_traffic_model.py",
+        ),
+    )
+    mod = ilu.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+@pytest.fixture(scope="module")
+def mod():
+    return _script()
+
+
+def test_cheap_probe_subset_matches_committed_manifest(mod):
+    if len(jax.devices()) < 2:
+        pytest.skip("needs 2 virtual devices")
+    findings = mod.check_against_manifest(
+        entry_names=CHEAP_TRAFFIC_ENTRIES
+    )
+    from ringpop_tpu.analysis.findings import render_text
+
+    assert findings == [], "\n" + render_text(findings)
+
+
+def test_manifest_covers_every_mesh_config(mod):
+    manifest = mod.load_manifest()
+    assert manifest is not None, "TRAFFIC_BUDGET.json not committed"
+    names = {mod.entry_name(c) for c in mod.MESH_CONFIGS}
+    assert set(manifest["entries"]) == names
+    for e in manifest["entries"].values():
+        assert "error" not in e
+        # the committed windows reconcile exactly: every trip a2a
+        assert e["ratio"] == 1.0
+        assert e["fallback_trips"] == 0
+        assert e["measured_interconnect"] == e["model_interconnect"]
+
+
+def test_script_exits_nonzero_on_doctored_manifest(mod, tmp_path, capsys):
+    """End-to-end proof the CI gate fires: perturb the committed
+    measured bytes (a silently changed wire format) and diff mode exits
+    non-zero; the pristine manifest exits zero."""
+    if len(jax.devices()) < 2:
+        pytest.skip("needs 2 virtual devices")
+    manifest = mod.load_manifest()
+    pristine = tmp_path / "ok.json"
+    doctored = tmp_path / "bad.json"
+    pristine.write_text(json.dumps(manifest))
+    bad = json.loads(json.dumps(manifest))
+    bad["entries"]["mesh-s2-n64"]["measured_interconnect"] *= 3
+    bad["entries"]["mesh-s2-n64"]["model_interconnect"] *= 3
+    doctored.write_text(json.dumps(bad))
+    args = ["--entries", ",".join(CHEAP_TRAFFIC_ENTRIES)]
+    assert mod.main(args + ["--budget", str(pristine)]) == 0
+    assert mod.main(args + ["--budget", str(doctored)]) == 1
+
+
+def test_reconcile_finding_fires_on_model_break(mod):
+    """The manifest-free layer: measured bytes off the analytic model
+    by more than rtol is a finding even with a colluding manifest."""
+    actual = {
+        "mesh-s2-n64": {
+            "shards": 2,
+            "n": 64,
+            "w": 4,
+            "cap": 32,
+            "ticks": 8,
+            "measured_interconnect": 30000,
+            "model_interconnect": 20480,
+            "ratio": 1.46,
+            "fallback_trips": 0,
+        }
+    }
+    findings = mod.reconcile_findings(actual)
+    assert len(findings) == 1
+    assert "exceeds rtol" in findings[0].message
+    assert findings[0].prong == "traffic"
+    # a failed measurement is a finding too, not a silent skip
+    failed = mod.reconcile_findings({"x": {"error": "boom"}})
+    assert len(failed) == 1 and "measurement failed" in failed[0].message
+
+
+def test_compare_flags_identity_and_band_drift(mod):
+    entry = {
+        "shards": 2,
+        "n": 64,
+        "w": 4,
+        "cap": 32,
+        "ticks": 8,
+        "measured_interconnect": 20480,
+        "model_interconnect": 20480,
+        "ratio": 1.0,
+        "fallback_trips": 0,
+    }
+    manifest = {"entries": {"mesh-s2-n64": dict(entry)}}
+    assert mod.compare_to_manifest({"mesh-s2-n64": dict(entry)}, manifest) == []
+    # identity fields are exact: a cap change at equal bytes still fires
+    recapped = dict(entry, cap=16)
+    findings = mod.compare_to_manifest({"mesh-s2-n64": recapped}, manifest)
+    assert any("cap changed" in f.message for f in findings)
+    # banded fields tolerate rtol, fire beyond it
+    drifted = dict(entry, measured_interconnect=30000)
+    findings = mod.compare_to_manifest({"mesh-s2-n64": drifted}, manifest)
+    assert any("drifted" in f.message for f in findings)
+    # missing/extra entries both fire
+    findings = mod.compare_to_manifest(
+        {"other": dict(entry)}, manifest
+    )
+    msgs = "\n".join(f.message for f in findings)
+    assert "not measured" in msgs and "no manifest entry" in msgs
+
+
+def test_write_manifest_refuses_failed_entries(mod, tmp_path):
+    with pytest.raises(ValueError, match="refusing"):
+        mod.write_manifest(
+            {"good": {"shards": 2}, "broken": {"error": "boom"}},
+            tmp_path / "m.json",
+        )
+
+
+def test_backend_mismatch_skips_cleanly(mod, tmp_path):
+    other = {
+        "backend": "tpu" if jax.default_backend() != "tpu" else "cpu",
+        "entries": {"mesh-s2-n64": {"shards": 2}},
+    }
+    p = tmp_path / "other.json"
+    p.write_text(json.dumps(other))
+    assert mod.check_against_manifest(("mesh-s2-n64",), Path(p)) == []
+
+
+def test_missing_manifest_is_a_finding(mod, tmp_path):
+    findings = mod.check_against_manifest(
+        ("mesh-s2-n64",), tmp_path / "nope.json"
+    )
+    assert len(findings) == 1 and "missing manifest" in findings[0].message

@@ -1,0 +1,147 @@
+"""Host half of the mesh exchange telemetry (obs.exchange_stats):
+exact wire-byte pricing, the (S-1)/S interconnect fraction, reconcile
+identities, and schema-valid runlog/statsd emission from drain()."""
+
+from __future__ import annotations
+
+import importlib.util
+import os
+
+import numpy as np
+import pytest
+
+from ringpop_tpu.obs import exchange_stats as oxs
+from ringpop_tpu.obs.recorder import RunRecorder
+from ringpop_tpu.obs.statsd_bridge import StatsdBridge
+from ringpop_tpu.ops import exchange as exch
+from ringpop_tpu.utils.stats import CapturingStatsd
+
+REPO_ROOT = os.path.dirname(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+
+
+def _counters_2shard():
+    """A hand-built 2-shard window: 2 ticks, one push trip fell back."""
+    c = np.zeros((2, len(exch.EXCH_COUNTERS)), np.uint32)
+    idx = {f: i for i, f in enumerate(exch.EXCH_COUNTERS)}
+    for s in range(2):
+        c[s, idx["ticks"]] = 2
+        c[s, idx["a2a_pull"]] = 2
+        c[s, idx["a2a_push"]] = 1
+        c[s, idx["fallback_push"]] = 1
+        c[s, idx["pull_rows"]] = 5 + s
+        c[s, idx["push_rows"]] = 6 - s
+        c[s, idx["dest_shards_pull"]] = 4
+        c[s, idx["dest_shards_push"]] = 3
+    return c
+
+
+def test_drain_counters_price_wire_bytes_exactly():
+    w, local = 4, 4
+    rows = exch.drain_exchange_counters(
+        _counters_2shard(), w=w, cap=None, local_rows=local
+    )
+    assert [r.shard for r in rows] == [0, 1]
+    cap = exch.exchange_cap(local, 2)
+    a2a = exch.a2a_trip_bytes(w, 2, cap)
+    fb = exch.fallback_trip_bytes(local, w, 2)
+    for r in rows:
+        assert r.wire_bytes_pull == 2 * a2a
+        assert r.wire_bytes_push == 1 * a2a + 1 * fb
+    assert rows[0].pull_rows == 5 and rows[1].pull_rows == 6
+
+
+def test_totals_and_interconnect_fraction():
+    rows = exch.drain_exchange_counters(
+        _counters_2shard(), w=4, cap=None, local_rows=4
+    )
+    tot = oxs.totals(rows)
+    assert tot["shards"] == 2
+    assert tot["pull_rows"] == 11 and tot["push_rows"] == 11
+    full = tot["wire_bytes_pull"] + tot["wire_bytes_push"]
+    # exactly the (S-1)/S fraction of the full buffers crosses shards
+    assert oxs.measured_interconnect_bytes(tot) == full * 1 // 2
+    # degenerate single shard: nothing crosses
+    assert oxs.measured_interconnect_bytes({"shards": 1}) == 0
+
+
+def test_reconcile_is_exact_without_fallbacks():
+    """Construct totals straight from the model: ratio must be 1.0."""
+    n, w, s, ticks = 64, 4, 4, 8
+    cap = exch.exchange_cap(n // s, s)
+    per_tick = s * exch.a2a_trip_bytes(w, s, cap)
+    tot = {
+        "shards": s,
+        "ticks": s * ticks,
+        "fallback_pull": 0,
+        "fallback_push": 0,
+        "wire_bytes_pull": per_tick * ticks,
+        "wire_bytes_push": per_tick * ticks,
+    }
+    rec = oxs.reconcile(tot, n=n, w=w)
+    assert rec["ticks"] == ticks
+    assert rec["measured_interconnect"] == rec["model_interconnect"]
+    assert rec["ratio"] == 1.0
+    assert rec["fallback_trips"] == 0
+
+
+def _load_checker():
+    spec = importlib.util.spec_from_file_location(
+        "check_metrics_schema",
+        os.path.join(REPO_ROOT, "scripts", "check_metrics_schema.py"),
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_drain_emits_schema_valid_rows_and_statsd_keys(tmp_path):
+    path = str(tmp_path / "drain.runlog.jsonl")
+    cap = CapturingStatsd()
+    bridge = StatsdBridge(statsd=cap, host_port="127.0.0.1:4080")
+    hist = np.asarray(exch.init_exchange_hist(2))
+    with RunRecorder(path, config={}) as rec:
+        summary = oxs.drain(
+            _counters_2shard(),
+            hist,
+            w=4,
+            local_rows=4,
+            source="test",
+            recorder=rec,
+            statsd=bridge,
+        )
+    assert summary["totals"]["shards"] == 2
+    assert summary["reconcile"]["shards"] == 2
+    assert set(summary["cap_util"]) == set(exch.EXCH_HIST_TRACKS)
+    # one drain row per shard + one reconcile row, all schema-valid
+    problems = _load_checker().check([path], verbose=False)
+    assert problems == [], "\n".join(problems)
+    import json
+
+    with open(path) as fh:
+        rows = [json.loads(line) for line in fh]
+    names = [r.get("name") for r in rows if r.get("kind") == "event"]
+    assert names.count(oxs.EXCHANGE_DRAIN_EVENT) == 2
+    assert names.count(oxs.TRAFFIC_RECONCILE_EVENT) == 1
+    # statsd saw the summed counters
+    keys = {r[1] for r in cap.records}
+    assert "ringpop.127_0_0_1_4080.sharded.exchange.ticks" in keys
+
+
+def test_sinks_run_before_any_reset_can_happen():
+    """A raising sink propagates — the caller must not have reset the
+    device window yet (the drain contract both drivers rely on)."""
+
+    class Boom:
+        def record_event(self, *a, **k):
+            raise RuntimeError("sink down")
+
+    with pytest.raises(RuntimeError, match="sink down"):
+        oxs.drain(
+            _counters_2shard(),
+            w=4,
+            local_rows=4,
+            source="test",
+            recorder=Boom(),
+        )

@@ -135,6 +135,82 @@ def test_route_event_rows_validated(tmp_path):
     assert checker.check([str(log)], verbose=False) == []
 
 
+def test_exchange_drain_fields_stay_in_lockstep_with_exchange_metrics():
+    # the round-17 drain-row required set IS ExchangeMetrics (+ the
+    # window-identity extras) — a renamed counter or a forgotten
+    # validator update fails here, same pin as the RouteMetrics gate
+    from ringpop_tpu.obs import exchange_stats as oxs
+    from ringpop_tpu.obs import xprof
+    from ringpop_tpu.ops.exchange import ExchangeMetrics
+
+    checker = _load_checker()
+    assert set(checker.ROUTE_EVENT_FIELDS["mesh.exchange.drain"]) == set(
+        oxs.EXCHANGE_DRAIN_EXTRAS
+    ) | set(ExchangeMetrics._fields)
+    assert set(checker.ROUTE_EVENT_FIELDS["traffic_reconcile"]) == {
+        "source"
+    } | set(
+        oxs.reconcile(
+            {
+                "shards": 2,
+                "ticks": 2,
+                "fallback_pull": 0,
+                "fallback_push": 0,
+                "wire_bytes_pull": 0,
+                "wire_bytes_push": 0,
+            },
+            n=8,
+            w=4,
+        )
+    )
+    assert (
+        checker.ROUTE_EVENT_FIELDS["xprof.capture"] == xprof.XPROF_FIELDS
+    )
+
+
+def test_observatory_event_rows_validated(tmp_path):
+    """Round-17 observatory events: a drain row missing a counter, a
+    reconcile row missing its model bytes, or an xprof row missing its
+    trace pointer is a drifted recorder, not a valid artifact."""
+    import json
+
+    checker = _load_checker()
+    log = tmp_path / "obsrv.runlog.jsonl"
+    good_drain = {"kind": "event", "name": "mesh.exchange.drain"}
+    good_drain.update(
+        {f: 1 for f in checker.ROUTE_EVENT_FIELDS["mesh.exchange.drain"]}
+    )
+    bad_drain = dict(good_drain)
+    del bad_drain["wire_bytes_pull"]
+    log.write_text(
+        "\n".join(
+            [
+                _header_line(),
+                json.dumps(good_drain),
+                json.dumps(bad_drain),
+                json.dumps({"kind": "event", "name": "traffic_reconcile"}),
+                json.dumps({"kind": "event", "name": "xprof.capture"}),
+            ]
+        )
+        + "\n"
+    )
+    problems = checker.check([str(log)], verbose=False)
+    assert any(
+        "mesh.exchange.drain event missing 'wire_bytes_pull'" in p
+        for p in problems
+    )
+    assert any(
+        "traffic_reconcile event missing 'model_interconnect'" in p
+        for p in problems
+    )
+    assert any(
+        "xprof.capture event missing 'trace_dir'" in p for p in problems
+    )
+    # a complete drain row alone passes
+    log.write_text(_header_line() + "\n" + json.dumps(good_drain) + "\n")
+    assert checker.check([str(log)], verbose=False) == []
+
+
 def test_mesh_event_rows_validated(tmp_path):
     """Round-14 mesh-plane events: a weak_scaling row without its gate
     verdict (or a mesh_window without its shard count) is a drifted

@@ -111,6 +111,96 @@ def test_bridge_requires_a_sink():
         StatsdBridge(statsd=CapturingStatsd())  # host_port missing
 
 
+def test_exchange_key_map_stays_in_lockstep_with_exchange_metrics():
+    """Round-17 mesh observatory keys: every ExchangeMetrics counter
+    (minus the shard id) maps to an increment, the shard count to a
+    gauge, and every cap-utilization track to a timer key — drift in
+    either direction (a renamed counter, a forgotten key) fails here."""
+    from ringpop_tpu.obs.statsd_bridge import (
+        EXCHANGE_HIST_KEYS,
+        EXCHANGE_KEY_MAP,
+        XPROF_KEY_MAP,
+    )
+    from ringpop_tpu.ops.exchange import EXCH_HIST_TRACKS, ExchangeMetrics
+
+    counters = set(ExchangeMetrics._fields) - {"shard"}
+    assert set(EXCHANGE_KEY_MAP) == counters | {"shards"}
+    for f in counters:
+        assert EXCHANGE_KEY_MAP[f][0] == "increment", f
+    assert EXCHANGE_KEY_MAP["shards"][0] == "gauge"
+    assert set(EXCHANGE_HIST_KEYS) == set(EXCH_HIST_TRACKS)
+    # xprof: capture wall time is a TIMER (|ms), op count a gauge
+    assert XPROF_KEY_MAP["wall_s"][0] == "timing"
+    assert XPROF_KEY_MAP["ops"][0] == "gauge"
+
+
+def test_emit_exchange_drain_wire_types():
+    """Counters emit as nonzero-only increments, the shard count always
+    as a gauge, all under the fq-key scheme."""
+    cap = CapturingStatsd()
+    bridge = StatsdBridge(statsd=cap, host_port="127.0.0.1:4060")
+    tot = {
+        "shards": 4,
+        "ticks": 8,
+        "a2a_pull": 8,
+        "a2a_push": 8,
+        "fallback_pull": 0,  # zero counter: suppressed
+        "fallback_push": 0,
+        "pull_rows": 100,
+        "push_rows": 100,
+        "dest_shards_pull": 30,
+        "dest_shards_push": 31,
+        "wire_bytes_pull": 1024,
+        "wire_bytes_push": 1024,
+        "not_a_counter": 7,  # unmapped: ignored
+    }
+    emitted = bridge.emit_exchange_drain(tot)
+    prefix = "ringpop.127_0_0_1_4060."
+    incs = {r[1]: r[2] for r in cap.records if r[0] == "increment"}
+    assert incs[prefix + "sharded.exchange.wire-bytes.pull"] == 1024
+    assert incs[prefix + "sharded.exchange.spread.push"] == 31
+    assert not any("fallback" in r[1] for r in cap.records)
+    assert not any("not_a_counter" in r[1] for r in cap.records)
+    gauges = [r for r in cap.records if r[0] == "gauge"]
+    assert gauges == [("gauge", prefix + "sharded.exchange.shards", 4)]
+    assert emitted == len(cap.records)
+
+
+def test_exchange_hist_summary_emits_timer_quantiles():
+    from ringpop_tpu.obs.statsd_bridge import EXCHANGE_HIST_KEYS
+
+    cap = CapturingStatsd()
+    bridge = StatsdBridge(statsd=cap, host_port="127.0.0.1:4061")
+    summary = {
+        "cap_util_pull": {"count": 3, "p50": 2.0, "p95": 4.0, "p99": None},
+        "cap_util_push": {"count": 0, "p50": None, "p95": None, "p99": None},
+    }
+    assert bridge.emit_hist_summary(summary, key_map=EXCHANGE_HIST_KEYS) == 2
+    prefix = "ringpop.127_0_0_1_4061."
+    assert cap.records == [
+        ("timing", prefix + "sharded.exchange.cap-util.pull.p50", 2.0),
+        ("timing", prefix + "sharded.exchange.cap-util.pull.p95", 4.0),
+    ]
+
+
+def test_xprof_emit_wire_types():
+    """obs.xprof stamps capture wall time as a |ms timer and the
+    attributed-op count as a gauge through the bridge's public seams."""
+    from ringpop_tpu.obs import xprof
+
+    cap = CapturingStatsd()
+    bridge = StatsdBridge(statsd=cap, host_port="127.0.0.1:4070")
+    row = {"phase": "p", "ok": True, "wall_s": 0.25, "ops": [{"name": "x"}]}
+    xprof._emit(row, None, bridge)
+    prefix = "ringpop.127_0_0_1_4070."
+    assert ("timing", prefix + "xprof.capture", 250.0) in cap.records
+    assert ("gauge", prefix + "xprof.ops", 1) in cap.records
+    # a failed capture (no wall clock) still reports the zero op count
+    cap.records.clear()
+    xprof._emit({"phase": "p", "ok": False, "wall_s": None}, None, bridge)
+    assert cap.records == [("gauge", prefix + "xprof.ops", 0)]
+
+
 def test_key_map_covers_both_engines():
     from ringpop_tpu.models.sim.engine import TickMetrics
     from ringpop_tpu.models.sim.engine_scalable import ScalableMetrics

@@ -1,0 +1,124 @@
+"""Profiler trace harness (obs.xprof): Chrome-trace digestion, the
+fuzzy COST_BUDGET keying, and a live capture round trip whose runlog
+row validates against the metrics schema."""
+
+from __future__ import annotations
+
+import gzip
+import importlib.util
+import json
+import os
+
+from ringpop_tpu.obs import xprof
+
+REPO_ROOT = os.path.dirname(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+
+
+def _write_trace(path, events, bare=False):
+    doc = events if bare else {"traceEvents": events}
+    raw = json.dumps(doc).encode()
+    if str(path).endswith(".gz"):
+        with gzip.open(path, "wb") as fh:
+            fh.write(raw)
+    else:
+        with open(path, "wb") as fh:
+            fh.write(raw)
+
+
+EVENTS = [
+    {"ph": "X", "name": "fusion.exchange", "dur": 40.0, "ts": 0},
+    {"ph": "X", "name": "fusion.exchange", "dur": 10.0, "ts": 1},
+    {"ph": "X", "name": "all-to-all", "dur": 30.0, "ts": 2},
+    {"ph": "X", "name": "copy", "dur": 5.0, "ts": 3},
+    {"ph": "X", "name": "zero-dur-marker", "dur": 0, "ts": 4},  # dropped
+    {"ph": "M", "name": "process_name", "args": {}},  # metadata: dropped
+]
+
+
+def test_load_trace_events_gzip_and_bare_list(tmp_path):
+    gz = tmp_path / "plugins" / "profile" / "run1" / "t.trace.json.gz"
+    gz.parent.mkdir(parents=True)
+    _write_trace(gz, EVENTS)
+    assert xprof.load_trace_events(str(gz)) == EVENTS
+    plain = tmp_path / "bare.trace.json"
+    _write_trace(plain, EVENTS, bare=True)
+    assert xprof.load_trace_events(str(plain)) == EVENTS
+    # discovery finds the gz under the profiler's nested layout
+    assert xprof.find_trace_files(str(tmp_path)) == [str(gz)]
+
+
+def test_op_table_aggregates_and_ranks():
+    ops, total = xprof.op_table(EVENTS, top_k=2)
+    assert total == 85.0
+    assert [o["name"] for o in ops] == ["fusion.exchange", "all-to-all"]
+    assert ops[0]["self_us"] == 50.0 and ops[0]["count"] == 2
+
+
+def test_match_budget_entry_token_overlap():
+    entries = ["exchange-plane", "engine-scalable-tick"]
+    assert (
+        xprof.match_budget_entry("fusion.exchange_plane.1", entries)
+        == "exchange-plane"
+    )
+    assert (
+        xprof.match_budget_entry("scalable_tick_scan", entries)
+        == "engine-scalable-tick"
+    )
+    assert xprof.match_budget_entry("copy.42", entries) is None
+    assert xprof.match_budget_entry("anything", None) is None
+
+
+def _load_checker():
+    spec = importlib.util.spec_from_file_location(
+        "check_metrics_schema",
+        os.path.join(REPO_ROOT, "scripts", "check_metrics_schema.py"),
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_capture_round_trip_stamps_schema_valid_row(tmp_path):
+    import jax
+    import jax.numpy as jnp
+
+    from ringpop_tpu.obs.recorder import RunRecorder
+
+    x = jnp.arange(1024, dtype=jnp.float32)
+    run = jax.jit(lambda: jnp.sum(x * x))
+    path = str(tmp_path / "xprof.runlog.jsonl")
+    with RunRecorder(path, config={}) as rec:
+        row = xprof.capture(
+            run,
+            str(tmp_path / "trace"),
+            phase="unit",
+            warmup=1,
+            repeats=1,
+            recorder=rec,
+        )
+    assert row["ok"], row.get("error")
+    assert row["num_trace_files"] >= 1
+    assert row["wall_s"] is not None
+    assert row["total_self_us"] > 0
+    assert row["ops"], "no ops attributed"
+    problems = _load_checker().check([path], verbose=False)
+    assert problems == [], "\n".join(problems)
+    # the console rendering carries the headline + every op line
+    text = xprof.render_table(row)
+    assert "xprof[unit]" in text and row["ops"][0]["name"][:40] in text
+
+
+def test_capture_failure_is_a_row_not_an_exception(tmp_path, monkeypatch):
+    import jax
+    import jax.numpy as jnp
+
+    run = jax.jit(lambda: jnp.zeros(8).sum())
+    monkeypatch.setattr(xprof, "find_trace_files", lambda d: [])
+    row = xprof.capture(
+        run, str(tmp_path / "trace"), phase="unit", warmup=0
+    )
+    assert row["ok"] is False
+    assert "no trace files" in row["error"]
+    assert "error" in xprof.render_table(row)

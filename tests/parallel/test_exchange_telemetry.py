@@ -1,0 +1,236 @@
+"""Per-shard exchange telemetry plane (round 17, ISSUE 16 tentpole a).
+
+The flight-recorder gates: with ``ScalableParams.exchange_metrics`` on,
+(1) the mesh plane's device counters/histograms are bitwise-identical
+to the single-device analytic twin's at every shard count (1/2/4/8 on
+the virtual 8-device CPU mesh), (2) the drained per-shard rows sum to
+the twin's totals bitwise, (3) the pooled cap-utilization histogram
+summary equals the per-shard aggregate (obs.histograms.summarize_batched
+— counts are exact, not sampled), and (4) instrumentation is gate-
+equivalence-neutral: every trajectory field of an instrumented run is
+bitwise-identical to the uninstrumented run's (n=64 tier-1, n=64k slow).
+"""
+
+import numpy as np
+import pytest
+
+import jax
+
+from ringpop_tpu.models.sim import engine_scalable as es
+from ringpop_tpu.models.sim.storm import ScalableCluster, StormSchedule
+from ringpop_tpu.obs import exchange_stats as oxs
+from ringpop_tpu.obs import histograms as oh
+from ringpop_tpu.ops import exchange as exch
+from ringpop_tpu.parallel import mesh as pmesh
+
+
+@pytest.fixture(scope="module")
+def eight_devices():
+    if len(jax.devices()) < 8:
+        pytest.skip("needs 8 virtual devices")
+    return jax.devices()[:8]
+
+
+def _params(n, shards, **kw):
+    kw.setdefault("u", 192)
+    kw.setdefault("suspicion_ticks", 5)
+    return es.ScalableParams(n=n, exchange_metrics=shards, **kw)
+
+
+def _sched(ticks, n, seed=4):
+    return StormSchedule.churn_storm(
+        ticks, n, fraction=0.1, fail_tick=2, seed=seed
+    )
+
+
+def test_mesh_counters_match_single_device_twin(eight_devices):
+    """The plane's in-body bumps == the analytic twin, bitwise, at
+    every shard count — and the drained per-shard rows sum to the
+    twin's totals."""
+    n, ticks = 64, 8
+    for shards in (2, 4, 8):
+        params = _params(n, shards)
+        sched = _sched(ticks, n)
+        twin = ScalableCluster(n=n, params=params, seed=4)
+        twin.run(sched)
+        storm = pmesh.ShardedStorm(
+            n=n, mesh=pmesh.make_mesh(shards), params=params, seed=4
+        )
+        storm.run(sched)
+        assert storm.exchange_mode == "shard_map"
+        np.testing.assert_array_equal(
+            np.asarray(storm.state.exch),
+            np.asarray(twin.state.exch),
+            "exch counters diverged at %d shards" % shards,
+        )
+        np.testing.assert_array_equal(
+            np.asarray(storm.state.exch_hist),
+            np.asarray(twin.state.exch_hist),
+            "exch_hist diverged at %d shards" % shards,
+        )
+        mesh_drained = storm.drain_exchange_metrics(reset=False)
+        twin_drained = twin.drain_exchange_metrics(reset=False)
+        assert mesh_drained["shards"] == twin_drained["shards"]
+        assert mesh_drained["totals"] == twin_drained["totals"]
+
+
+def test_single_shard_drain_totals(eight_devices):
+    """The 1-shard twin is the degenerate case: every row is local, so
+    the drain reconciles to zero interconnect bytes and the per-shard
+    'spread' counts at most 1 destination."""
+    n, ticks = 64, 8
+    single = ScalableCluster(n=n, params=_params(n, 1), seed=4)
+    single.run(_sched(ticks, n))
+    drained = single.drain_exchange_metrics(reset=False)
+    tot = drained["totals"]
+    assert tot["shards"] == 1
+    assert tot["ticks"] == ticks
+    assert oxs.measured_interconnect_bytes(tot) == 0
+    # one destination bucket per tick: the spread counter accumulates
+    # exactly ticks on a 1-shard mesh
+    assert all(r["dest_shards_pull"] == ticks for r in drained["shards"])
+
+
+def test_drained_wire_bytes_reconcile_with_model(eight_devices):
+    """Measured interconnect bytes == the analytic model x ticks (exact
+    when every trip takes the a2a path) — the traffic gate's identity,
+    checked here at the test shapes so a drift is attributable before
+    the committed TRAFFIC_BUDGET.json diff fires."""
+    n, ticks = 64, 8
+    for shards in (2, 4, 8):
+        storm = pmesh.ShardedStorm(
+            n=n,
+            mesh=pmesh.make_mesh(shards),
+            params=_params(n, shards),
+            seed=4,
+        )
+        storm.run(_sched(ticks, n))
+        drained = storm.drain_exchange_metrics(reset=False)
+        rec = drained["reconcile"]
+        assert rec["fallback_trips"] == 0
+        assert rec["ticks"] == ticks
+        assert rec["measured_interconnect"] == rec["model_interconnect"]
+        assert rec["ratio"] == 1.0
+
+
+def test_cap_util_pooled_equals_aggregate(eight_devices):
+    """summarize_batched over the [S, H, NB] histogram plane ==
+    summarize of the shard-summed plane: device counts pool exactly."""
+    n, shards, ticks = 64, 4, 8
+    storm = pmesh.ShardedStorm(
+        n=n,
+        mesh=pmesh.make_mesh(shards),
+        params=_params(n, shards),
+        seed=4,
+    )
+    storm.run(_sched(ticks, n))
+    hist = np.asarray(storm.state.exch_hist)
+    pooled = oh.summarize_batched(hist, exch.EXCH_HIST_TRACKS)
+    aggregate = oh.summarize(hist.sum(axis=0), exch.EXCH_HIST_TRACKS)
+    assert pooled == aggregate
+    # every tick records one cap-utilization sample per direction/shard
+    assert pooled["cap_util_pull"]["count"] == shards * shards * ticks
+
+
+def test_drain_reset_starts_a_fresh_window(eight_devices):
+    n, shards, ticks = 64, 2, 4
+    storm = pmesh.ShardedStorm(
+        n=n,
+        mesh=pmesh.make_mesh(shards),
+        params=_params(n, shards),
+        seed=4,
+    )
+    storm.run(_sched(ticks, n))
+    first = storm.drain_exchange_metrics(reset=True)
+    assert first["totals"]["ticks"] == ticks * shards
+    assert not np.asarray(storm.state.exch).any()
+    assert not np.asarray(storm.state.exch_hist).any()
+    # the next window accumulates afresh (and keeps its sharding)
+    storm.run(_sched(ticks, n, seed=9))
+    second = storm.drain_exchange_metrics(reset=False)
+    assert second["totals"]["ticks"] == ticks * shards
+
+
+def test_drain_raises_when_telemetry_off(eight_devices):
+    storm = pmesh.ShardedStorm(
+        n=64, mesh=pmesh.make_mesh(2), params=_params(64, 0), seed=4
+    )
+    with pytest.raises(ValueError, match="exchange telemetry is off"):
+        storm.drain_exchange_metrics()
+    single = ScalableCluster(n=64, params=_params(64, 0), seed=4)
+    with pytest.raises(ValueError, match="exchange telemetry is off"):
+        single.drain_exchange_metrics()
+
+
+def test_mesh_size_mismatch_rejected(eight_devices):
+    with pytest.raises(ValueError, match="must equal the mesh size"):
+        pmesh.ShardedStorm(
+            n=64, mesh=pmesh.make_mesh(4), params=_params(64, 2), seed=4
+        )
+
+
+def _assert_trajectory_equal(a, b, ctx=""):
+    for f in es.ScalableState._fields:
+        if f in es.SCALABLE_OBS_ONLY_FIELDS:
+            continue
+        x, y = getattr(a, f), getattr(b, f)
+        if x is None:
+            assert y is None, f
+            continue
+        np.testing.assert_array_equal(
+            np.asarray(x), np.asarray(y), "%s%s" % (ctx, f)
+        )
+
+
+def test_instrumentation_is_gate_equivalent_n64(eight_devices):
+    """Telemetry ON vs OFF: bitwise-identical trajectories, single
+    device and mesh (the noninterference prong proves this statically;
+    this is the dynamic spot check at the tier-1 shape)."""
+    n, ticks = 64, 8
+    sched = _sched(ticks, n)
+    for shards in (4, 8):
+        off = pmesh.ShardedStorm(
+            n=n,
+            mesh=pmesh.make_mesh(shards),
+            params=_params(n, 0),
+            seed=4,
+        )
+        off.run(sched)
+        on = pmesh.ShardedStorm(
+            n=n,
+            mesh=pmesh.make_mesh(shards),
+            params=_params(n, shards),
+            seed=4,
+        )
+        on.run(sched)
+        _assert_trajectory_equal(
+            on.state, off.state, "mesh s=%d " % shards
+        )
+    off1 = ScalableCluster(n=n, params=_params(n, 0), seed=4)
+    off1.run(sched)
+    on1 = ScalableCluster(n=n, params=_params(n, 4), seed=4)
+    on1.run(sched)
+    _assert_trajectory_equal(on1.state, off1.state, "single ")
+
+
+@pytest.mark.slow
+def test_instrumentation_is_gate_equivalent_n64k_slow(eight_devices):
+    n, ticks, shards = 65536, 6, 8
+    sched = _sched(ticks, n)
+    off = pmesh.ShardedStorm(
+        n=n,
+        mesh=pmesh.make_mesh(shards),
+        params=_params(n, 0, u=288),
+        seed=4,
+    )
+    off.run(sched)
+    on = pmesh.ShardedStorm(
+        n=n,
+        mesh=pmesh.make_mesh(shards),
+        params=_params(n, shards, u=288),
+        seed=4,
+    )
+    on.run(sched)
+    _assert_trajectory_equal(on.state, off.state, "mesh 64k ")
+    drained = on.drain_exchange_metrics(reset=False)
+    assert drained["reconcile"]["ratio"] == 1.0
