@@ -218,6 +218,32 @@ def main(argv: Optional[List[str]] = None) -> int:
                     noninterference.check_noninterference(entry_names)
                 )
 
+    if "overflow" in prongs:
+        from ringpop_tpu.analysis import overflow
+
+        entry_names = None
+        if scoped_rel is not None:
+            # same touched-module gate as noninterference: a scoped run
+            # only pays for the interval sweep when certifier-relevant
+            # sources changed (a full sweep — allowlist rows are keyed
+            # by entry patterns, so partial sweeps would false-stale)
+            entry_names = overflow.entries_for_changed(scoped_rel)
+        if entry_names is None or entry_names:
+            with stopwatch(prong_seconds, "overflow"):
+                all_findings.extend(overflow.check_overflow(entry_names))
+
+    if "scale" in prongs:
+        from ringpop_tpu.analysis import overflow, scale_budget
+
+        entry_names = None
+        if scoped_rel is not None:
+            entry_names = overflow.entries_for_changed(scoped_rel)
+        if entry_names is None or entry_names:
+            with stopwatch(prong_seconds, "scale"):
+                all_findings.extend(
+                    scale_budget.check_against_manifest(entry_names)
+                )
+
     if "donation" in prongs:
         from ringpop_tpu.analysis import donation
 

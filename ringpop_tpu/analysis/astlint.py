@@ -12,6 +12,12 @@ np-on-traced        no ``np.asarray/np.prod/np.sum/...`` on traced values
 implicit-dtype      ``jnp.array/zeros/ones/full/empty/arange`` in ``ops/``
                     and ``models/sim/`` must pass an explicit dtype (the
                     x64-flag-dependent default breaks uint32 discipline)
+implicit-accum-     ``jnp.sum/cumsum/prod/cumprod`` in the same paths must
+dtype               make the accumulator dtype reviewable at the call site
+                    — a ``dtype=`` kwarg or an ``.astype(...)``-pinned
+                    operand (ISSUE 18: int32 telemetry accumulators are
+                    what the interval certifier overflow-prices at the
+                    declared 64Mi-node scale)
 py-random-time      no ``random``/``time``/``np.random`` calls inside jit
                     contexts (trace-time nondeterminism baked into the
                     compiled program)
@@ -542,6 +548,53 @@ class ImplicitDtypeRule(Rule):
             )
 
 
+class ImplicitAccumDtypeRule(Rule):
+    name = "implicit-accum-dtype"
+    summary = (
+        "accumulating reduction without a reviewable accumulator dtype: "
+        "pass dtype= or pin the operand with .astype(...) — jnp.sum "
+        "upcasts with the x64 flag, and int32 accumulators are what the "
+        "overflow prong prices at declared scale"
+    )
+    scope = "ops/, models/sim/"
+
+    _ACCUM = ("sum", "cumsum", "prod", "cumprod")
+    # calls whose first operand is one of these are visibly pinned: the
+    # value range a reviewer (and the interval certifier) must check is
+    # stated inline even though jnp.sum still widens the accumulator
+    # under x64 — THAT half is dtype-overflow's job, not the lint's
+    _PINNERS = ("astype", "view")
+
+    def applies(self, mod: ModuleInfo) -> bool:
+        return _in_device_paths(mod, DTYPE_PATHS)
+
+    def check(self, mod: ModuleInfo) -> Iterator[Finding]:
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            chain = _attr_chain(node.func) or ""
+            parts = chain.split(".")
+            if len(parts) != 2 or parts[0] != "jnp":
+                continue
+            if parts[1] not in self._ACCUM:
+                continue
+            if any(k.arg == "dtype" for k in node.keywords):
+                continue
+            op = node.args[0] if node.args else None
+            if (
+                isinstance(op, ast.Call)
+                and isinstance(op.func, ast.Attribute)
+                and op.func.attr in self._PINNERS
+            ):
+                continue
+            yield self.finding(
+                mod,
+                node,
+                f"jnp.{parts[1]}(...) without explicit accumulator dtype "
+                "(dtype= kwarg or .astype-pinned operand)",
+            )
+
+
 class PyRandomTimeRule(Rule):
     name = "py-random-time"
     summary = (
@@ -918,6 +971,7 @@ ALL_RULES: List[Rule] = [
     HostCoerceRule(),
     NpOnTracedRule(),
     ImplicitDtypeRule(),
+    ImplicitAccumDtypeRule(),
     PyRandomTimeRule(),
     MutableDefaultRule(),
     BlockUntilReadyRule(),

@@ -388,6 +388,21 @@ def _entry_exchange(impl: str) -> Tuple[Callable, Tuple]:
     return fused, _exchange_args()
 
 
+def _entry_exchange_local() -> Tuple[Callable, Tuple]:
+    """The shard-local fused exchange (ops.exchange.exchange_local):
+    the inside-shard_map entry the mesh plane pins — same mod-2^32
+    contract as the global op, no auto resolution, counts never
+    requested."""
+    from ringpop_tpu.ops import exchange as exch
+
+    def local(heard, pulled, pushed, r_delta):
+        return exch.exchange_local(
+            heard, pulled, pushed, r_delta, impl="xla"
+        )
+
+    return local, _exchange_args()
+
+
 def _plane_fixture(n: int = 8, metrics: bool = False):
     """1-device mesh + exchange plane at toy shapes — the mesh axis is
     logical (shard_map traces identically at any device count), so the
@@ -572,6 +587,34 @@ def _entry_ring_device() -> Tuple[Callable, Tuple]:
     return _ring_fn(), _ring_args()
 
 
+def _entry_route_lookup_batched() -> Tuple[Callable, Tuple]:
+    """The batched fixed-width successor lookup
+    (route.ring_kernel.lookup_n_fixed): the static-trip vmapped twin of
+    device.lookup_n's while-loop walk — the serving-path shape, so it
+    holds the same purity/dtype gates.  width=6 deliberately avoids a
+    multiple of the toy n so the scale certifier keeps the successor
+    window constant while the ring and the query batch scale."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from ringpop_tpu.models.ring import device
+    from ringpop_tpu.models.route import ring_kernel
+
+    table, mask, _ = _ring_args()
+    rng = np.random.default_rng(5)
+    keys = jnp.asarray(rng.integers(0, 2**32, size=16, dtype=np.uint32))
+
+    def batched(table, mask, keys):
+        ring = device.build_ring(table, mask)
+        n_points = device.ring_size(mask, table.shape[1])
+        return jax.vmap(
+            lambda k: ring_kernel.lookup_n_fixed(ring, n_points, k, 3, 6)
+        )(keys)
+
+    return batched, (table, mask, keys)
+
+
 def _route_fixture(
     impl: str,
     n: int = 8,
@@ -698,6 +741,27 @@ def _entry_fuzz_scan_scalable() -> Tuple[Callable, Tuple]:
         return fex.scenario_scan_scalable(states, inputs, ex.params)
 
     return scan, (states, inputs)
+
+
+def _entry_checkpoint_restore() -> Tuple[Callable, Tuple]:
+    """The recovery plane's post-load fixup (cluster.fixup_sim_state)
+    with fused_checksum="on" — the one device computation between
+    checkpoint bytes and a resuming engine (record-cache rebuild via
+    member_records), so it must hold the same purity/dtype gates as
+    the tick it hands the state to."""
+    import jax
+
+    from ringpop_tpu.models.sim import cluster, engine
+
+    universe = _toy_universe(8)
+    params = engine.SimParams(n=8, hash_impl="scan", fused_checksum="on")
+    params = engine.resolve_auto_parity(params, jax.default_backend())
+    state = engine.init_state(params, seed=0, universe=universe)
+
+    def restore(state):
+        return cluster.fixup_sim_state(state, params, universe)
+
+    return restore, (state,)
 
 
 def _fused_apply_args(n: int = 8, seed: int = 0):
@@ -878,6 +942,14 @@ DEFAULT_ENTRIES: List[EntryPoint] = [
     EntryPoint(
         "fuzz-scenario-scan-scalable", _entry_fuzz_scan_scalable
     ),
+    # round-18 scale certifier: the entry points added since PR 12 that
+    # the prongs were not yet seeing — the shard-local exchange the
+    # mesh plane pins, the batched serving-path ring lookup, and the
+    # checkpoint-restore fixup (the only device computation between
+    # saved bytes and a resuming engine)
+    EntryPoint("exchange-local-xla", _entry_exchange_local),
+    EntryPoint("route-lookup-batched", _entry_route_lookup_batched),
+    EntryPoint("checkpoint-restore", _entry_checkpoint_restore),
 ]
 
 

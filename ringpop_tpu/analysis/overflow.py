@@ -1,0 +1,367 @@
+"""jaxgate prong: interval-range overflow/index certification.
+
+ISSUE 18's scale certifier consumer #1: run the interval-domain
+abstract interpreter (:mod:`analysis.ranges`) over every registered
+entry point (jaxpr_audit.DEFAULT_ENTRIES) and fail on any NEW way a
+value range can escape its dtype under the declared scale contracts
+(N up to 64Mi nodes, ticks up to 2^20, capacity envelopes in
+``ranges.ENTRY_SCALES``).  Three rules:
+
+``dtype-overflow``
+    an equation whose result interval escapes its dtype from in-range
+    inputs (including reduce_sum re-checked at the DECLARED N, and
+    lossy convert_element_type);
+``unbounded-carry``
+    a signed scan/while carry whose widened fixpoint escapes its dtype
+    — the per-tick-growing-counter class, named via the state-field
+    labels from :mod:`analysis.noninterference`;
+``index-overflow``
+    an iota/gather/scatter/dynamic_slice index lane whose indexed
+    extent exceeds the index dtype at the declared N ceiling.
+
+The TRIAGED findings on the current tree live in :data:`ALLOWED`,
+each with the justification that makes the wrap benign (or the
+documented contract that bounds it).  The allowlist is exact-ish by
+design: fnmatch patterns over (entry, rule:key), and a FULL run
+reports any row that suppressed nothing as ``stale-allowlist`` so the
+table can only shrink in step with the code.  Mutation tests doctor an
+entry (seeded int32 accumulator) and assert the prong catches it; the
+ad-hoc :func:`check_entry` mirrors noninterference's so they can.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+import re
+from typing import Dict, Iterable, List, Optional, Sequence, Tuple
+
+from ringpop_tpu.analysis import ranges
+from ringpop_tpu.analysis.findings import Finding
+
+
+@dataclasses.dataclass(frozen=True)
+class AllowRow:
+    """One triaged finding class: ``entries``/``keys`` are fnmatch
+    patterns; an event is suppressed when some entry pattern matches the
+    entry name AND some key pattern matches ``"rule:key"``."""
+
+    entries: Tuple[str, ...]
+    keys: Tuple[str, ...]
+    why: str
+
+
+# The certifier's real findings on the current tree, triaged (ISSUE 18
+# satellite 1).  tests/analysis/test_overflow.py pins this table against
+# a live full run: no stale rows, no new unexplained events.
+ALLOWED: Tuple[AllowRow, ...] = (
+    AllowRow(
+        entries=("engine-tick-scan*", "fuzz-scenario-scan-full"),
+        keys=(
+            "unbounded-carry:SimState.tick_index",
+            "unbounded-carry:SimState.susp_deadline",
+        ),
+        why=(
+            "int32 tick counter / tick-derived deadline: wraps at 2^31 "
+            "ticks = 13.6 years of 200ms protocol periods, 4 orders past "
+            "the 2^20-tick (~2.4 day) serving envelope of ROADMAP item 1 "
+            "— documented headroom, not a live hazard"
+        ),
+    ),
+    AllowRow(
+        entries=("engine-tick-scan*", "fuzz-scenario-scan-full"),
+        keys=(
+            "unbounded-carry:SimState.inc",
+            "unbounded-carry:SimState.ch_inc",
+            "unbounded-carry:SimState.ch_source_inc",
+            "unbounded-carry:SimState.ch_pb",
+        ),
+        why=(
+            "incarnation stamps (and the checksum cache's stamp/budget "
+            "planes) mint from the tick index: bounded by ticks+2 < 2^21 "
+            "per engine._pack_key's documented invariant; the interval "
+            "domain cannot see the mint-site bound through the carry"
+        ),
+    ),
+    AllowRow(
+        entries=("engine-tick-scan*", "fuzz-scenario-scan-full"),
+        keys=("unbounded-carry:SimState.perm_inv",),
+        why=(
+            "inverse membership permutation: values are [0, N) by "
+            "construction; the carry interval is polluted through "
+            "stamp-dependent select chains (over-approximation), not by "
+            "any arithmetic growth of the permutation itself"
+        ),
+    ),
+    AllowRow(
+        entries=("engine-tick-scan*", "fuzz-scenario-scan-full"),
+        keys=(
+            "unbounded-carry:SimState.ev_buf",
+            "unbounded-carry:SimState.ev_drops",
+            "unbounded-carry:SimState.first_heard",
+        ),
+        why=(
+            "obs-only planes (flight-recorder ring words, drop counter, "
+            "rumor wavefront stamps): tick-stamped by design and proven "
+            "unable to reach the trajectory by the noninterference prong "
+            "— a wrap distorts telemetry readout only"
+        ),
+    ),
+    AllowRow(
+        entries=("fuzz-scenario-scan-scalable", "engine-scalable-*"),
+        keys=(
+            "unbounded-carry:ScalableState.tick_index",
+            "unbounded-carry:ScalableState.susp_since",
+            "unbounded-carry:ScalableState.truth_inc",
+            "unbounded-carry:ScalableState.r_birth",
+            "unbounded-carry:ScalableState.defame_by",
+        ),
+        why=(
+            "scalable-engine int32 tick stamps (ISSUE 18 satellite 1): "
+            "suspicion start, ground-truth incarnation, rumor birth and "
+            "defamer stamps all mint from tick_index and share its 2^31 "
+            "wrap horizon (13.6 years at 200ms) — documented against the "
+            "2^20-tick serving envelope; widening them to int64 would "
+            "double the O(N)/O(U) state planes for no contract gain"
+        ),
+    ),
+    AllowRow(
+        entries=("*",),
+        keys=("unbounded-carry:carry[*]",),
+        why=(
+            "unnamed inner-loop cursors (hash block walks, digit counts, "
+            "ring binary search): bounded by data extents the interval "
+            "domain cannot express (row width, log10(n) digits, log2(n) "
+            "probe steps), not by per-tick growth — no cursor survives "
+            "its enclosing loop"
+        ),
+    ),
+    AllowRow(
+        entries=("engine-tick-scan*", "fuzz-scenario-scan-full"),
+        keys=("dtype-overflow:mul.out0",),
+        why=(
+            "engine._pack_key (engine.py) computes inc*4+status in int32 "
+            "with the documented invariant stamps < ticks+2 (so the "
+            "packed key stays < 2^22); the flagged range inherits the "
+            "widened inc carry, the mint-site bound holds"
+        ),
+    ),
+    AllowRow(
+        entries=(
+            "engine-tick-scan*",
+            "fuzz-scenario-scan-full",
+            "fused-apply-*",
+            "fused-piggyback-*",
+        ),
+        keys=("dtype-overflow:reduce_sum.scaled.*",),
+        why=(
+            "int32 telemetry sums over [N,N] masks (applied_count, "
+            "piggyback drops, per-tick event counts): the worst case "
+            "assumes all N^2 pairs fire in one tick, real multiplicity "
+            "is <= N*K; metrics-plane only, bitwise gates compare them "
+            "at toy N where they are exact"
+        ),
+    ),
+)
+
+
+@functools.lru_cache(maxsize=None)
+def _pat(p: str):
+    """Glob where ``*`` is the ONLY metacharacter — carry keys contain
+    literal ``[i]`` brackets that fnmatch would read as char classes."""
+    return re.compile(
+        "".join(".*" if c == "*" else re.escape(c) for c in p) + r"\Z"
+    )
+
+
+def _match(value: str, patterns: Sequence[str]) -> bool:
+    return any(_pat(p).match(value) for p in patterns)
+
+
+def allowed(
+    entry: str,
+    rule: str,
+    key: str,
+    allowlist: Sequence[AllowRow] = ALLOWED,
+) -> Optional[int]:
+    """Index of the first allowlist row suppressing this event, else
+    None."""
+    tag = f"{rule}:{key}"
+    for i, row in enumerate(allowlist):
+        if _match(entry, row.entries) and _match(tag, row.keys):
+            return i
+    return None
+
+
+def _event_finding(name: str, ev: ranges.RangeEvent) -> Finding:
+    where = f" [{ev.src}]" if ev.src else ""
+    return Finding(
+        rule=ev.rule,
+        path=f"<entry:{name}>",
+        line=0,
+        message=(
+            f"{ev.key} @ {ev.loc}{where}: {ev.detail} — fix the dtype, "
+            "tighten the declared contract in ranges.ENTRY_SCALES, or "
+            "triage into overflow.ALLOWED with a justification"
+        ),
+        prong="overflow",
+    )
+
+
+def _invar_names(args, closed) -> Optional[List[Optional[str]]]:
+    """State-field paths for the flattened inputs, via the
+    noninterference labeler; None when flatten orders disagree."""
+    from ringpop_tpu.analysis import noninterference as ni
+
+    labels = ni._flatten_labels(
+        ni.label_tree(tuple(args), ni.state_registries(), "args")
+    )
+    if len(labels) != len(closed.jaxpr.invars):
+        return None
+    return [lab.path for lab in labels]
+
+
+def check_entry(
+    name: str,
+    fn,
+    args: Tuple,
+    cache_as: Optional[str] = None,
+    spec: Optional[ranges.ScaleSpec] = None,
+    allowlist: Tuple[AllowRow, ...] = ALLOWED,
+) -> Tuple[List[Finding], set]:
+    """Certify one entry point; returns (findings, used allowlist row
+    indices).  Ad-hoc callers (mutation tests) pass a doctored ``fn``
+    with ``cache_as=None`` and usually ``allowlist=()``."""
+    import jax
+
+    findings: List[Finding] = []
+    used: set = set()
+    try:
+        if cache_as is not None:
+            from ringpop_tpu.analysis import jaxpr_audit as ja
+
+            closed, _ = ja.trace_entry(cache_as, fn, args)
+        else:
+            closed = jax.make_jaxpr(fn)(*args)
+    except Exception as e:
+        findings.append(
+            Finding(
+                rule="trace-failure",
+                path=f"<entry:{name}>",
+                line=0,
+                message=(
+                    f"entry point failed to trace: {type(e).__name__}: {e}"
+                ),
+                prong="overflow",
+            )
+        )
+        return findings, used
+
+    events = ranges.analyze_jaxpr(
+        closed,
+        spec or ranges.entry_scale(name),
+        _invar_names(args, closed),
+    )
+    for ev in sorted(events, key=lambda e: (e.rule, e.key, e.loc)):
+        row = allowed(name, ev.rule, ev.key, allowlist)
+        if row is None:
+            findings.append(_event_finding(name, ev))
+        else:
+            used.add(row)
+    return findings, used
+
+
+def check_overflow(
+    entry_names: Optional[Sequence[str]] = None,
+    allowlist: Tuple[AllowRow, ...] = ALLOWED,
+) -> List[Finding]:
+    """The prong: certify the registered entries.
+
+    ``entry_names=None`` scans the WHOLE jaxpr registry and additionally
+    reports ``stale-allowlist`` for any :data:`ALLOWED` row that
+    suppressed nothing — the triage table must shrink in step with the
+    code it excuses.  A subset run (--changed-only) skips staleness
+    (a scoped run legitimately never reaches most rows).
+    """
+    from ringpop_tpu.analysis import jaxpr_audit as ja
+
+    by_name = {ep.name: ep for ep in ja.DEFAULT_ENTRIES}
+    full = entry_names is None
+    names = list(by_name) if full else list(entry_names)
+    findings: List[Finding] = []
+    used_all: set = set()
+    for name in names:
+        ep = by_name.get(name)
+        if ep is None:
+            findings.append(
+                Finding(
+                    rule="trace-failure",
+                    path=f"<entry:{name}>",
+                    line=0,
+                    message="unknown entry point",
+                    prong="overflow",
+                )
+            )
+            continue
+        try:
+            fn, args = ep.build()
+        except Exception as e:
+            findings.append(
+                Finding(
+                    rule="trace-failure",
+                    path=f"<entry:{name}>",
+                    line=0,
+                    message=(
+                        f"entry point setup failed: {type(e).__name__}: {e}"
+                    ),
+                    prong="overflow",
+                )
+            )
+            continue
+        got, used = check_entry(
+            name, fn, args, cache_as=name, allowlist=allowlist
+        )
+        findings.extend(got)
+        used_all |= used
+    if full:
+        for i, row in enumerate(allowlist):
+            if i in used_all:
+                continue
+            findings.append(
+                Finding(
+                    rule="stale-allowlist",
+                    path="ringpop_tpu/analysis/overflow.py",
+                    line=0,
+                    message=(
+                        f"ALLOWED[{i}] ({row.keys[0]}, ...) suppressed "
+                        "nothing in a full run — the finding it excuses "
+                        "is gone; delete the row"
+                    ),
+                    prong="overflow",
+                )
+            )
+    return findings
+
+
+# --changed-only scoping: every registered entry traces code from
+# these trees (entry builders span models/, ops/, parallel/, fuzz/;
+# the certifier itself and its contracts are analysis/).  A change
+# under none of them cannot alter any traced jaxpr, so a scoped run
+# skips the prong entirely.
+SOURCES: Tuple[str, ...] = (
+    "analysis/",
+    "models/",
+    "ops/",
+    "parallel/",
+    "fuzz/",
+)
+
+
+def entries_for_changed(rel_paths: Iterable[str]) -> List[str]:
+    """Entry names to re-certify for a set of changed package-relative
+    paths; empty list = prong can be skipped."""
+    from ringpop_tpu.analysis import jaxpr_audit as ja
+
+    if any(r.startswith(SOURCES) for r in rel_paths):
+        return [ep.name for ep in ja.DEFAULT_ENTRIES]
+    return []

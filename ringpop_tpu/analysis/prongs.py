@@ -93,6 +93,37 @@ PRONGS: Dict[str, ProngSpec] = {
             ci="tests/analysis/test_noninterference.py",
         ),
         ProngSpec(
+            name="overflow",
+            summary=(
+                "interval-range certifier per entry point: dtype "
+                "escapes, widened loop carries, index lanes vs the "
+                "declared 64Mi-node / 2^20-tick envelopes"
+            ),
+            rules=(
+                "dtype-overflow",
+                "unbounded-carry",
+                "index-overflow",
+                "stale-allowlist",
+                "trace-failure",
+            ),
+            default=True,  # traces (no compiles); shares the jaxpr cache
+            ci="tests/analysis/test_overflow.py",
+        ),
+        ProngSpec(
+            name="scale",
+            summary=(
+                "abstract per-entry memory footprint vs the per-chip "
+                "HBM budget: feasible-N* ceilings pinned in "
+                "SCALE_BUDGET.json"
+            ),
+            rules=("scale-budget", "scale-failure"),
+            default=True,  # traces (no compiles); shares the jaxpr cache
+            ci=(
+                "tests/analysis/test_scale_budget.py + "
+                "scripts/check_scale_budget.py"
+            ),
+        ),
+        ProngSpec(
             name="donation",
             summary=(
                 "donating jitted drivers compile to the committed "

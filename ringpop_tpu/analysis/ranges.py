@@ -1,0 +1,1108 @@
+"""Interval-range abstract interpretation over ClosedJaxprs (ISSUE 18).
+
+The numeric half of the scale certifier: propagate per-variable VALUE
+RANGES from declared input contracts through a traced entry point's
+jaxpr — riding :mod:`ringpop_tpu.analysis.dataflow`'s recursive walker
+(``pjit`` / ``scan`` / ``while`` / ``cond`` / ``shard_map`` /
+``pallas_call``) in precise mode, with scan/while carries run to a
+widening fixpoint — and report every equation whose result interval can
+escape its dtype's representable range.
+
+Domain
+------
+A value is ``None`` (unknown — floats, pallas outputs, unmodeled
+primitives) or an :class:`Interval` of Python ints with ``None``
+endpoints meaning ±∞.  Constvars and literals seed exact ranges from
+their concrete values; entry inputs seed from the declared contracts
+(:func:`input_contract`): ticks/stamps ∈ [-2, 2^20] for signed lanes
+(the ``-1``/``-2`` sentinels plus ROADMAP item 1's serving envelope),
+full range for unsigned lanes (mod-2^32 wrap is the repo's hash
+contract, never a finding), [0, 1] for bools.
+
+Termination: the carry-feedback join is a *widening with thresholds* —
+a bound that grows between loop iterations jumps to the next landmark
+(0, ±1, the tick ceiling, the int32/uint32/int64 edges, then ±∞), so a
+``min``/``clamp``-stabilized counter converges to a finite range while
+a bare ``c + 1`` carry provably escapes in a handful of iterations.
+
+Events (:class:`RangeEvent`) carry a stable ``key`` so the consumer
+prong (:mod:`ringpop_tpu.analysis.overflow`) can hold an explicit,
+justified allowlist:
+
+- ``dtype-overflow`` — a signed-integer result interval escapes its
+  dtype (per-equation, including lossy ``convert_element_type``
+  narrowing; same-width int<->int reinterprets are the sanctioned
+  bit-cast idiom and stay silent).  ``reduce_sum`` is additionally
+  checked at the entry's DECLARED scale: an accumulator fine at the
+  n=8 trace can still wrap when the reduced axis is an N axis.
+- ``unbounded-carry`` — a scan/while integer carry widened past its
+  dtype: a per-tick-growing counter, invisible to any fixed-length
+  trace, wraps under a long enough run.
+- ``index-overflow`` — shape-derived index-space safety at the
+  declared N ceiling: an ``iota`` / ``gather`` / ``scatter`` /
+  ``dynamic_slice`` index lane whose EXTENT at scale exceeds the index
+  dtype, even though the toy trace is fine.
+
+Scale model
+-----------
+:class:`ScaleSpec` declares, per entry point, how trace-time toy dims
+extrapolate: dims equal to ``c * toy_n`` (``c`` from a small declared
+coefficient set) scale to ``c * n_max``; ``dim_map`` pins named toy
+dims to their envelope values (the rumor-table capacity ``u`` and its
+word width are bounded by design — ``ScalableParams.u`` — and must NOT
+ride the N axis).  The same spec prices abstract buffer footprints for
+the memory-feasibility pass (:mod:`ringpop_tpu.analysis.scale_budget`).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import fnmatch
+import math
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ringpop_tpu.analysis import dataflow
+
+__all__ = [
+    "Interval",
+    "RangeEvent",
+    "ScaleSpec",
+    "RangeVisitor",
+    "analyze_jaxpr",
+    "input_contract",
+    "entry_scale",
+    "scaled_dim",
+    "TICK_CEILING",
+    "N_MAX_PODS",
+    "ENTRY_SCALES",
+]
+
+# ---------------------------------------------------------------------------
+# declared contracts (ISSUE 18): the envelopes the certifier proves against
+
+TICK_CEILING = 1 << 20  # ROADMAP item 1: long-running serving, ~2.4 days
+N_MAX_PODS = 64 << 20  # ROADMAP item 3: 64Mi-node pod-scale ceiling
+FULL_N_MAX = 1 << 16  # full-fidelity [N,N]-plane engine ceiling
+ROUTE_N_MAX = 16 << 20  # routing plane ceiling
+HASH_ROWS_MAX = 1 << 20  # checksum/farmhash row-batch ceiling
+U_ENVELOPE = 512  # ScalableParams.u default: rumor table capacity
+SENTINEL_LO = -2  # the -1/-2 "never"/"tombstone" stamp sentinels
+
+
+@dataclasses.dataclass(frozen=True)
+class Interval:
+    """Closed integer interval; a ``None`` endpoint is ±∞."""
+
+    lo: Optional[int]
+    hi: Optional[int]
+
+    def __repr__(self) -> str:  # compact in findings text
+        lo = "-inf" if self.lo is None else str(self.lo)
+        hi = "+inf" if self.hi is None else str(self.hi)
+        return f"[{lo}, {hi}]"
+
+
+def point(v: int) -> Interval:
+    return Interval(int(v), int(v))
+
+
+FULL = Interval(None, None)
+BOOL = Interval(0, 1)
+
+
+def _min(a: Optional[int], b: Optional[int]) -> Optional[int]:
+    if a is None or b is None:
+        return None
+    return min(a, b)
+
+
+def _max(a: Optional[int], b: Optional[int]) -> Optional[int]:
+    if a is None or b is None:
+        return None
+    return max(a, b)
+
+
+def union(a: Optional[Interval], b: Optional[Interval]) -> Optional[Interval]:
+    """Precise union (no widening) — top absorbs."""
+    if a is None or b is None:
+        return None
+    return Interval(_min(a.lo, b.lo), _max(a.hi, b.hi))
+
+
+def intersect_hull(a: Interval, b: Interval) -> Interval:
+    """Intersection, falling back to ``a`` clamped into ``b``'s hull
+    (used for dtype clamping where emptiness cannot arise)."""
+    lo = a.lo if b.lo is None else (b.lo if a.lo is None else max(a.lo, b.lo))
+    hi = a.hi if b.hi is None else (b.hi if a.hi is None else min(a.hi, b.hi))
+    if lo is not None and hi is not None and lo > hi:
+        return b
+    return Interval(lo, hi)
+
+
+# widening thresholds: a carry bound that grows between loop iterations
+# jumps outward to the next landmark instead of inching forever
+_HI_LANDMARKS: Tuple[Optional[int], ...] = (
+    0,
+    1,
+    (1 << 8) - 1,
+    (1 << 16) - 1,
+    TICK_CEILING,
+    (1 << 31) - 1,
+    (1 << 32) - 1,
+    (1 << 63) - 1,
+    None,
+)
+_LO_LANDMARKS: Tuple[Optional[int], ...] = (
+    0,
+    SENTINEL_LO,
+    -TICK_CEILING,
+    -(1 << 31),
+    -(1 << 63),
+    None,
+)
+
+
+def _widen_hi(v: Optional[int]) -> Optional[int]:
+    if v is None:
+        return None
+    for lm in _HI_LANDMARKS:
+        if lm is None or v <= lm:
+            return lm
+    return None
+
+
+def _widen_lo(v: Optional[int]) -> Optional[int]:
+    if v is None:
+        return None
+    for lm in _LO_LANDMARKS:
+        if lm is None or v >= lm:
+            return lm
+    return None
+
+
+def widen(old: Optional[Interval], new: Optional[Interval]) -> Optional[Interval]:
+    """``old ∇ (old ∪ new)``: keep stable bounds, jump grown ones to
+    the next landmark.  Guarantees fixpoint in O(#landmarks) rounds."""
+    if old is None or new is None:
+        return None
+    u = union(old, new)
+    lo = u.lo if (old.lo is not None and u.lo == old.lo) else _widen_lo(u.lo)
+    if old.lo is None:
+        lo = None
+    hi = u.hi if (old.hi is not None and u.hi == old.hi) else _widen_hi(u.hi)
+    if old.hi is None:
+        hi = None
+    return Interval(lo, hi)
+
+
+# ---------------------------------------------------------------------------
+# dtype lattice anchors
+
+
+def _np_dtype(dt):
+    try:
+        return np.dtype(dt)
+    except TypeError:
+        return None
+
+
+def dtype_interval(dt) -> Optional[Interval]:
+    """Representable range for an integer/bool dtype; None for floats
+    and anything else (unranged)."""
+    dt = _np_dtype(dt)
+    if dt is None:
+        return None
+    if dt == np.dtype(bool):
+        return BOOL
+    if dt.kind in ("i", "u"):
+        info = np.iinfo(dt)
+        return Interval(int(info.min), int(info.max))
+    return None
+
+
+def _is_signed(dt) -> bool:
+    dt = _np_dtype(dt)
+    return dt is not None and dt.kind == "i"
+
+
+def _is_int_like(dt) -> bool:
+    dt = _np_dtype(dt)
+    return dt is not None and (dt.kind in ("i", "u") or dt == np.dtype(bool))
+
+
+def input_contract(aval) -> Optional[Interval]:
+    """Declared contract for one entry-point input leaf, by dtype.
+
+    Unsigned lanes are the hash/bitmask planes: full range, wrap is the
+    contract.  Signed lanes are tick stamps, indices and counts: the
+    ``-1``/``-2`` sentinels up to the serving-envelope tick ceiling —
+    NOT the full int32 range, or every add would (vacuously) overflow.
+    """
+    dt = getattr(aval, "dtype", None)
+    if dt is None:
+        return None
+    dt = _np_dtype(dt)
+    if dt is None:
+        return None
+    if dt == np.dtype(bool):
+        return BOOL
+    if dt.kind == "u":
+        return dtype_interval(dt)
+    if dt.kind == "i":
+        return Interval(SENTINEL_LO, TICK_CEILING)
+    return None
+
+
+# ---------------------------------------------------------------------------
+# declared per-entry scale model
+
+
+@dataclasses.dataclass(frozen=True)
+class ScaleSpec:
+    """How one entry point's trace-time toy dims extrapolate to scale.
+
+    ``toy_n`` is the member-count axis at trace time (the registry
+    traces everything at n=8); a dim equal to ``c * toy_n`` for ``c``
+    in ``coeffs`` scales to ``c * n_max``.  ``dim_map`` pins specific
+    toy dims to their declared envelope (capacity knobs like the rumor
+    table that must NOT ride the N axis); it wins over the coefficient
+    rule.  Dims matching neither are trace-time constants.
+    """
+
+    toy_n: int = 8
+    n_max: int = N_MAX_PODS
+    coeffs: Tuple[int, ...] = (1,)
+    dim_map: Tuple[Tuple[int, int], ...] = ()
+
+    def label(self) -> str:
+        return f"toy_n={self.toy_n} n_max={self.n_max}"
+
+
+def _dim_rule(d: int, spec: ScaleSpec) -> Tuple[str, int]:
+    """Classify one trace-time dim: ``("pinned", env)`` for a dim_map
+    capacity envelope (constant at scale), ``("scaled", c)`` for a
+    ``c*toy_n`` dim riding the N axis, ``("const", d)`` otherwise."""
+    for toy, env in spec.dim_map:
+        if d == toy:
+            return "pinned", env
+    for c in spec.coeffs:
+        if c > 0 and d == c * spec.toy_n:
+            return "scaled", c
+    return "const", d
+
+
+def scaled_dim(d: int, spec: ScaleSpec) -> int:
+    """The declared at-scale extent of one trace-time dim."""
+    kind, v = _dim_rule(d, spec)
+    if kind == "pinned":
+        return v
+    if kind == "scaled":
+        return v * spec.n_max
+    return d
+
+
+# u=128 / w=4 trace shapes scale to the ScalableParams.u capacity
+# envelope, not with N (rumor-table capacity is bounded by design);
+# 32 is the uint32 bit-lane axis the exchange unpacks into — a word
+# width, never a scaled dim
+_SCALABLE_DIMS = ((128, U_ENVELOPE), (4, U_ENVELOPE // 32), (32, 32))
+
+# first fnmatch wins; the trailing "*" is the conservative default
+ENTRY_SCALES: Tuple[Tuple[str, ScaleSpec], ...] = (
+    # full-fidelity engine: [N,N] planes — ceiling is the ROADMAP
+    # item-2 full-engine ladder, not the pod-scale axis
+    ("engine-tick-scan*", ScaleSpec(8, FULL_N_MAX)),
+    ("fused-apply-*", ScaleSpec(8, FULL_N_MAX)),
+    ("fused-piggyback-*", ScaleSpec(8, FULL_N_MAX)),
+    ("fuzz-scenario-scan-full", ScaleSpec(8, FULL_N_MAX)),
+    ("checkpoint-restore*", ScaleSpec(8, FULL_N_MAX)),
+    # scalable O(N·U) engine + exchange: N rides to the pod ceiling,
+    # u/w stay at the capacity envelope
+    ("engine-scalable-*", ScaleSpec(8, N_MAX_PODS, dim_map=_SCALABLE_DIMS)),
+    (
+        "fuzz-scenario-scan-scalable",
+        ScaleSpec(8, N_MAX_PODS, dim_map=_SCALABLE_DIMS),
+    ),
+    ("exchange-*", ScaleSpec(8, N_MAX_PODS, dim_map=_SCALABLE_DIMS)),
+    # row-batched hash pipelines: rows scale, digest width is constant
+    ("fused-checksum-*", ScaleSpec(8, HASH_ROWS_MAX)),
+    ("farmhash-*", ScaleSpec(8, HASH_ROWS_MAX)),
+    # consistent-hash ring + routing plane: N members x 100 replica
+    # points — the flat ring dim (toy 800 = 100*8) rides the N axis
+    # with coefficient 100.  Declared ceiling is the routing plane's
+    # 16Mi, NOT the 64Mi pod axis: the certifier proved the int32
+    # dynamic_slice index lane caps the flat ring at
+    # floor(int32_max / 100) ~ 21.4M members — 64Mi needs an int64
+    # ring index (ROADMAP item 3 follow-up), 16Mi (1.6e9 points) fits
+    ("ring-device-lookup", ScaleSpec(8, ROUTE_N_MAX, coeffs=(1, 100))),
+    ("route-*", ScaleSpec(8, ROUTE_N_MAX, coeffs=(1, 100))),
+    ("*", ScaleSpec(8, N_MAX_PODS)),
+)
+
+
+def entry_scale(name: str) -> ScaleSpec:
+    for pat, spec in ENTRY_SCALES:
+        if fnmatch.fnmatchcase(name, pat):
+            return spec
+    return ScaleSpec()
+
+
+# ---------------------------------------------------------------------------
+# interval arithmetic (None endpoint = ±∞, None interval = top)
+
+
+def iv_neg(a: Optional[Interval]) -> Optional[Interval]:
+    if a is None:
+        return None
+    return Interval(
+        None if a.hi is None else -a.hi, None if a.lo is None else -a.lo
+    )
+
+
+def iv_add(a: Optional[Interval], b: Optional[Interval]) -> Optional[Interval]:
+    if a is None or b is None:
+        return None
+    lo = None if (a.lo is None or b.lo is None) else a.lo + b.lo
+    hi = None if (a.hi is None or b.hi is None) else a.hi + b.hi
+    return Interval(lo, hi)
+
+
+def iv_sub(a: Optional[Interval], b: Optional[Interval]) -> Optional[Interval]:
+    return iv_add(a, iv_neg(b))
+
+
+def iv_mul(a: Optional[Interval], b: Optional[Interval]) -> Optional[Interval]:
+    if a is None or b is None:
+        return None
+    if None not in (a.lo, a.hi, b.lo, b.hi):
+        c = (a.lo * b.lo, a.lo * b.hi, a.hi * b.lo, a.hi * b.hi)
+        return Interval(min(c), max(c))
+    # both known nonnegative: the product's lower bound survives
+    if a.lo is not None and b.lo is not None and a.lo >= 0 and b.lo >= 0:
+        hi = None if (a.hi is None or b.hi is None) else a.hi * b.hi
+        return Interval(a.lo * b.lo, hi)
+    return FULL
+
+
+def iv_scale(a: Optional[Interval], k: int) -> Optional[Interval]:
+    return iv_mul(a, point(k))
+
+
+def iv_min(a: Optional[Interval], b: Optional[Interval]) -> Optional[Interval]:
+    if a is None or b is None:
+        return None
+    return Interval(_min(a.lo, b.lo), _min(a.hi, b.hi))
+
+
+def iv_max(a: Optional[Interval], b: Optional[Interval]) -> Optional[Interval]:
+    if a is None or b is None:
+        return None
+    return Interval(_max(a.lo, b.lo), _max(a.hi, b.hi))
+
+
+def iv_abs(a: Optional[Interval]) -> Optional[Interval]:
+    if a is None:
+        return None
+    if a.lo is not None and a.lo >= 0:
+        return a
+    if a.hi is not None and a.hi <= 0:
+        return iv_neg(a)
+    hi = None
+    if a.lo is not None and a.hi is not None:
+        hi = max(-a.lo, a.hi)
+    return Interval(0, hi)
+
+
+def iv_div(a: Optional[Interval], b: Optional[Interval]) -> Optional[Interval]:
+    """Integer division, conservative: only when the divisor interval
+    is finite and excludes 0."""
+    if a is None or b is None or None in (a.lo, a.hi, b.lo, b.hi):
+        return None
+    if b.lo <= 0 <= b.hi:
+        return None
+    c = []
+    for x in (a.lo, a.hi):
+        for y in (b.lo, b.hi):
+            c.append(int(x / y) if (x < 0) != (y < 0) else x // y)
+    return Interval(min(c), max(c))
+
+
+def iv_rem(a: Optional[Interval], b: Optional[Interval]) -> Optional[Interval]:
+    """lax.rem (C-style, sign of the dividend)."""
+    if b is None or b.lo is None or b.hi is None:
+        return None
+    m = max(abs(b.lo), abs(b.hi))
+    if m == 0:
+        return None
+    lo = 0
+    if a is None or a.lo is None or a.lo < 0:
+        lo = -(m - 1)
+    hi = m - 1
+    if a is not None and a.lo is not None and a.hi is not None:
+        if 0 <= a.hi < m and a.lo >= 0:
+            return a  # fits entirely below the modulus
+    return Interval(lo, hi)
+
+
+def _bit_ceiling(v: int) -> int:
+    """Smallest 2^k - 1 >= v (for or/xor upper bounds)."""
+    return (1 << max(v, 0).bit_length()) - 1
+
+
+def iv_and(a: Optional[Interval], b: Optional[Interval]) -> Optional[Interval]:
+    if a is None or b is None:
+        return None
+    if a.lo is not None and b.lo is not None and a.lo >= 0 and b.lo >= 0:
+        return Interval(0, _min(a.hi, b.hi))
+    return None
+
+
+def iv_orxor(a: Optional[Interval], b: Optional[Interval]) -> Optional[Interval]:
+    if a is None or b is None:
+        return None
+    if (
+        a.lo is not None
+        and b.lo is not None
+        and a.lo >= 0
+        and b.lo >= 0
+        and a.hi is not None
+        and b.hi is not None
+    ):
+        return Interval(0, _bit_ceiling(max(a.hi, b.hi)))
+    return None
+
+
+def iv_shl(a: Optional[Interval], b: Optional[Interval]) -> Optional[Interval]:
+    if (
+        a is None
+        or b is None
+        or None in (a.lo, a.hi, b.lo, b.hi)
+        or a.lo < 0
+        or b.lo < 0
+        or b.hi > 64
+    ):
+        return None
+    return Interval(a.lo << b.lo, a.hi << b.hi)
+
+
+def iv_shr(a: Optional[Interval], b: Optional[Interval]) -> Optional[Interval]:
+    if a is None or b is None or a.lo is None or a.lo < 0:
+        return None  # logical shift of a negative reinterprets the sign bit
+    if b is None or b.lo is None or b.lo < 0:
+        return Interval(0, a.hi)
+    hi = None if a.hi is None else a.hi >> b.lo
+    lo = 0
+    if a.lo is not None and b.hi is not None:
+        lo = a.lo >> b.hi
+    return Interval(lo, hi)
+
+
+# ---------------------------------------------------------------------------
+# events
+
+
+def _eqn_src(eqn) -> str:
+    """Best-effort ``file.py:line (fn)`` for an equation, repo-relative.
+    Purely informational — never part of an event's identity/allowlist
+    key (tracebacks move with unrelated edits)."""
+    if eqn is None:
+        return ""
+    try:
+        from jax._src import source_info_util
+
+        s = source_info_util.summarize(eqn.source_info)
+    except Exception:
+        return ""
+    for anchor in ("ringpop_tpu/", "tests/"):
+        i = s.find(anchor)
+        if i > 0:
+            return s[i:]
+    return s
+
+
+@dataclasses.dataclass(frozen=True)
+class RangeEvent:
+    """One certifier hit, pre-rendering: ``key`` is the stable identity
+    the overflow prong's allowlist matches on (never includes interval
+    endpoints, which move as the analysis gets sharper)."""
+
+    rule: str  # dtype-overflow | unbounded-carry | index-overflow
+    loc: str  # "/".join(walk stack), "<top>" at depth 0
+    prim: str
+    key: str
+    detail: str
+    src: str = ""  # "path/to/file.py:123 (fn)" from jaxpr source info
+
+
+class RangeVisitor(dataflow.Visitor):
+    """The interval interpreter as a :class:`dataflow.Visitor`.
+
+    Values are ``Optional[Interval]`` (None = top).  ``join`` is the
+    WIDENING join — :func:`dataflow.walk` calls it only on the
+    scan/while carry feedback loop, which is exactly where widening
+    belongs; everything inside :meth:`eqn_out` uses the precise
+    :func:`union`.  Signed results that escape their dtype are reported
+    once (at the first equation that manufactures the escape from
+    in-range inputs) and kept UNCLAMPED so carry growth stays visible
+    to the fixpoint; unsigned results wrap silently to full range (the
+    repo's mod-2^32 contract).
+    """
+
+    bottom = None
+    precise = True
+    fixpoint = True
+
+    def __init__(
+        self,
+        spec: Optional[ScaleSpec] = None,
+        invar_names: Optional[Dict[object, str]] = None,
+    ):
+        self.spec = spec or ScaleSpec()
+        self.invar_names = invar_names or {}
+        # (rule, loc, prim, key) -> RangeEvent; dict so fixpoint
+        # revisits of a loop body overwrite instead of duplicate
+        self._events: Dict[Tuple[str, str, str, str], RangeEvent] = {}
+
+    # -- lattice ----------------------------------------------------------
+    def join(self, a, b):
+        return widen(a, b)
+
+    def measure(self, val):
+        return None if val is None else (val.lo, val.hi)
+
+    def seed_constvar(self, var, const):
+        return self._concrete(const)
+
+    def literal(self, lit):
+        return self._concrete(lit.val)
+
+    @staticmethod
+    def _concrete(val) -> Optional[Interval]:
+        arr = np.asarray(val)
+        if not _is_int_like(arr.dtype):
+            return None
+        if arr.size == 0:
+            return Interval(0, 0)
+        return Interval(int(arr.min()), int(arr.max()))
+
+    # -- events -----------------------------------------------------------
+    def events(self) -> List[RangeEvent]:
+        return list(self._events.values())
+
+    def _emit(
+        self, rule: str, loc: str, prim: str, key: str, detail: str, eqn=None
+    ):
+        ident = (rule, loc, prim, key)
+        self._events[ident] = RangeEvent(
+            rule, loc or "<top>", prim, key, detail, _eqn_src(eqn)
+        )
+
+    # -- equation transfer -------------------------------------------------
+    def eqn_out(self, eqn, stack, in_vals, subs, sub_out_vals):
+        prim = eqn.primitive.name
+        loc = "/".join(stack)
+        n_out = len(eqn.outvars)
+        if subs:
+            raw = self._from_subs(eqn, loc, in_vals, subs, sub_out_vals)
+        else:
+            raw = self._transfer(prim, eqn, loc, in_vals)
+        self._index_checks(prim, eqn, loc)
+        if len(raw) < n_out:
+            raw = list(raw) + [None] * (n_out - len(raw))
+        return [
+            self._finalize(eqn, loc, prim, in_vals, var, raw[i])
+            for i, var in enumerate(eqn.outvars)
+        ]
+
+    # sub-jaxpr boundary: positional prefix union; cond branches union;
+    # unmapped boundaries (pallas kernels) stay top; scan/while carries
+    # get the zero-iteration identity and the escaped-dtype check
+    def _from_subs(self, eqn, loc, in_vals, subs, sub_out_vals):
+        n_out = len(eqn.outvars)
+        EMPTY = object()
+        outs: List[object] = [EMPTY] * n_out
+
+        def merge(i, v):
+            outs[i] = v if outs[i] is EMPTY else union(outs[i], v)
+
+        for sub, ov in zip(subs, sub_out_vals):
+            if sub.control:
+                continue  # a while condition's value never leaves the eqn
+            if not sub.out_positional:
+                for i in range(n_out):
+                    merge(i, None)
+                continue
+            for i in range(min(n_out, len(ov))):
+                merge(i, ov[i])
+            if sub.carry_feedback and sub.in_map is not None:
+                for oi, ii in sub.carry_feedback:
+                    if oi < n_out and ii < len(sub.in_map):
+                        merge(oi, in_vals[sub.in_map[ii]])
+        result = [None if o is EMPTY else o for o in outs]
+
+        for sub, ov in zip(subs, sub_out_vals):
+            if not sub.carry_feedback:
+                continue
+            for oi, ii in sub.carry_feedback:
+                if oi >= n_out:
+                    continue
+                var = eqn.outvars[oi]
+                dt = getattr(getattr(var, "aval", None), "dtype", None)
+                if dt is None or not _is_signed(dt):
+                    continue  # unsigned carries wrap by contract
+                rng = dtype_interval(dt)
+                v = result[oi]
+                if v is None:
+                    continue  # top from an unmodeled source, not growth
+                if (v.hi is None or v.hi > rng.hi) or (
+                    v.lo is None or v.lo < rng.lo
+                ):
+                    name = self._carry_name(eqn, sub, ii, oi)
+                    self._emit(
+                        "unbounded-carry",
+                        loc,
+                        eqn.primitive.name,
+                        name,
+                        f"{dt} loop carry '{name}' widens to {v} across "
+                        f"iterations — a per-tick-growing counter wraps "
+                        f"{dt} under the {TICK_CEILING}-tick serving "
+                        "envelope's extension",
+                        eqn=eqn,
+                    )
+        return result
+
+    def _carry_name(self, eqn, sub, ii: int, oi: int) -> str:
+        if sub.in_map is not None and ii < len(sub.in_map):
+            var = eqn.invars[sub.in_map[ii]]
+            try:
+                name = self.invar_names.get(var)
+            except TypeError:  # a Literal carry slot is unhashable
+                name = None
+            if name:
+                return name
+        return f"carry[{oi}]"
+
+    # -- finalize one output var -------------------------------------------
+    def _finalize(self, eqn, loc, prim, in_vals, var, raw):
+        dt = getattr(getattr(var, "aval", None), "dtype", None)
+        if dt is None or not _is_int_like(dt):
+            return None
+        rng = dtype_interval(dt)
+        if raw is None:
+            return rng
+        exceeds = (
+            raw.lo is None
+            or raw.hi is None
+            or raw.lo < rng.lo
+            or raw.hi > rng.hi
+        )
+        if not exceeds:
+            return raw
+        if _is_signed(dt) and self._inputs_tame(eqn, in_vals):
+            self._emit(
+                "dtype-overflow",
+                loc,
+                prim,
+                f"{prim}.out{_out_index(eqn, var)}",
+                f"'{prim}' result range {raw} escapes {dt} "
+                f"{rng} from in-range inputs",
+                eqn=eqn,
+            )
+        if _is_signed(dt):
+            # keep the escape visible to downstream carries; inputs are
+            # no longer "tame", so the escape reports exactly once
+            return raw
+        return rng  # unsigned: mod-2^n wrap is the contract
+
+    @staticmethod
+    def _inputs_tame(eqn, in_vals) -> bool:
+        """All integer inputs sit strictly inside their own dtype
+        ranges — the overflow is newly manufactured HERE, not inherited
+        from an already-reported upstream escape.  A wide-int input
+        saturated AT its dtype edge counts as suspect too: that's a
+        widened loop carry, an unmodeled-primitive top, or a wrapped
+        lane — in all three the actionable report lives upstream (the
+        named ``unbounded-carry``), not at every downstream ``+1``."""
+        for var, val in zip(eqn.invars, in_vals):
+            dt = getattr(getattr(var, "aval", None), "dtype", None)
+            if dt is None or not _is_int_like(dt):
+                continue
+            if val is None:
+                return False
+            rng = dtype_interval(dt)
+            if (
+                val.lo is None
+                or val.hi is None
+                or val.lo < rng.lo
+                or val.hi > rng.hi
+            ):
+                return False
+            npdt = _np_dtype(dt)
+            if (
+                npdt is not None
+                and npdt.kind in ("i", "u")
+                and npdt.itemsize >= 4
+                and (val.lo == rng.lo or val.hi == rng.hi)
+            ):
+                return False
+        return True
+
+    # -- primitive transfer -------------------------------------------------
+    def _transfer(self, prim, eqn, loc, in_vals):
+        n_out = len(eqn.outvars)
+        v = in_vals
+        if prim in ("add", "add_any"):
+            return [iv_add(v[0], v[1])]
+        if prim == "sub":
+            return [iv_sub(v[0], v[1])]
+        if prim == "mul":
+            return [iv_mul(v[0], v[1])]
+        if prim == "neg":
+            return [iv_neg(v[0])]
+        if prim == "abs":
+            return [iv_abs(v[0])]
+        if prim == "sign":
+            return [Interval(-1, 1)]
+        if prim == "max":
+            return [iv_max(v[0], v[1])]
+        if prim == "min":
+            return [iv_min(v[0], v[1])]
+        if prim == "div":
+            return [iv_div(v[0], v[1])]
+        if prim == "rem":
+            return [iv_rem(v[0], v[1])]
+        if prim == "clamp":
+            return [iv_min(iv_max(v[1], v[0]), v[2])]
+        if prim == "select_n":
+            out = v[1] if len(v) > 1 else None
+            for w in v[2:]:
+                out = union(out, w)
+            return [out]
+        if prim == "convert_element_type":
+            return [self._convert(eqn, v[0])]
+        if prim == "iota":
+            size = eqn.outvars[0].aval.shape[eqn.params["dimension"]]
+            return [Interval(0, max(size - 1, 0))]
+        if prim in (
+            "broadcast_in_dim",
+            "reshape",
+            "transpose",
+            "rev",
+            "squeeze",
+            "expand_dims",
+            "slice",
+            "dynamic_slice",
+            "copy",
+            "copy_p",
+            "device_put",
+            "reduce_precision",
+            "stop_gradient",
+            "gather",
+            "optimization_barrier",
+        ):
+            return [v[0] if i < len(v) else None for i in range(n_out)]
+        if prim == "sort":
+            return list(v[:n_out])
+        if prim == "dynamic_update_slice":
+            return [union(v[0], v[1])]
+        if prim == "concatenate":
+            out = v[0]
+            for w in v[1:]:
+                out = union(out, w)
+            return [out]
+        if prim == "pad":
+            return [union(v[0], v[1])]
+        if prim.startswith("scatter"):
+            return [self._scatter(prim, eqn, v)]
+        if prim == "reduce_sum":
+            return [self._reduce_sum(eqn, loc, v)]
+        if prim == "cumsum":
+            size = eqn.invars[0].aval.shape[eqn.params["axis"]]
+            return [iv_mul(v[0], Interval(min(1, size), max(size, 1)))]
+        if prim in ("reduce_max", "reduce_min", "reduce_or", "reduce_and"):
+            return [v[0]]
+        if prim in ("argmax", "argmin"):
+            axes = eqn.params.get("axes", ())
+            size = 1
+            for a in axes:
+                size *= eqn.invars[0].aval.shape[a]
+            return [Interval(0, max(size - 1, 0))]
+        if prim in ("eq", "ne", "lt", "le", "gt", "ge", "is_finite"):
+            return [BOOL]
+        if prim == "and":
+            return [iv_and(v[0], v[1])]
+        if prim in ("or", "xor"):
+            return [iv_orxor(v[0], v[1])]
+        if prim == "not":
+            dt = eqn.outvars[0].aval.dtype
+            if _np_dtype(dt) == np.dtype(bool):
+                return [BOOL]
+            return [None]
+        if prim == "shift_left":
+            return [iv_shl(v[0], v[1])]
+        if prim in ("shift_right_logical", "shift_right_arithmetic"):
+            return [iv_shr(v[0], v[1])]
+        if prim == "population_count":
+            bits = _np_dtype(eqn.invars[0].aval.dtype).itemsize * 8
+            return [Interval(0, bits)]
+        if prim == "clz":
+            bits = _np_dtype(eqn.invars[0].aval.dtype).itemsize * 8
+            return [Interval(0, bits)]
+        if prim == "integer_pow":
+            return [self._integer_pow(v[0], eqn.params.get("y", 1))]
+        if prim == "dot_general":
+            dims = eqn.params["dimension_numbers"][0][0]
+            k = 1
+            for d in dims:
+                k *= eqn.invars[0].aval.shape[d]
+            return [iv_scale(iv_mul(v[0], v[1]), max(k, 1))]
+        return [None] * n_out
+
+    @staticmethod
+    def _integer_pow(a: Optional[Interval], y: int) -> Optional[Interval]:
+        if a is None or a.lo is None or a.hi is None or y < 0:
+            return None
+        cands = [a.lo**y, a.hi**y]
+        if a.lo <= 0 <= a.hi:
+            cands.append(0)
+        return Interval(min(cands), max(cands))
+
+    def _convert(self, eqn, val) -> Optional[Interval]:
+        src = _np_dtype(eqn.invars[0].aval.dtype)
+        dst = _np_dtype(eqn.outvars[0].aval.dtype)
+        if (
+            src is not None
+            and dst is not None
+            and src.kind in ("i", "u")
+            and dst.kind in ("i", "u")
+            and src.itemsize == dst.itemsize
+            and src.kind != dst.kind
+        ):
+            # same-width signed<->unsigned reinterpret: the sanctioned
+            # bit-cast idiom (uint32 hash lanes through int32 plumbing)
+            rng = dtype_interval(dst)
+            if val is None:
+                return rng
+            exceeds = (
+                val.lo is None
+                or val.hi is None
+                or val.lo < rng.lo
+                or val.hi > rng.hi
+            )
+            return rng if exceeds else val
+        return val  # value-preserving intent; _finalize flags the escape
+
+    def _scatter(self, prim, eqn, v) -> Optional[Interval]:
+        operand, updates = v[0], v[2] if len(v) > 2 else None
+        if prim == "scatter":
+            return union(operand, updates)
+        if prim == "scatter-add":
+            upd_aval = eqn.invars[2].aval
+            count = 1
+            for d in upd_aval.shape:
+                count *= d
+            bump = iv_scale(updates, max(count, 1))
+            if bump is None:
+                return None
+            # additive: only the signs that can actually accumulate move
+            lo = operand.lo if operand is not None else None
+            hi = operand.hi if operand is not None else None
+            if lo is not None:
+                lo = lo + min(bump.lo, 0) if bump.lo is not None else None
+            if hi is not None:
+                hi = hi + max(bump.hi, 0) if bump.hi is not None else None
+            return Interval(lo, hi)
+        return None  # scatter-mul / -max / -min: dtype top
+
+    def _reduce_sum(self, eqn, loc, v) -> Optional[Interval]:
+        shape = eqn.invars[0].aval.shape
+        axes = eqn.params["axes"]
+        count = 1
+        scaled = 1
+        for a in axes:
+            count *= shape[a]
+            scaled *= scaled_dim(shape[a], self.spec)
+        out = iv_scale(v[0], max(count, 1))
+        dt = _np_dtype(eqn.outvars[0].aval.dtype)
+        if (
+            scaled != count
+            and dt is not None
+            and dt.kind == "i"
+            and v[0] is not None
+            and self._inputs_tame(eqn, [v[0]])
+        ):
+            at_scale = iv_scale(v[0], max(scaled, 1))
+            rng = dtype_interval(dt)
+            if (
+                at_scale is not None
+                and at_scale.lo is not None
+                and at_scale.hi is not None
+                and (at_scale.lo < rng.lo or at_scale.hi > rng.hi)
+            ):
+                self._emit(
+                    "dtype-overflow",
+                    loc,
+                    "reduce_sum",
+                    f"reduce_sum.scaled.{shape}",
+                    f"reduce_sum over a scaled axis ({count} -> {scaled} "
+                    f"at {self.spec.label()}) accumulates {at_scale}, "
+                    f"escaping {dt} {rng} — fine at the n={self.spec.toy_n} "
+                    "trace, wraps at the declared ceiling",
+                    eqn=eqn,
+                )
+        return out
+
+    # -- shape-derived index-space safety at the declared ceiling -----------
+    def _index_checks(self, prim, eqn, loc):
+        spec = self.spec
+        checks: List[Tuple[object, int, str]] = []  # (idx dtype, extent, tag)
+        if prim == "iota":
+            axis = eqn.params["dimension"]
+            shape = eqn.outvars[0].aval.shape
+            dt = eqn.outvars[0].aval.dtype
+            checks.append((dt, scaled_dim(shape[axis], spec), f"iota.{axis}"))
+        elif prim == "gather":
+            dnums = eqn.params["dimension_numbers"]
+            op_shape = eqn.invars[0].aval.shape
+            idx_dt = eqn.invars[1].aval.dtype
+            for d in dnums.start_index_map:
+                checks.append(
+                    (idx_dt, scaled_dim(op_shape[d], spec), f"gather.dim{d}")
+                )
+        elif prim.startswith("scatter"):
+            dnums = eqn.params["dimension_numbers"]
+            op_shape = eqn.invars[0].aval.shape
+            idx_dt = eqn.invars[1].aval.dtype
+            for d in dnums.scatter_dims_to_operand_dims:
+                checks.append(
+                    (idx_dt, scaled_dim(op_shape[d], spec), f"{prim}.dim{d}")
+                )
+        elif prim in ("dynamic_slice", "dynamic_update_slice"):
+            op_shape = eqn.invars[0].aval.shape
+            first_idx = 2 if prim == "dynamic_update_slice" else 1
+            if len(eqn.invars) > first_idx:
+                idx_dt = eqn.invars[first_idx].aval.dtype
+                for d, size in enumerate(op_shape):
+                    checks.append(
+                        (idx_dt, scaled_dim(size, spec), f"{prim}.dim{d}")
+                    )
+        for dt, extent, tag in checks:
+            rng = dtype_interval(dt)
+            if rng is None or rng.hi is None:
+                continue
+            if extent - 1 > rng.hi:
+                self._emit(
+                    "index-overflow",
+                    loc,
+                    prim,
+                    tag,
+                    f"'{prim}' index lane is {_np_dtype(dt)} but the "
+                    f"indexed extent reaches {extent} at the declared "
+                    f"ceiling ({spec.label()}) — index space escapes the "
+                    "dtype before the engine reaches its contract scale",
+                    eqn=eqn,
+                )
+
+
+def analyze_jaxpr(
+    closed,
+    spec: Optional[ScaleSpec] = None,
+    invar_names: Optional[Sequence[Optional[str]]] = None,
+) -> List[RangeEvent]:
+    """Run the interval certifier over one ClosedJaxpr.
+
+    ``invar_names[i]`` optionally names flattened input leaf ``i``
+    (state-field paths from ``noninterference.label_tree``) so carry
+    findings are attributable; the list must align with
+    ``closed.jaxpr.invars`` when given.
+    """
+    jaxpr = closed.jaxpr
+    names: Dict[object, str] = {}
+    if invar_names is not None and len(invar_names) == len(jaxpr.invars):
+        for var, name in zip(jaxpr.invars, invar_names):
+            if name:
+                names[var] = name
+    visitor = RangeVisitor(spec=spec, invar_names=names)
+    in_vals = [input_contract(v.aval) for v in jaxpr.invars]
+    dataflow.walk(jaxpr, closed.consts, (), in_vals, visitor)
+    return visitor.events()
+
+
+def buffer_poly(closed, spec: ScaleSpec) -> Dict[int, int]:
+    """Abstract footprint of a traced entry as a polynomial in N.
+
+    Sums the at-scale byte size of EVERY SSA value in the program —
+    inputs, every equation output, recursively through all sub-jaxprs —
+    as ``{exponent: coeff_bytes}`` where the exponent counts scaled
+    dims (``poly[1]`` is the O(N) coefficient, ``poly[2]`` the O(N²)
+    one).  This deliberately overcounts live memory (no liveness, scan
+    bodies priced once but intermediates all summed): an UPPER bound
+    XLA's buffer assignment only improves on, which is the right
+    direction for a feasibility ceiling.
+    """
+    poly: Dict[int, float] = {}
+
+    def price(var):
+        aval = getattr(var, "aval", None)
+        shape = getattr(aval, "shape", None)
+        dt = _np_dtype(getattr(aval, "dtype", None))
+        if shape is None or dt is None:
+            return
+        coeff = dt.itemsize
+        exp = 0
+        for d in shape:
+            kind, v = _dim_rule(d, spec)
+            if kind == "scaled":
+                # dim = c*toy_n rides the N axis: bytes go up a degree
+                exp += 1
+                coeff *= v
+            else:
+                # trace constant, or a dim_map capacity envelope: a
+                # constant factor at its declared at-scale extent
+                coeff *= v
+        poly[exp] = poly.get(exp, 0) + coeff
+
+    def visit(jaxpr):
+        import jax
+
+        for var in jaxpr.invars:
+            price(var)
+        for eqn in jaxpr.eqns:
+            for var in eqn.outvars:
+                if isinstance(var, jax.core.DropVar):
+                    continue
+                price(var)
+            for sub in dataflow.sub_jaxprs(eqn, precise=True):
+                inner, _ = sub.open_()
+                visit(inner)
+
+    visit(closed.jaxpr)
+    return {e: int(math.ceil(c)) for e, c in sorted(poly.items())}
+
+
+def poly_bytes(poly: Dict[int, int], n: int) -> int:
+    return sum(c * n**e for e, c in poly.items())
+
+
+def feasible_n(poly: Dict[int, int], budget_bytes: int, n_max: int) -> int:
+    """Largest N <= n_max with poly(N) <= budget (binary search; 0 when
+    even the constant term busts the budget)."""
+    if poly_bytes(poly, 1) > budget_bytes:
+        return 0
+    lo, hi = 1, n_max
+    while lo < hi:
+        mid = (lo + hi + 1) // 2
+        if poly_bytes(poly, mid) <= budget_bytes:
+            lo = mid
+        else:
+            hi = mid - 1
+    return lo
+
+
+def _out_index(eqn, var) -> int:
+    for i, ov in enumerate(eqn.outvars):
+        if ov is var:
+            return i
+    return 0

@@ -1,0 +1,266 @@
+"""jaxgate prong: static memory-feasibility ceilings (SCALE_BUDGET.json).
+
+ISSUE 18's scale certifier consumer #2.  For every auditable entry
+point (the jaxpr prong's registry), the interval certifier's footprint
+model (:func:`ranges.buffer_poly`) prices the traced program as a
+polynomial in N — ``{exponent: bytes_coeff}``, exponent counting
+scaled dims — and a binary search finds **N\\***: the largest N at or
+under the entry's declared ceiling whose total abstract footprint fits
+the per-chip HBM budget.  The per-entry N\\* goes into a committed
+``SCALE_BUDGET.json`` diffed by ``scripts/check_scale_budget.py``: a
+refactor that adds an [N,N] temp, widens a dtype, or otherwise shrinks
+the feasible scale fails STATICALLY, with no chip and no OOM run.
+
+The polynomial deliberately overcounts (every SSA value summed, no
+liveness — see buffer_poly's docstring), so N\\* is a conservative
+floor on what actually fits; XLA's buffer assignment only improves on
+it.  The analysis is backend-independent — unlike COST_BUDGET.json
+there is no backend field and the gate always compares.
+
+Degree is pinned too: the cheapest way to regress feasible scale is to
+raise the polynomial's degree (an O(N) plan growing an O(N^2) plane),
+and at entries already ceiling-bound by ``n_max`` a degree bump may
+not move N\\* — so the manifest records ``degree`` and the gate
+compares it exactly.
+"""
+
+from __future__ import annotations
+
+import json
+from pathlib import Path
+from typing import Dict, Iterable, List, Optional
+
+from ringpop_tpu.analysis import ranges
+from ringpop_tpu.analysis.findings import Finding
+
+MANIFEST_NAME = "SCALE_BUDGET.json"
+DEFAULT_RTOL = 0.05
+# per-chip HBM budget: a v4-generation 16 GiB class chip minus ~25%
+# headroom for XLA scratch, the program image, and the host transfer
+# staging the footprint model cannot see
+HBM_BUDGET_BYTES = 12 * (1 << 30)
+
+
+def entry_budget(
+    name: str,
+    fn,
+    args,
+    spec: Optional[ranges.ScaleSpec] = None,
+    budget_bytes: int = HBM_BUDGET_BYTES,
+    cache_as: Optional[str] = None,
+) -> dict:
+    """Footprint polynomial + feasible N\\* for one entry point.
+
+    Ad-hoc callers (the oversized-buffer mutation test) pass a doctored
+    ``fn`` with ``cache_as=None``."""
+    import jax
+
+    spec = spec or ranges.entry_scale(name)
+    try:
+        if cache_as is not None:
+            from ringpop_tpu.analysis import jaxpr_audit as ja
+
+            closed, _ = ja.trace_entry(cache_as, fn, args)
+        else:
+            closed = jax.make_jaxpr(fn)(*args)
+    except Exception as e:
+        return {"error": "%s: %s" % (type(e).__name__, e)}
+    poly = ranges.buffer_poly(closed, spec)
+    n_star = ranges.feasible_n(poly, budget_bytes, spec.n_max)
+    return {
+        "poly_bytes": {str(e): c for e, c in sorted(poly.items())},
+        "degree": max(poly) if poly else 0,
+        "n_max": spec.n_max,
+        "n_star": n_star,
+        "ceiling_bound": n_star == spec.n_max,
+    }
+
+
+def collect_budgets(
+    entry_names: Optional[Iterable[str]] = None,
+    budget_bytes: int = HBM_BUDGET_BYTES,
+) -> Dict[str, dict]:
+    """``name -> entry_budget`` over the registry (or a named subset)."""
+    from ringpop_tpu.analysis import jaxpr_audit as ja
+
+    by_name = {ep.name: ep for ep in ja.DEFAULT_ENTRIES}
+    wanted = set(entry_names) if entry_names is not None else set(by_name)
+    out: Dict[str, dict] = {}
+    for name in sorted(wanted):
+        ep = by_name.get(name)
+        if ep is None:
+            out[name] = {"error": "unknown entry point"}
+            continue
+        try:
+            fn, args = ep.build()
+        except Exception as e:
+            out[name] = {"error": "%s: %s" % (type(e).__name__, e)}
+            continue
+        out[name] = entry_budget(
+            name, fn, args, budget_bytes=budget_bytes, cache_as=name
+        )
+    return out
+
+
+def compare_to_manifest(
+    actual: Dict[str, dict], manifest: dict, rtol: float = DEFAULT_RTOL
+) -> List[Finding]:
+    """Findings for every feasibility drift.
+
+    N\\* shrinking past ``rtol`` is a scale regression; growing past it
+    is a stale manifest (bank the win).  ``degree`` compares exactly.
+    Entries on only one side are findings, like the cost gate."""
+    findings: List[Finding] = []
+
+    def emit(name, rule, message):
+        findings.append(
+            Finding(
+                rule=rule,
+                path="<entry:%s>" % name,
+                line=0,
+                message=message,
+                prong="scale",
+            )
+        )
+
+    expected = manifest.get("entries", {})
+    for name, exp in sorted(expected.items()):
+        act = actual.get(name)
+        if act is None:
+            emit(name, "scale-budget", "entry in manifest but not analyzed")
+            continue
+        if "error" in act:
+            emit(
+                name,
+                "scale-failure",
+                "entry failed to analyze: %s" % act["error"],
+            )
+            continue
+        if act.get("degree") != exp.get("degree"):
+            emit(
+                name,
+                "scale-budget",
+                "footprint degree changed: O(N^%s) -> O(N^%s) — a new "
+                "scaled plane; regenerate with scripts/"
+                "check_scale_budget.py --write if intentional"
+                % (exp.get("degree"), act.get("degree")),
+            )
+        ev, av = exp.get("n_star", 0), act.get("n_star", 0)
+        if av < ev and (ev - av) > rtol * max(ev, 1):
+            emit(
+                name,
+                "scale-budget",
+                "feasible ceiling N* shrank: %d -> %d (%.1f%%) — the "
+                "entry fits fewer nodes per chip than the committed "
+                "budget; shrink the footprint or regenerate with "
+                "scripts/check_scale_budget.py --write if intentional"
+                % (ev, av, 100.0 * (ev - av) / max(ev, 1)),
+            )
+        elif av > ev and (av - ev) > rtol * max(ev, 1):
+            emit(
+                name,
+                "scale-budget",
+                "feasible ceiling N* grew: %d -> %d — stale manifest; "
+                "bank the win with scripts/check_scale_budget.py --write"
+                % (ev, av),
+            )
+    for name in sorted(set(actual) - set(expected)):
+        act = actual[name]
+        if "error" in act:
+            emit(
+                name,
+                "scale-failure",
+                "entry failed to analyze: %s" % act["error"],
+            )
+        else:
+            emit(
+                name,
+                "scale-budget",
+                "entry has no manifest entry — regenerate with "
+                "scripts/check_scale_budget.py --write",
+            )
+    return findings
+
+
+def manifest_path(root: Optional[Path] = None) -> Path:
+    if root is None:
+        root = Path(__file__).resolve().parents[2]
+    return root / MANIFEST_NAME
+
+
+def load_manifest(path: Optional[Path] = None) -> dict:
+    with open(path or manifest_path()) as f:
+        return json.load(f)
+
+
+def write_manifest(
+    actual: Dict[str, dict],
+    path: Optional[Path] = None,
+    budget_bytes: int = HBM_BUDGET_BYTES,
+) -> Path:
+    """Commit collected budgets.  REFUSES entries that failed to
+    analyze — a broken entry point is a finding, not a budget."""
+    broken = {
+        name: e["error"] for name, e in actual.items() if "error" in e
+    }
+    if broken:
+        raise ValueError(
+            "refusing to write a manifest with failed entries: %r"
+            % (broken,)
+        )
+    p = path or manifest_path()
+    doc = {
+        "version": 1,
+        "hbm_budget_bytes": budget_bytes,
+        "note": (
+            "jaxgate static scale budget: abstract per-entry footprint "
+            "polynomial in N and the binding-search feasible ceiling N* "
+            "under the per-chip HBM budget (see ringpop_tpu/analysis/"
+            "scale_budget.py).  Backend-independent.  Regenerate with "
+            "scripts/check_scale_budget.py --write after an INTENTIONAL "
+            "footprint change; the diff of this file is the scale "
+            "review."
+        ),
+        "entries": actual,
+    }
+    with open(p, "w") as f:
+        json.dump(doc, f, indent=2, sort_keys=True)
+        f.write("\n")
+    return p
+
+
+def check_against_manifest(
+    entry_names: Optional[Iterable[str]] = None,
+    path: Optional[Path] = None,
+    rtol: float = DEFAULT_RTOL,
+) -> List[Finding]:
+    """The gate: analyze + diff (always — the analysis has no backend
+    sensitivity).  A caller-chosen subset diffs only its manifest
+    slice; a full run also catches stale manifest rows."""
+    try:
+        manifest = load_manifest(path)
+    except FileNotFoundError:
+        return [
+            Finding(
+                rule="scale-budget",
+                path=MANIFEST_NAME,
+                line=0,
+                message=(
+                    "manifest missing — generate with "
+                    "scripts/check_scale_budget.py --write"
+                ),
+                prong="scale",
+            )
+        ]
+    budget = int(manifest.get("hbm_budget_bytes", HBM_BUDGET_BYTES))
+    explicit_subset = entry_names is not None
+    actual = collect_budgets(entry_names, budget_bytes=budget)
+    if explicit_subset:
+        sliced = dict(manifest)
+        sliced["entries"] = {
+            k: v
+            for k, v in manifest.get("entries", {}).items()
+            if k in actual
+        }
+        return compare_to_manifest(actual, sliced, rtol=rtol)
+    return compare_to_manifest(actual, manifest, rtol=rtol)

@@ -1680,7 +1680,8 @@ def tick(
     server_count = jnp.sum(
         state.known & ((state.status == ALIVE) | (state.status == SUSPECT)),
         axis=1,
-    ).astype(jnp.int32)
+        dtype=jnp.int32,
+    )
     max_pb = _max_piggyback(server_count, params.piggyback_factor)
 
     # nothing to select or bump when every change table is empty (the
@@ -2069,7 +2070,7 @@ def tick(
         # pb + k + 1 <= max_pb; every valid slot bumps whether or not the
         # intermediary is reachable (the dissemination.js:142-155 quirk)
         pb0, active0 = state.ch_pb, state.ch_active
-        n_slots = jnp.sum(pr_valid, axis=1).astype(jnp.int32)  # [N]
+        n_slots = jnp.sum(pr_valid, axis=1, dtype=jnp.int32)  # [N]
         if ft_on:
             # content mask unused at this site: slot-k message content
             # (send_k below) is computed from the PRE-bump planes
@@ -2494,7 +2495,8 @@ def tick(
     distinct = (
         jnp.sum(
             (cs_sorted[1:] != cs_sorted[:-1])
-            & (cs_sorted[1:] != jnp.uint32(0xFFFFFFFF))
+            & (cs_sorted[1:] != jnp.uint32(0xFFFFFFFF)),
+            dtype=jnp.int32,
         )
         + (cs_sorted[0] != jnp.uint32(0xFFFFFFFF)).astype(jnp.int32)
     ).astype(jnp.int32)

@@ -1101,9 +1101,11 @@ def tick(
     # ---- rumor aging + slot recycling ----------------------------------
     # aging: the batched analog of the per-change piggyback drop rule
     live_count = jnp.sum(proc_alive.astype(jnp.int32))
+    # powers-of-ten as a host-built table: ``10 ** jnp.arange(10)`` lowers
+    # to square-and-multiply whose masked x^16/x^32 lanes wrap int64
+    pow10 = jnp.asarray([10 ** k for k in range(10)], jnp.int64)
     digits = jnp.sum(
-        live_count.astype(jnp.int64)
-        >= 10 ** jnp.arange(10, dtype=jnp.int64),
+        live_count.astype(jnp.int64) >= pow10,
         dtype=jnp.int32,
     )
     max_age = params.piggyback_factor * digits + params.age_slack
@@ -1627,7 +1629,7 @@ def tick(
     )
 
     def _mean_frac(_):
-        heard_counts = jnp.sum(_popcount(hw_all), axis=1)
+        heard_counts = jnp.sum(_popcount(hw_all), axis=1, dtype=jnp.uint32)
         frac = jnp.where(
             n_active > 0,
             heard_counts.astype(jnp.float32) / jnp.maximum(n_active, 1),
@@ -1654,7 +1656,8 @@ def tick(
         s = jnp.sort(c)
         return (
             jnp.sum(
-                (s[1:] != s[:-1]) & (s[1:] != jnp.uint32(0xFFFFFFFF))
+                (s[1:] != s[:-1]) & (s[1:] != jnp.uint32(0xFFFFFFFF)),
+                dtype=jnp.int32,
             )
             + (s[0] != jnp.uint32(0xFFFFFFFF)).astype(jnp.int32)
         ).astype(jnp.int32)

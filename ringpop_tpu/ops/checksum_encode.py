@@ -210,8 +210,8 @@ def membership_rows(
         slen = status_len[stat]
         dlen = _ndigits(inc)
         seg_len = (addr_len + slen + dlen + 1) * pres_i
-        offset = jnp.cumsum(seg_len) - seg_len  # exclusive cumsum
-        total = jnp.maximum(jnp.sum(seg_len) - jnp.int32(1), 0) * (
+        offset = jnp.cumsum(seg_len, dtype=jnp.int32) - seg_len  # exclusive cumsum
+        total = jnp.maximum(jnp.sum(seg_len, dtype=jnp.int32) - jnp.int32(1), 0) * (
             pres_i.sum() > 0
         ).astype(jnp.int32)
 
@@ -318,7 +318,7 @@ def _membership_rows_gather(
         slen = status_len[stat]
         dlen = _ndigits(inc)
         seg_len = (addr_len + slen + dlen + 1) * pres_i
-        ends = jnp.cumsum(seg_len)  # inclusive: segment m covers
+        ends = jnp.cumsum(seg_len, dtype=jnp.int32)  # inclusive: segment m covers
         offset = ends - seg_len  # [offset[m], ends[m])
         total = jnp.maximum(ends[-1] - jnp.int32(1), 0) * (
             pres_i.sum() > 0
@@ -335,8 +335,8 @@ def _membership_rows_gather(
                 .at[jnp.clip(offset, 0, width)]
                 .add(pres_i, mode="drop")
             )
-            rank_of_byte = jnp.cumsum(starts[:width]) - 1  # [W]
-            prank = jnp.cumsum(pres_i) - 1  # present-member rank
+            rank_of_byte = jnp.cumsum(starts[:width], dtype=jnp.int32) - 1  # [W]
+            prank = jnp.cumsum(pres_i, dtype=jnp.int32) - 1  # present-member rank
             rank_to_m = (
                 jnp.zeros(n, jnp.int32)
                 .at[jnp.where(pres, prank, n)]
@@ -402,8 +402,8 @@ def ring_rows(
     def one_row(pres):
         pres_i = pres.astype(jnp.int32)
         seg_len = (addr_len + 1) * pres_i
-        offset = jnp.cumsum(seg_len) - seg_len
-        total = jnp.maximum(jnp.sum(seg_len) - jnp.int32(1), 0) * (
+        offset = jnp.cumsum(seg_len, dtype=jnp.int32) - seg_len
+        total = jnp.maximum(jnp.sum(seg_len, dtype=jnp.int32) - jnp.int32(1), 0) * (
             pres_i.sum() > 0
         ).astype(jnp.int32)
         drop = jnp.int32(width)

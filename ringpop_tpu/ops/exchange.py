@@ -244,7 +244,9 @@ def exchange_xla(
         acc = jnp.sum(bits * tbl[None], axis=(1, 2), dtype=jnp.uint32)
         if not want_counts:
             return acc
-        cnt = jnp.sum(popcount_u32(d), axis=1).astype(jnp.int32)
+        cnt = jnp.sum(popcount_u32(d), axis=1, dtype=jnp.uint32).astype(
+            jnp.int32
+        )
         return acc, cnt
 
     chunk = max(1, min(n, _chunk_rows))

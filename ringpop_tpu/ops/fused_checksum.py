@@ -231,7 +231,7 @@ def fused_hash_rows(
     if impl is None:
         impl = _stream_impl_from_env()
     seg = rec_len.astype(jnp.int32)
-    ends = jnp.cumsum(seg, axis=1)
+    ends = jnp.cumsum(seg, axis=1, dtype=jnp.int32)
     total = jnp.maximum(ends[:, -1] - 1, 0)  # no trailing ';'
     B = rec_words.shape[0]
 

@@ -1,0 +1,110 @@
+#!/usr/bin/env python
+"""Run every committed-manifest gate in one shot with a summary table.
+
+ISSUE 18 satellite: the repo now has seven chip-free gates, each a
+standalone ``scripts/check_*.py`` diffing live analysis against a
+committed artifact (or validating committed artifacts in place).  This
+driver runs them all (subprocesses: each gate owns its JAX state, same
+isolation CI gives them), prints one PASS/FAIL table with wall time,
+and exits non-zero if ANY gate failed — the single pre-push command::
+
+    python scripts/check_all_budgets.py            # all gates
+    python scripts/check_all_budgets.py --only cost,scale
+    python scripts/check_all_budgets.py --list
+    python scripts/check_all_budgets.py --verbose  # stream gate output
+
+Gate output is captured and only replayed for FAILING gates (or with
+``--verbose``), so a clean run is one table.
+"""
+
+from __future__ import annotations
+
+import argparse
+import subprocess
+import sys
+import time
+from pathlib import Path
+
+REPO_ROOT = Path(__file__).resolve().parents[1]
+
+# name -> script; every entry supports a no-argument invocation that
+# exits 0 iff its committed artifact matches the tree
+GATES = (
+    ("retrace", "check_retrace_budget.py"),
+    ("cost", "check_cost_budget.py"),
+    ("donation", "check_donation_budget.py"),
+    ("scale", "check_scale_budget.py"),
+    ("metrics-schema", "check_metrics_schema.py"),
+    ("ckpt-manifest", "check_ckpt_manifest.py"),
+    ("traffic-model", "check_traffic_model.py"),
+)
+
+
+def run_gate(script: str, verbose: bool) -> tuple:
+    cmd = [sys.executable, str(REPO_ROOT / "scripts" / script)]
+    t0 = time.monotonic()
+    proc = subprocess.run(
+        cmd,
+        cwd=REPO_ROOT,
+        capture_output=not verbose,
+        text=True,
+    )
+    dt = time.monotonic() - t0
+    out = "" if verbose else (proc.stdout or "") + (proc.stderr or "")
+    return proc.returncode, dt, out
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument(
+        "--only",
+        default=None,
+        help="comma list of gate names (see --list)",
+    )
+    parser.add_argument(
+        "--list", action="store_true", help="print gate names and exit"
+    )
+    parser.add_argument(
+        "--verbose",
+        action="store_true",
+        help="stream every gate's output instead of capturing",
+    )
+    args = parser.parse_args(argv)
+
+    by_name = dict(GATES)
+    if args.list:
+        for name, script in GATES:
+            print(f"{name:16s} scripts/{script}")
+        return 0
+    names = [n for n, _ in GATES]
+    if args.only:
+        names = [n.strip() for n in args.only.split(",") if n.strip()]
+        unknown = [n for n in names if n not in by_name]
+        if unknown:
+            parser.error(f"unknown gate(s): {unknown}")
+
+    results = []
+    for name in names:
+        if args.verbose:
+            print(f"=== {name} (scripts/{by_name[name]})", flush=True)
+        rc, dt, out = run_gate(by_name[name], args.verbose)
+        results.append((name, rc, dt, out))
+        if rc != 0 and not args.verbose:
+            print(f"=== {name} FAILED (scripts/{by_name[name]})")
+            print(out.rstrip())
+
+    width = max(len(n) for n in names)
+    print()
+    print(f"{'gate':<{width}}  result  seconds")
+    for name, rc, dt, _ in results:
+        print(f"{name:<{width}}  {'PASS' if rc == 0 else 'FAIL':6s}  {dt:7.1f}")
+    failed = [name for name, rc, _, _ in results if rc != 0]
+    if failed:
+        print(f"\n{len(failed)} gate(s) failed: {', '.join(failed)}")
+        return 1
+    print(f"\nall {len(results)} gates pass")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

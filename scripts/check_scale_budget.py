@@ -1,0 +1,91 @@
+#!/usr/bin/env python
+"""Diff static memory-feasibility ceilings against SCALE_BUDGET.json —
+the OOM-regression gate that needs no chip.
+
+Traces every auditable entry point (the jaxpr prong's registry) at its
+toy shape, prices the program as a footprint polynomial in N
+(ringpop_tpu/analysis/ranges.buffer_poly), and binary-searches the
+largest N* whose abstract footprint fits the per-chip HBM budget (see
+ringpop_tpu/analysis/scale_budget.py).  A refactor that adds an [N,N]
+temp, widens a dtype, or raises the polynomial degree fails the diff.
+
+Usage::
+
+    python scripts/check_scale_budget.py                    # diff, exit 1 on drift
+    python scripts/check_scale_budget.py --write            # regenerate manifest
+    python scripts/check_scale_budget.py --entries a,b,c    # subset (diff only)
+    python scripts/check_scale_budget.py --rtol 0.02
+
+``--write`` REFUSES to commit a manifest containing entries that failed
+to trace or analyze — a broken entry point is a finding, not a budget.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parents[1]))
+
+from ringpop_tpu.analysis import scale_budget  # noqa: E402
+from ringpop_tpu.analysis.findings import render_text  # noqa: E402
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument(
+        "--write",
+        action="store_true",
+        help="analyze the entry points and (re)write SCALE_BUDGET.json",
+    )
+    parser.add_argument(
+        "--budget",
+        default=None,
+        help="manifest path (default: SCALE_BUDGET.json at repo root)",
+    )
+    parser.add_argument(
+        "--entries",
+        default=None,
+        help="comma-separated entry-name subset (diff mode only)",
+    )
+    parser.add_argument(
+        "--rtol",
+        type=float,
+        default=scale_budget.DEFAULT_RTOL,
+        help="relative N* drift tolerance (default %g)"
+        % scale_budget.DEFAULT_RTOL,
+    )
+    args = parser.parse_args(argv)
+    path = Path(args.budget) if args.budget else None
+    names = (
+        [n.strip() for n in args.entries.split(",") if n.strip()]
+        if args.entries
+        else None
+    )
+
+    if args.write:
+        if names is not None:
+            parser.error(
+                "--write regenerates the FULL manifest; drop --entries"
+            )
+        actual = scale_budget.collect_budgets()
+        out = scale_budget.write_manifest(actual, path)
+        bound = sum(
+            1 for e in actual.values() if not e.get("ceiling_bound")
+        )
+        print(
+            "wrote %s (%d entries, %d memory-bound below their declared "
+            "ceiling)" % (out, len(actual), bound)
+        )
+        return 0
+
+    findings = scale_budget.check_against_manifest(
+        entry_names=names, path=path, rtol=args.rtol
+    )
+    print(render_text(findings))
+    return 1 if findings else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -9,7 +9,7 @@ import jax.numpy as jnp
 def step(x):
     scale = int(x[0])  # jaxgate: ignore[host-coerce]
     flag = bool(x.any())  # jaxgate: ignore
-    total = float(jnp.sum(x))  # jaxgate: ignore[implicit-dtype]
+    total = float(jnp.sum(x, dtype=jnp.float32))  # jaxgate: ignore[implicit-dtype]
     wrapped = int(
         x[1]
     )  # jaxgate: ignore[host-coerce] — comment on the statement's LAST line

@@ -209,6 +209,67 @@ class TestCondAndWhile:
         assert labels[2] == frozenset({"B", "N"})
 
 
+class TestShardMap:
+    """ISSUE 18 satellite: direct coverage for the precise 1:1
+    shard_map boundary (round 17 added it so telemetry planes entering
+    the sharded exchange don't conservatively taint the heard tile)."""
+
+    def _traced(self):
+        import numpy as np
+        from jax.experimental.shard_map import shard_map
+        from jax.sharding import Mesh, PartitionSpec as P
+
+        mesh = Mesh(np.array(jax.devices()[:4]), ("i",))
+
+        def inner(a, b):
+            return a + 1.0, b * 2.0
+
+        fn = shard_map(
+            inner, mesh=mesh, in_specs=(P("i"), P("i")), out_specs=P("i")
+        )
+        return jax.make_jaxpr(fn)(jnp.ones(8), jnp.ones(8))
+
+    def _shard_eqn(self, closed):
+        def find(jaxpr):
+            for eqn in jaxpr.eqns:
+                if eqn.primitive.name == "shard_map":
+                    return eqn
+                for sub in dataflow.sub_jaxprs(eqn, precise=True):
+                    inner, _ = sub.open_()
+                    got = find(inner)
+                    if got is not None:
+                        return got
+            return None
+
+        eqn = find(closed.jaxpr)
+        assert eqn is not None, "no shard_map eqn traced"
+        return eqn
+
+    def test_precise_boundary_is_positional(self):
+        eqn = self._shard_eqn(self._traced())
+        precise = dataflow.sub_jaxprs(eqn, precise=True)
+        assert len(precise) == 1
+        assert precise[0].in_map == list(range(len(eqn.invars)))
+        assert precise[0].out_positional
+
+    def test_audit_boundary_stays_conservative(self):
+        # the historical traversal keeps its unmapped fallback (findings
+        # text inside kernels is pinned against it)
+        eqn = self._shard_eqn(self._traced())
+        audit = dataflow.sub_jaxprs(eqn, precise=False)
+        assert len(audit) == 1
+        assert audit[0].in_map is None
+        assert not audit[0].out_positional
+
+    def test_slice_keeps_lanes_separate_through_shard_map(self):
+        closed = self._traced()
+        reach = dataflow.slice_reachability(closed, ["A", "B"])
+        assert [frozenset(r) for r in reach] == [
+            frozenset({"A"}),
+            frozenset({"B"}),
+        ]
+
+
 class TestSliceApi:
     def test_seed_arity_mismatch_raises(self):
         closed = jax.make_jaxpr(lambda a, b: a + b)(

@@ -1,0 +1,153 @@
+"""The overflow prong: allowlist mechanics, the full-registry clean
+pin, and the ISSUE 18 mutation proof.
+
+The clean pin doubles as the satellite-1 triage regression: a full
+sweep over every registered entry point must produce NO unsuppressed
+event AND use every ALLOWED row (a bogus extra row is the only
+stale-allowlist finding) — so the triage table can neither rot nor
+silently grow.
+"""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from ringpop_tpu.analysis import overflow
+from ringpop_tpu.analysis.overflow import AllowRow
+
+
+class TestAllowlistMatcher:
+    def test_star_is_the_only_metacharacter(self):
+        # the carry keys contain literal [i] — fnmatch would read a
+        # character class and never match (the round-18 bug)
+        assert overflow._match(
+            "unbounded-carry:carry[0]", ("unbounded-carry:carry[*]",)
+        )
+        assert overflow._match(
+            "unbounded-carry:carry[17]", ("unbounded-carry:carry[*]",)
+        )
+        assert not overflow._match(
+            "unbounded-carry:carry0", ("unbounded-carry:carry[*]",)
+        )
+        # regex metachars in keys stay literal
+        assert not overflow._match("a.c", ("abc",))
+
+    def test_allowed_returns_first_matching_row(self):
+        rows = (
+            AllowRow(("e-*",), ("r:k1",), "one"),
+            AllowRow(("*",), ("r:*",), "two"),
+        )
+        assert overflow.allowed("e-x", "r", "k1", rows) == 0
+        assert overflow.allowed("other", "r", "k9", rows) == 1
+        assert overflow.allowed("other", "q", "k9", rows) is None
+
+    def test_every_committed_row_documents_why(self):
+        for row in overflow.ALLOWED:
+            assert len(row.why) > 40, row
+            assert row.entries and row.keys, row
+
+
+class TestMutationProof:
+    """Seed the ISSUE 18 overflow bug class and prove the prong is the
+    thing that catches it (with the detector's allowlist emptied, the
+    finding appears; with a row covering it, it does not)."""
+
+    def _doctored(self):
+        # an engine-style tick scan accumulating an int32 event counter
+        # by a per-tick delta: the classic silent-wrap telemetry bug
+        def tick(state, _):
+            count, mask = state
+            count = count + jnp.sum(mask, dtype=jnp.int32) + 1
+            return (count, mask), count
+
+        def entry(count0, mask, ticks):
+            return jax.lax.scan(tick, (count0, mask), ticks)
+
+        args = (
+            jnp.int32(0),
+            jnp.ones(8, jnp.int32),
+            jnp.zeros(4, jnp.int32),
+        )
+        return entry, args
+
+    def test_seeded_accumulator_is_caught(self):
+        entry, args = self._doctored()
+        findings, used = overflow.check_entry(
+            "doctored-entry", entry, args, allowlist=()
+        )
+        assert findings, "the seeded int32 accumulator escaped the prong"
+        assert any(f.rule == "unbounded-carry" for f in findings)
+        assert all(f.prong == "overflow" for f in findings)
+        assert used == set()
+
+    def test_detection_not_luck_allowlist_is_the_only_suppressor(self):
+        entry, args = self._doctored()
+        cover = (AllowRow(("doctored-*",), ("unbounded-carry:*",), "test"),)
+        findings, used = overflow.check_entry(
+            "doctored-entry", entry, args, allowlist=cover
+        )
+        assert [f for f in findings if f.rule == "unbounded-carry"] == []
+        assert used == {0}
+
+    def test_seeded_index_lane_is_caught(self):
+        # int32 gather lane over a 100*N ring priced at the pod axis
+        from ringpop_tpu.analysis import ranges
+
+        def entry(table, idx):
+            return jnp.take(table, idx)
+
+        findings, _ = overflow.check_entry(
+            "doctored-ring",
+            entry,
+            (jnp.zeros(800, jnp.uint32), jnp.zeros(3, jnp.int32)),
+            spec=ranges.ScaleSpec(
+                toy_n=8, n_max=ranges.N_MAX_PODS, coeffs=(1, 100)
+            ),
+            allowlist=(),
+        )
+        assert any(f.rule == "index-overflow" for f in findings)
+
+    def test_broken_entry_is_a_trace_failure_finding(self):
+        def boom():
+            raise RuntimeError("nope")
+
+        findings, _ = overflow.check_entry("broken", boom, ())
+        assert [f.rule for f in findings] == ["trace-failure"]
+
+
+class TestChangedOnlyScoping:
+    def test_non_certifier_paths_skip_the_prong(self):
+        assert overflow.entries_for_changed(["obs/statsd.py"]) == []
+        assert overflow.entries_for_changed([]) == []
+
+    def test_certifier_paths_rescan_everything(self):
+        from ringpop_tpu.analysis import jaxpr_audit as ja
+
+        names = overflow.entries_for_changed(["models/sim/engine.py"])
+        assert names == [ep.name for ep in ja.DEFAULT_ENTRIES]
+        assert overflow.entries_for_changed(["analysis/ranges.py"]) == names
+
+
+class TestFullRegistryCleanPin:
+    """One sweep proves three things: the tree is certifier-clean, no
+    committed ALLOWED row is stale, and staleness detection itself
+    works (the appended bogus row is flagged, and only it)."""
+
+    def test_full_run_is_clean_and_allowlist_is_live(self):
+        bogus = AllowRow(
+            ("no-such-entry-*",), ("dtype-overflow:never.*",), "canary"
+        )
+        findings = overflow.check_overflow(
+            allowlist=overflow.ALLOWED + (bogus,)
+        )
+        stale = [f for f in findings if f.rule == "stale-allowlist"]
+        real = [f for f in findings if f.rule != "stale-allowlist"]
+        assert real == [], "\n".join(f.message for f in real)
+        assert len(stale) == 1, "\n".join(f.message for f in stale)
+        assert f"ALLOWED[{len(overflow.ALLOWED)}]" in stale[0].message
+
+    def test_subset_run_skips_staleness(self):
+        findings = overflow.check_overflow(entry_names=["ring-device-lookup"])
+        assert findings == []

@@ -1,0 +1,308 @@
+"""Unit coverage for the interval-range certifier (analysis/ranges.py).
+
+Three layers: the interval algebra (pure lattice math must be sound —
+a wrong bound here silently un-proves every entry point), the declared
+scale-contract table, and the abstract interpreter over small synthetic
+jaxprs with KNOWN ranges — including the ISSUE 18 satellite-4 edge
+cases: negative strides, clamped gathers, and a never-stabilizing
+while carry that must widen to top instead of looping forever.
+"""
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from ringpop_tpu.analysis import ranges
+from ringpop_tpu.analysis.ranges import Interval, point
+
+
+def iv(lo, hi):
+    return Interval(lo, hi)
+
+
+class TestIntervalAlgebra:
+    def test_union_and_top_absorbs(self):
+        assert ranges.union(iv(0, 3), iv(2, 9)) == iv(0, 9)
+        assert ranges.union(iv(0, 3), None) is None
+        assert ranges.union(iv(None, 3), iv(0, 9)) == iv(None, 9)
+
+    def test_widen_keeps_stable_bounds(self):
+        # hi grew -> jumps to the next landmark; lo stayed -> kept exact
+        w = ranges.widen(iv(0, 5), iv(0, 6))
+        assert w.lo == 0
+        assert w.hi == (1 << 8) - 1
+
+    def test_widen_walks_the_landmark_ladder_to_top(self):
+        cur = iv(0, 0)
+        seen = []
+        for _ in range(20):
+            nxt = ranges.widen(cur, ranges.iv_add(cur, point(1)))
+            if nxt == cur:
+                break
+            cur = nxt
+            seen.append(cur.hi)
+        # strictly increasing landmark hops, fixpoint at top
+        assert seen[-1] is None
+        assert len(seen) <= len(ranges._HI_LANDMARKS)
+        assert ranges.widen(cur, ranges.iv_add(cur, point(1))) == cur
+
+    def test_widen_lo_jumps_to_sentinel_then_negative_landmarks(self):
+        w = ranges.widen(iv(0, 4), iv(-1, 4))
+        assert w.lo == ranges.SENTINEL_LO  # the -1/-2 stamp sentinels
+        w2 = ranges.widen(w, iv(-5, 4))
+        assert w2.lo == -ranges.TICK_CEILING
+
+    def test_mul_sign_cases(self):
+        assert ranges.iv_mul(iv(-2, 3), iv(-4, 5)) == iv(-12, 15)
+        assert ranges.iv_mul(iv(2, 3), iv(4, 5)) == iv(8, 15)
+        # nonneg semi-infinite keeps the finite lower bound
+        assert ranges.iv_mul(iv(2, None), iv(3, 4)) == iv(6, None)
+        # mixed-sign semi-infinite degrades to full
+        assert ranges.iv_mul(iv(-2, None), iv(3, 4)) == ranges.FULL
+
+    def test_div_requires_nonzero_finite_divisor(self):
+        assert ranges.iv_div(iv(4, 9), point(2)) == iv(2, 4)
+        assert ranges.iv_div(iv(-9, 9), iv(2, 3)) == iv(-4, 4)
+        assert ranges.iv_div(iv(4, 9), iv(-1, 1)) is None
+        assert ranges.iv_div(iv(4, 9), iv(1, None)) is None
+
+    def test_rem_precise_when_dividend_fits_below_modulus(self):
+        assert ranges.iv_rem(iv(3, 6), point(100)) == iv(3, 6)
+        assert ranges.iv_rem(iv(0, 500), point(100)) == iv(0, 99)
+        # C-style: negative dividends pull the bound negative
+        assert ranges.iv_rem(iv(-500, 500), point(100)) == iv(-99, 99)
+
+    def test_bitwise_bounds(self):
+        assert ranges.iv_and(iv(0, 200), iv(0, 15)) == iv(0, 15)
+        assert ranges.iv_and(iv(-1, 5), iv(0, 5)) is None
+        assert ranges.iv_orxor(iv(0, 5), iv(0, 9)) == iv(0, 15)
+        assert ranges.iv_shl(iv(1, 3), point(4)) == iv(16, 48)
+        assert ranges.iv_shr(iv(16, 64), point(4)) == iv(1, 4)
+        # logical shift of a possibly-negative value reinterprets bits
+        assert ranges.iv_shr(iv(-1, 64), point(4)) is None
+
+    def test_dtype_interval_anchors(self):
+        assert ranges.dtype_interval(jnp.int32) == iv(-(1 << 31), (1 << 31) - 1)
+        assert ranges.dtype_interval(jnp.uint32) == iv(0, (1 << 32) - 1)
+        assert ranges.dtype_interval(jnp.bool_) == ranges.BOOL
+        assert ranges.dtype_interval(jnp.float32) is None
+
+
+class TestScaleSpecs:
+    def test_entry_patterns_resolve(self):
+        assert ranges.entry_scale("engine-tick-scan").n_max == ranges.FULL_N_MAX
+        assert (
+            ranges.entry_scale("engine-scalable-tick").dim_map
+            == ranges._SCALABLE_DIMS
+        )
+        assert ranges.entry_scale("ring-device-lookup").coeffs == (1, 100)
+        assert ranges.entry_scale("route-tick-xla").n_max == ranges.ROUTE_N_MAX
+        assert ranges.entry_scale("something-new").n_max == ranges.N_MAX_PODS
+
+    def test_dim_rule_three_way(self):
+        spec = ranges.ScaleSpec(
+            toy_n=8, n_max=1000, coeffs=(1, 100), dim_map=((128, 512),)
+        )
+        assert ranges._dim_rule(128, spec) == ("pinned", 512)
+        assert ranges._dim_rule(8, spec) == ("scaled", 1)
+        assert ranges._dim_rule(800, spec) == ("scaled", 100)
+        assert ranges._dim_rule(7, spec) == ("const", 7)
+        # dim_map wins over the coefficient rule when both match
+        pin8 = ranges.ScaleSpec(toy_n=8, n_max=1000, dim_map=((8, 99),))
+        assert ranges._dim_rule(8, pin8) == ("pinned", 99)
+
+    def test_scaled_dim_extents(self):
+        spec = ranges.ScaleSpec(
+            toy_n=8, n_max=1000, coeffs=(1, 100), dim_map=((128, 512),)
+        )
+        assert ranges.scaled_dim(8, spec) == 1000
+        assert ranges.scaled_dim(800, spec) == 100 * 1000
+        assert ranges.scaled_dim(128, spec) == 512
+        assert ranges.scaled_dim(7, spec) == 7
+
+
+def _events(fn, args, spec=None, invar_names=None):
+    closed = jax.make_jaxpr(fn)(*args)
+    return ranges.analyze_jaxpr(closed, spec=spec, invar_names=invar_names)
+
+
+class TestAnalyzeJaxpr:
+    def test_clean_program_has_no_events(self):
+        def fn(a):  # uint32 hash-style mixing: wrap is the contract
+            return (a * jnp.uint32(0x9E3779B9)) ^ (a >> 13)
+
+        assert _events(fn, (jnp.zeros(8, jnp.uint32),)) == []
+
+    def test_int32_product_escape_is_one_event_not_a_flood(self):
+        # a*a busts int32 from in-range tick-contract inputs; the +1 and
+        # *2 downstream must NOT re-report (the escape already widened
+        # the inputs, _inputs_tame routes the report upstream)
+        def fn(a):
+            big = a * a
+            return big + 1, big * 2
+
+        evs = _events(fn, (jnp.zeros(8, jnp.int32),))
+        assert [e.rule for e in evs] == ["dtype-overflow"]
+        assert evs[0].key == "mul.out0"
+        assert "escapes int32" in evs[0].detail
+
+    def test_reduce_sum_repriced_at_declared_scale(self):
+        # exact at the toy [8, 8] trace; re-check at N=64Mi^2 wraps int32
+        def fn(m):
+            return jnp.sum(m, dtype=jnp.int32)
+
+        evs = _events(fn, (jnp.ones((8, 8), jnp.int32),))
+        assert [e.rule for e in evs] == ["dtype-overflow"]
+        assert evs[0].key.startswith("reduce_sum.scaled.")
+        # the same sum under a toy-sized contract is fine
+        tiny = ranges.ScaleSpec(toy_n=8, n_max=8)
+        assert _events(fn, (jnp.ones((8, 8), jnp.int32),), spec=tiny) == []
+
+    def test_scan_counter_carry_is_named_via_invar_names(self):
+        def fn(c0, xs):
+            def body(c, x):
+                return c + 1, c
+
+            return jax.lax.scan(body, c0, xs)
+
+        evs = _events(
+            fn,
+            (jnp.int32(0), jnp.zeros(4, jnp.int32)),
+            invar_names=["SimStateX.ticker", None],
+        )
+        carries = [e for e in evs if e.rule == "unbounded-carry"]
+        assert [e.key for e in carries] == ["SimStateX.ticker"]
+        assert "widens" in carries[0].detail
+
+    def test_bounded_carry_stays_quiet(self):
+        # the carry is clamped every iteration: the fixpoint must settle
+        # inside int32 and emit nothing
+        def fn(c0, xs):
+            def body(c, x):
+                return jnp.minimum(c + 1, jnp.int32(100)), c
+
+            return jax.lax.scan(body, c0, xs)
+
+        evs = _events(fn, (jnp.int32(0), jnp.zeros(4, jnp.int32)))
+        assert evs == []
+
+    def test_index_overflow_on_scaled_iota_extent(self):
+        # ring geometry at the POD axis: toy 800 = 100*8 scales to
+        # 100*64Mi > int32 (at the declared 16Mi route contract the
+        # same lane fits — that asymmetry IS the certified ceiling)
+        def fn():
+            return jnp.arange(800, dtype=jnp.int32)
+
+        spec = ranges.ScaleSpec(
+            toy_n=8, n_max=ranges.N_MAX_PODS, coeffs=(1, 100)
+        )
+        evs = _events(fn, (), spec=spec)
+        assert [(e.rule, e.key) for e in evs] == [("index-overflow", "iota.0")]
+        # the certified route contract (16Mi*100 points) fits int32
+        route = ranges.ScaleSpec(
+            toy_n=8, n_max=ranges.ROUTE_N_MAX, coeffs=(1, 100)
+        )
+        assert _events(fn, (), spec=route) == []
+        # int64 lanes hold the pod-axis extent fine
+        def fn64():
+            return jnp.arange(800, dtype=jnp.int64)
+
+        assert _events(fn64, (), spec=spec) == []
+
+
+class TestSatellite4EdgeCases:
+    def test_negative_stride_slice_preserves_the_interval(self):
+        # x[::-1] lowers through rev; x[::-2] through strided slice —
+        # both are permutations/selections, neither may invent range
+        def fn(a):
+            r = a[::-1]
+            s = a[::-2]
+            return r[:4] + s
+
+        assert _events(fn, (jnp.zeros(8, jnp.int32),)) == []
+
+    def test_clamped_gather_still_flags_a_narrow_index_lane(self):
+        # mode="clip" fixes out-of-bounds BEHAVIOR, not the index dtype:
+        # an int32 lane cannot even NAME the rows past 2^31 at the
+        # declared 100*16Mi ring extent, so the certifier still fires
+        def fn(table, idx):
+            return jnp.take(table, idx, mode="clip")
+
+        spec = ranges.ScaleSpec(
+            toy_n=8, n_max=ranges.N_MAX_PODS, coeffs=(1, 100)
+        )
+        evs = _events(
+            fn,
+            (jnp.zeros(800, jnp.uint32), jnp.zeros(3, jnp.int32)),
+            spec=spec,
+        )
+        assert ("index-overflow", "gather.dim0") in [
+            (e.rule, e.key) for e in evs
+        ]
+
+    def test_never_stabilizing_while_widens_to_top_and_terminates(self):
+        # c doubles every iteration under a traced bound: no finite
+        # fixpoint exists, so widening MUST hit top in bounded rounds
+        # (this test hanging = the landmark ladder is broken)
+        def fn(n, c0):
+            def cond(c):
+                return c < n
+
+            def body(c):
+                return c * 2 + 1
+
+            return jax.lax.while_loop(cond, body, c0)
+
+        evs = _events(fn, (jnp.int64(10), jnp.int64(1)))
+        carries = [e for e in evs if e.rule == "unbounded-carry"]
+        assert len(carries) == 1
+        assert "int64" in carries[0].detail
+
+    def test_zero_iteration_while_keeps_the_init_range(self):
+        # body would overflow, but the certifier must still include the
+        # zero-iteration identity (init passes through untouched)
+        def fn(n, c0):
+            def body(c):
+                return c * c
+
+            return jax.lax.while_loop(lambda c: c < n, body, c0)
+
+        evs = _events(fn, (jnp.int32(0), jnp.int32(2)))
+        # the in-body escape is real and reported; what matters here is
+        # analysis soundness, not silence
+        assert all(
+            e.rule in ("unbounded-carry", "dtype-overflow") for e in evs
+        )
+
+
+class TestFootprintPolynomial:
+    def test_poly_prices_scaled_and_pinned_dims(self):
+        def fn(plane, tile):
+            return plane.sum(dtype=jnp.int32) + tile.sum(dtype=jnp.int32)
+
+        spec = ranges.ScaleSpec(toy_n=8, n_max=1000, dim_map=((128, 512),))
+        closed = jax.make_jaxpr(fn)(
+            jnp.ones((8, 8), jnp.int32), jnp.ones((8, 128), jnp.int32)
+        )
+        poly = ranges.buffer_poly(closed, spec)
+        # [8,8] -> degree 2; [8,128] -> degree 1 with the 512 envelope
+        assert poly[2] >= 4
+        assert poly[1] >= 4 * 512
+
+    def test_poly_bytes_and_feasible_n(self):
+        assert ranges.poly_bytes({0: 7, 1: 4, 2: 2}, 10) == 7 + 40 + 200
+        assert ranges.feasible_n({1: 4}, 400, 10**6) == 100
+        # constant term alone busts the budget -> infeasible everywhere
+        assert ranges.feasible_n({0: 500}, 400, 10**6) == 0
+        # cheap programs are ceiling-bound at the declared n_max
+        assert ranges.feasible_n({1: 1}, 1 << 60, 4096) == 4096
+
+    def test_feasible_n_is_monotone_in_the_budget(self):
+        poly = {0: 1024, 1: 100, 2: 3}
+        prev = 0
+        for budget in (10**4, 10**6, 10**8, 10**10):
+            cur = ranges.feasible_n(poly, budget, 1 << 40)
+            assert cur >= prev
+            prev = cur
+        assert ranges.poly_bytes(poly, prev) <= 10**10
+        assert ranges.poly_bytes(poly, prev + 1) > 10**10

@@ -1,0 +1,151 @@
+"""The scale prong: manifest round-trip, drift detection, and the
+ISSUE 18 oversized-buffer mutation proof.
+
+The committed SCALE_BUDGET.json is kept honest cheaply here (name-set
+pin + one re-analyzed entry); the full diff runs in CI via
+scripts/check_scale_budget.py (and scripts/check_all_budgets.py).
+"""
+
+import json
+
+import jax.numpy as jnp
+import pytest
+
+from ringpop_tpu.analysis import ranges, scale_budget
+from ringpop_tpu.analysis.jaxpr_audit import DEFAULT_ENTRIES
+
+
+def _clean_entry():
+    def fn(stamps):  # O(N): one [8] plane in, one out
+        return stamps + 1
+
+    return fn, (jnp.zeros(8, jnp.int32),)
+
+
+def _doctored_entry():
+    def fn(stamps):  # seeded [N, N] int64 plane: the footprint mutation
+        plane = jnp.zeros((8, 8), jnp.int64) + stamps[:, None]
+        return stamps + 1, plane
+
+    return fn, (jnp.zeros(8, jnp.int32),)
+
+
+class TestEntryBudget:
+    def test_clean_entry_is_ceiling_bound(self):
+        fn, args = _clean_entry()
+        b = scale_budget.entry_budget("clean", fn, args)
+        assert b["degree"] == 1
+        assert b["n_star"] == b["n_max"] == ranges.N_MAX_PODS
+        assert b["ceiling_bound"] is True
+
+    def test_oversized_buffer_collapses_n_star(self):
+        fn, args = _doctored_entry()
+        bad = scale_budget.entry_budget("doctored", fn, args)
+        clean = scale_budget.entry_budget("clean", *_clean_entry())
+        assert bad["degree"] == 2
+        assert not bad["ceiling_bound"]
+        assert bad["n_star"] < clean["n_star"] // 100
+        # N* is the BINDING search point of the priced polynomial
+        poly = {int(e): c for e, c in bad["poly_bytes"].items()}
+        n = bad["n_star"]
+        assert ranges.poly_bytes(poly, n) <= scale_budget.HBM_BUDGET_BYTES
+        assert ranges.poly_bytes(poly, n + 1) > scale_budget.HBM_BUDGET_BYTES
+
+    def test_broken_entry_reports_error(self):
+        def boom(_):
+            raise RuntimeError("nope")
+
+        b = scale_budget.entry_budget("broken", boom, (jnp.zeros(2),))
+        assert "nope" in b["error"]
+
+
+class TestManifestGate:
+    def _manifest(self, entries):
+        return {
+            "version": 1,
+            "hbm_budget_bytes": scale_budget.HBM_BUDGET_BYTES,
+            "entries": entries,
+        }
+
+    def test_round_trip_is_clean(self, tmp_path):
+        fn, args = _clean_entry()
+        actual = {"clean": scale_budget.entry_budget("clean", fn, args)}
+        path = tmp_path / "SCALE_BUDGET.json"
+        scale_budget.write_manifest(actual, path)
+        again = {"clean": scale_budget.entry_budget("clean", fn, args)}
+        assert (
+            scale_budget.compare_to_manifest(
+                again, json.loads(path.read_text())
+            )
+            == []
+        )
+
+    def test_mutation_fails_the_gate(self):
+        # the committed manifest blessed the clean shape; the doctored
+        # refactor must fail BOTH ways: degree bump and N* collapse
+        clean = scale_budget.entry_budget("e", *_clean_entry())
+        bad = scale_budget.entry_budget("e", *_doctored_entry())
+        findings = scale_budget.compare_to_manifest(
+            {"e": bad}, self._manifest({"e": clean})
+        )
+        msgs = "\n".join(f.message for f in findings)
+        assert any(f.rule == "scale-budget" for f in findings)
+        assert "degree changed" in msgs
+        assert "N* shrank" in msgs
+
+    def test_growth_past_rtol_is_a_stale_manifest(self):
+        clean = scale_budget.entry_budget("e", *_clean_entry())
+        stale = dict(clean, n_star=clean["n_star"] // 2)
+        findings = scale_budget.compare_to_manifest(
+            {"e": clean}, self._manifest({"e": stale})
+        )
+        assert any("bank the win" in f.message for f in findings)
+
+    def test_small_drift_within_rtol_passes(self):
+        clean = scale_budget.entry_budget("e", *_clean_entry())
+        near = dict(clean, n_star=int(clean["n_star"] * 0.99))
+        assert (
+            scale_budget.compare_to_manifest(
+                {"e": clean}, self._manifest({"e": near})
+            )
+            == []
+        )
+
+    def test_one_sided_entries_are_findings(self):
+        clean = scale_budget.entry_budget("e", *_clean_entry())
+        only_manifest = scale_budget.compare_to_manifest(
+            {}, self._manifest({"e": clean})
+        )
+        assert any("not analyzed" in f.message for f in only_manifest)
+        only_actual = scale_budget.compare_to_manifest(
+            {"e": clean}, self._manifest({})
+        )
+        assert any("no manifest entry" in f.message for f in only_actual)
+
+    def test_write_refuses_broken_entries(self, tmp_path):
+        with pytest.raises(ValueError, match="refusing"):
+            scale_budget.write_manifest(
+                {"x": {"error": "boom"}}, tmp_path / "S.json"
+            )
+
+    def test_missing_manifest_is_a_finding(self, tmp_path):
+        findings = scale_budget.check_against_manifest(
+            entry_names=[], path=tmp_path / "absent.json"
+        )
+        assert [f.rule for f in findings] == ["scale-budget"]
+        assert "manifest missing" in findings[0].message
+
+
+class TestCommittedManifest:
+    def test_covers_exactly_the_registry(self):
+        doc = scale_budget.load_manifest()
+        assert set(doc["entries"]) == {ep.name for ep in DEFAULT_ENTRIES}
+        for name, entry in doc["entries"].items():
+            assert "error" not in entry, name
+            assert entry["n_star"] >= 1, name
+
+    def test_one_entry_still_matches_the_committed_ceiling(self):
+        findings = scale_budget.check_against_manifest(
+            entry_names=["ring-device-lookup"]
+        )
+        assert findings == []
