@@ -918,6 +918,107 @@ def _hist_capture(
     return out
 
 
+def _reqtrace_capture(
+    n: int, ticks: int, q: int, churn: int, recorder=None
+) -> dict:
+    """Round-19 request-observatory capture: ONE reqtrace-enabled routed
+    storm drained in two windows through the sliding-window SLO plane —
+    sampled per-request records reconciled against the window's
+    RouteMetrics (the honesty gate rides the bench artifact as a bool),
+    ``reqtrace.drain``/``slo.window`` rows on the shared runlog, statsd
+    keys through an in-memory sink (the emitted-key proof).  Like the
+    histogram capture, a separate window from the measured A/Bs:
+    recording costs ride here, never inside a published rate."""
+    import numpy as np
+
+    from ringpop_tpu.models.route import reqtrace as rt
+    from ringpop_tpu.models.route.plane import RoutedStorm, RouteParams
+    from ringpop_tpu.models.sim import engine_scalable as es
+    from ringpop_tpu.obs import requests as oreq
+    from ringpop_tpu.obs.slo import SLOTarget, SLOWindowPlane
+    from ringpop_tpu.obs.statsd_bridge import StatsdBridge
+
+    window = max(ticks // 2, 1)
+    rs = RoutedStorm(
+        n,
+        params=es.ScalableParams(n=n),
+        route=RouteParams(
+            n=n,
+            queries_per_tick=q,
+            histograms=True,
+            reqtrace=True,
+            req_capacity=rt.req_capacity_for(q, window),
+            req_sample_log2=2,
+        ),
+        seed=0,
+    )
+
+    class _Capture:  # in-memory statsd sink: the emitted-key proof
+        def __init__(self):
+            self.keys = set()
+
+        def timing(self, key, value):
+            self.keys.add(key)
+
+        def increment(self, key, value=1):
+            self.keys.add(key)
+
+        def gauge(self, key, value):
+            self.keys.add(key)
+
+    cap = _Capture()
+    bridge = StatsdBridge(statsd=cap, host_port="127.0.0.1:3000")
+    slo = SLOWindowPlane(
+        SLOTarget(name="route", success_objective=0.999),
+        window_len=4,
+        recorder=recorder,
+        statsd=bridge,
+    )
+    out = {
+        "reqtrace_n": n,
+        "reqtrace_ticks": 2 * window,
+        "reqtrace_sample_log2": 2,
+    }
+    records = drops = 0
+    reconcile_ok = True
+    sched = _sparse_churn_schedule(n, 2 * window, churn)
+    for w in range(2):
+        lo, hi = w * window, (w + 1) * window
+        chunk = type(sched)(ticks=window, n=n)
+        chunk.kill = sched.kill[lo:hi]
+        chunk.revive = sched.revive[lo:hi]
+        # recorder attached only for the drains: this window's per-tick
+        # rows (a different n than the measured A/Bs) stay out of the
+        # shared bench runlog, like the histogram capture's
+        rs.recorder = None
+        _, rm = rs.run(chunk)
+        rs.recorder = recorder
+        hist = np.asarray(rs.rstate.hist)
+        rs.drain_histograms(reset=True)
+        slo.observe_route_window(hi, hist, rm)
+        drained = rs.drain_requests(reset=True, statsd=bridge)
+        records += len(drained["records"])
+        drops += drained["drops"]
+        recon = oreq.reconcile_metrics(
+            np.asarray(
+                [drained["counts"][f] for f in oreq.COUNT_FIELDS]
+            ),
+            rm,
+        )
+        reconcile_ok = reconcile_ok and all(
+            v["ok"] for v in recon.values()
+        )
+    out["reqtrace_records"] = records
+    out["reqtrace_drops"] = drops
+    out["reqtrace_reconcile_ok"] = reconcile_ok
+    row = slo.window_row(2 * window)
+    out["reqtrace_slo_p99"] = row["p99"]
+    out["reqtrace_slo_success_rate"] = row["success_rate"]
+    out["reqtrace_slo_burn_rate"] = row["burn_rate"]
+    out["reqtrace_statsd_keys"] = sorted(cap.keys)
+    return out
+
+
 def _ring_rebuild_ab(n: int, r: int, ticks: int, churn: int) -> dict:
     """Isolated ring-maintenance A/B (the ISSUE 6 perf headline): one
     scanned program per impl over the SAME sparse-churn mask sequence —
@@ -1419,6 +1520,32 @@ def _measure_recorded(n: int, ticks: int, platform: str, recorder) -> dict:
                         hn,
                         rticks,
                         rq,
+                        rchurn,
+                        recorder=recorder,
+                    )
+                )
+            # round-19 request-observatory capture (BENCH_REQTRACE=0
+            # opts out): sampled per-request records + the sliding-
+            # window SLO verdict, with the RouteMetrics reconciliation
+            # bool riding the artifact as a correctness gate
+            if os.environ.get("BENCH_REQTRACE", "1") == "1":
+                qn = int(
+                    os.environ.get("BENCH_REQTRACE_N", str(min(rn, 4096)))
+                )
+                # capacity is sized for the worst case (every query
+                # sampled), so the trace window uses a bounded query
+                # rate rather than the measured A/Bs' full storm
+                qq = int(
+                    os.environ.get(
+                        "BENCH_REQTRACE_Q", str(min(rq, 16384))
+                    )
+                )
+                result.update(
+                    _retry_helper_500(
+                        _reqtrace_capture,
+                        qn,
+                        rticks,
+                        qq,
                         rchurn,
                         recorder=recorder,
                     )

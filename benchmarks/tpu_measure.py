@@ -972,6 +972,115 @@ def phase_observatory(results: dict) -> None:
         )
 
 
+def phase_request_observatory(results: dict) -> None:
+    """Round-19 request observatory on-chip: a 1M-node routed storm
+    with hash-of-key sampling on — (a) the host-side drain cost of the
+    sampled record buffer, (b) the honest drop rate when the buffer is
+    sized BELOW worst case (counts-never-overwrites means drops are
+    measured, not silent), and (c) the sliding-window SLO p99 against
+    the full-histogram p99 over the same span (must agree exactly when
+    the window covers the whole run — the windowed extraction is the
+    same nearest-rank machinery)."""
+    import sys
+    import time
+
+    import numpy as np
+
+    sys.path.insert(
+        0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    )
+    from ringpop_tpu.models.route import reqtrace as rt
+    from ringpop_tpu.models.route.plane import (
+        ROUTE_HIST_TRACKS,
+        RoutedStorm,
+        RouteParams,
+    )
+    from ringpop_tpu.models.sim import engine_scalable as es
+    from ringpop_tpu.models.sim.storm import StormSchedule
+    from ringpop_tpu.obs import histograms as oh
+    from ringpop_tpu.obs.slo import SLOTarget, SLOWindowPlane
+    from ringpop_tpu.ops import histogram as hg
+
+    if not _todo(results, "request_observatory_1m"):
+        return
+    try:
+        n, window, windows, q, churn = 1_000_000, 8, 2, 1 << 18, 32
+        sample_log2 = 4  # trace 1/16 of the key space
+        # sized at HALF the expected sampled volume: the drop rate at
+        # cap is a measurement target here, not a failure
+        cap = rt.req_capacity_for(q, window) >> (sample_log2 + 1)
+        rs = RoutedStorm(
+            n,
+            params=es.ScalableParams(n=n, u=512),
+            route=RouteParams(
+                n=n,
+                queries_per_tick=q,
+                histograms=True,
+                reqtrace=True,
+                req_capacity=cap,
+                req_sample_log2=sample_log2,
+            ),
+            seed=0,
+        )
+        slo = SLOWindowPlane(
+            SLOTarget(name="route"), window_len=windows
+        )
+        full_hist = np.zeros(
+            (len(ROUTE_HIST_TRACKS), hg.NBUCKETS), np.int64
+        )
+        records = drops = 0
+        drain_s = []
+        rng = np.random.default_rng(0)
+        for w in range(windows):
+            sched = StormSchedule(ticks=window, n=n)
+            for t in range(1, window):
+                sched.kill[t, rng.choice(n, churn, replace=False)] = True
+            _, rm = rs.run(sched)
+            hist = np.asarray(rs.rstate.hist)
+            full_hist += hist
+            rs.drain_histograms(reset=True)
+            slo.observe_route_window(w * window + window, hist, rm)
+            t0 = time.perf_counter()
+            drained = rs.drain_requests(reset=True)
+            drain_s.append(time.perf_counter() - t0)
+            records += len(drained["records"])
+            drops += drained["drops"]
+        row = slo.window_row(windows * window)
+        full_p99 = oh.percentile(
+            full_hist[ROUTE_HIST_TRACKS.index("retry_depth")], 99
+        )
+        full_p99 = None if full_p99 is None else full_p99["value"]
+        results["request_observatory_1m"] = {
+            "n": n,
+            "ticks": windows * window,
+            "q": q,
+            "sample_log2": sample_log2,
+            "req_capacity": cap,
+            "records": records,
+            "drops": drops,
+            "drop_rate_at_cap": round(
+                drops / max(records + drops, 1), 4
+            ),
+            "drain_s_mean": round(sum(drain_s) / len(drain_s), 4),
+            "drain_s_max": round(max(drain_s), 4),
+            "windowed_p99": row["p99"],
+            "full_hist_p99": full_p99,
+            "p99_agreement": row["p99"] == full_p99,
+        }
+    except Exception as e:
+        results["request_observatory_1m"] = {"error": str(e)[:300]}
+    print(
+        json.dumps(
+            {
+                "request_observatory_1m": results[
+                    "request_observatory_1m"
+                ]
+            }
+        ),
+        flush=True,
+    )
+
+
 def phase_mesh_observatory(results: dict) -> None:
     """Round-17 mesh observatory on-chip: (a) the per-shard exchange
     telemetry plane (ScalableParams.exchange_metrics) drained after a
@@ -1470,6 +1579,7 @@ def main() -> int:
         ("weak_scaling", phase_weak_scaling),
         ("route", phase_route),
         ("observatory", phase_observatory),
+        ("request_observatory", phase_request_observatory),
         ("mesh_observatory", phase_mesh_observatory),
         ("fused_full", phase_fused_full),
         ("ckpt", phase_ckpt),

@@ -91,6 +91,13 @@ TRACED_ENTRIES: Dict[str, Set[str]] = {
         "fused_stream_xla",
     },
     "ops/record_mix.py": {"record_mix"},
+    # the round-19 sampled request-trace plane: appended from route_tick
+    # inside the routed scan
+    "models/route/reqtrace.py": {
+        "sample_mask",
+        "record_tick_requests",
+        "append_requests",
+    },
     # the round-15 device histogram primitives: called from every
     # histogram-enabled tick (both engines + the routing plane)
     "ops/histogram.py": {"init", "bucket_index", "record", "record_count"},

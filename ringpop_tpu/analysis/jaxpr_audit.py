@@ -621,6 +621,7 @@ def _route_fixture(
     r: int = 4,
     seed: int = 4,
     histograms: bool = False,
+    reqtrace: bool = False,
 ):
     """Small routing-plane fixture shared by the route-tick entries and
     the retrace probe: buckets/reps/cdf constants + one RouteState."""
@@ -641,6 +642,9 @@ def _route_fixture(
         max_changed=4,
         max_dirty=4,
         histograms=histograms,
+        reqtrace=reqtrace,
+        req_capacity=64,
+        req_sample_log2=1,
     )
     reps_np = np.asarray(ringdev.device_replica_hashes(n, r))
     buckets = ring_kernel.build_buckets(reps_np, params.bucket_bits)
@@ -660,14 +664,14 @@ def _route_fixture(
 
 
 def _entry_route_tick(
-    impl: str, histograms: bool = False
+    impl: str, histograms: bool = False, reqtrace: bool = False
 ) -> Tuple[Callable, Tuple]:
     """The routing plane's scanned tick (ISSUE 6): Zipf traffic draw,
     bucketed/sort-twin ring refresh, batched lookups and the misroute/
     keys-diverged/checksum-reject counters must all stay callback-free
     with the ring-key dataflow in integer lanes."""
     plane, params, buckets, reps, cdf, state, dyn = _route_fixture(
-        impl, histograms=histograms
+        impl, histograms=histograms, reqtrace=reqtrace
     )
 
     def one(state, in_ring, proc_alive, checksums):
@@ -930,6 +934,14 @@ DEFAULT_ENTRIES: List[EntryPoint] = [
     EntryPoint(
         "route-tick-histograms",
         lambda: _entry_route_tick("incremental", histograms=True),
+    ),
+    # round-19 request observatory: the sampled per-request trace buffer
+    # rides the same tick; its masked cumsum-scatter append and the
+    # sampled-subset counters must hold the purity gates and the
+    # noninterference prong must prove the req_* plane write-only
+    EntryPoint(
+        "route-tick-reqtrace",
+        lambda: _entry_route_tick("incremental", reqtrace=True),
     ),
     EntryPoint(
         "route-ring-incremental", _entry_route_ring_incremental

@@ -249,6 +249,10 @@ OBS_ENTRY_NAMES: Tuple[str, ...] = (
     "engine-scalable-tick-wavefront",
     "engine-scalable-tick-histograms",
     "route-tick-histograms",
+    # round-19 request observatory: RouteState.req_* (sampled trace
+    # buffer + sampled-subset counters) are obs-only — the prong proves
+    # neither the records nor the counts reach the gate-compared state
+    "route-tick-reqtrace",
     "fuzz-scenario-scan-full",
     # round-17 mesh observatory: ScalableState.exch/exch_hist are
     # obs-only — both the shard_map'd plane shape and the single-device
@@ -284,6 +288,7 @@ ENTRY_SOURCES: Dict[str, Tuple[str, ...]] = {
         "ops/",
     ),
     "route-tick-histograms": ("models/route/", "ops/"),
+    "route-tick-reqtrace": ("models/route/", "ops/"),
     "engine-scalable-tick-shardmap-metrics": (
         "models/sim/engine_scalable.py",
         "parallel/mesh.py",

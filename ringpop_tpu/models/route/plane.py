@@ -108,6 +108,17 @@ class RouteParams(NamedTuple):
     # masks that drive the counters — identical across ring impls (the
     # masks are), write-only (RouteState.hist), off by default.
     histograms: bool = False
+    # Sampled per-request trace records (models/route/reqtrace.py; host
+    # half obs/requests.py): hash-of-key Bernoulli sampling at rate
+    # 2^-req_sample_log2 appends one [RECORD_WIDTH] int32 record per
+    # sampled request under the SAME masks that drive the counters.
+    # Write-only (req_* RouteState fields), off by default; overflow
+    # counts-never-overwrites (req_drops).  req_capacity sizing for a
+    # drop-free window: reqtrace.req_capacity_for.
+    reqtrace: bool = False
+    req_capacity: int = 4096
+    req_sample_log2: int = 4
+    req_salt: int = 0x7E57A8
 
 
 class RouteState(NamedTuple):
@@ -125,6 +136,16 @@ class RouteState(NamedTuple):
     # [len(ROUTE_HIST_TRACKS), NBUCKETS] uint32, write-only — NOT part
     # of the checkpointed RouteCarry (telemetry resets on restore)
     hist: Optional[jax.Array] = None
+    # sampled request-trace plane (RouteParams.reqtrace only, else
+    # None): record buffer + write head + drop counter + sampled-subset
+    # counter deltas + the plane's own tick stamp.  Write-only like
+    # hist, and like it NOT checkpointed (a resume starts a fresh
+    # trace window; req_tick restarts too)
+    req_buf: Optional[jax.Array] = None  # [cap, RECORD_WIDTH] int32
+    req_head: Optional[jax.Array] = None  # scalar int32
+    req_drops: Optional[jax.Array] = None  # scalar int32
+    req_counts: Optional[jax.Array] = None  # [len(COUNT_FIELDS)] int32
+    req_tick: Optional[jax.Array] = None  # scalar int32
 
 
 # Single-source field classification (ISSUE 15): trajectory vs obs-only,
@@ -134,7 +155,9 @@ class RouteState(NamedTuple):
 # the gate-equivalence suites compare bitwise derive from them.  A new
 # RouteState field MUST land in exactly one set (tier-1 gate:
 # tests/analysis/test_state_registry.py).
-ROUTE_OBS_ONLY_FIELDS = frozenset({"hist"})
+ROUTE_OBS_ONLY_FIELDS = frozenset(
+    {"hist", "req_buf", "req_head", "req_drops", "req_counts", "req_tick"}
+)
 ROUTE_TRAJECTORY_FIELDS = frozenset({"ring", "flat_ring", "mask", "rng"})
 
 
@@ -210,6 +233,7 @@ def init_route_state(
         from ringpop_tpu.ops import histogram as hg
 
         hist = hg.init(len(ROUTE_HIST_TRACKS))
+    req = _init_reqtrace(params)
     if impl == "incremental":
         return RouteState(
             ring=rk.full_rebuild(buckets, in_ring),
@@ -217,6 +241,7 @@ def init_route_state(
             mask=None,
             rng=rng,
             hist=hist,
+            **req,
         )
     return RouteState(
         ring=None,
@@ -224,6 +249,25 @@ def init_route_state(
         mask=in_ring,
         rng=rng,
         hist=hist,
+        **req,
+    )
+
+
+def _init_reqtrace(params: RouteParams) -> dict:
+    """Fresh request-trace plane fields (empty dict when off)."""
+    if not params.reqtrace:
+        return {}
+    from ringpop_tpu.models.route import reqtrace as rt
+
+    buf, head, drops, counts, tick = rt.init_reqtrace_fields(
+        params.req_capacity
+    )
+    return dict(
+        req_buf=buf,
+        req_head=head,
+        req_drops=drops,
+        req_counts=counts,
+        req_tick=tick,
     )
 
 
@@ -301,6 +345,16 @@ def route_tick(
             rng=rng_next,
             hist=state.hist,
         )
+    if params.reqtrace:
+        # the request-trace plane rides the carry unchanged until the
+        # end-of-tick emission below
+        new_state = new_state._replace(
+            req_buf=state.req_buf,
+            req_head=state.req_head,
+            req_drops=state.req_drops,
+            req_counts=state.req_counts,
+            req_tick=state.req_tick,
+        )
 
     # -- traffic ---------------------------------------------------------
     senders = jax.random.randint(k_send, (q,), 0, n, dtype=jnp.int32)
@@ -355,6 +409,31 @@ def route_tick(
             hist, ROUTE_HIST_TRACKS.index("dirty_buckets"), n_dirty
         )
         new_state = new_state._replace(hist=hist)
+
+    # -- sampled per-request trace records (opt-in; write-only; the
+    # record mask is sendable & hash-of-key sampled — a pure function
+    # of the same masks the counters sum, so identical across ring
+    # impls) -------------------------------------------------------------
+    if params.reqtrace and state.req_buf is not None:
+        from ringpop_tpu.models.route import reqtrace as rt
+
+        new_state = rt.record_tick_requests(
+            new_state,
+            params,
+            kh=kh1,
+            senders=senders,
+            dest=dest,
+            own_truth=own1_truth,
+            sendable=sendable,
+            misroute=misroute,
+            reroute_local=reroute_local,
+            reroute_remote=reroute_remote,
+            differ=differ,
+            rejects=rejects,
+            multi_ok=multi_ok,
+            diverged=diverged,
+            retried=retried,
+        )
 
     return new_state, RouteMetrics(
         route_queries=cnt(sendable),
@@ -591,6 +670,45 @@ class RoutedStorm(CheckpointableMixin):
                 )
         return out
 
+    def drain_requests(self, reset: bool = True, statsd=None):
+        """Drain the sampled request-trace plane: decode the window's
+        records, log ONE ``reqtrace.drain`` event row on the attached
+        recorder, emit the sampled counters through ``statsd`` (a
+        StatsdBridge).  Returns the obs.requests.drain dict (records +
+        counts + drop honesty).  ``reset=True`` zeroes the buffer and
+        counters for the next window — the plane's tick stamp keeps
+        running, so records stay monotone across windows."""
+        from ringpop_tpu.obs import requests as oreq
+
+        if self.rstate.req_buf is None:
+            raise ValueError(
+                "request tracing is off — construct with "
+                "RouteParams(reqtrace=True)"
+            )
+        out = oreq.drain(
+            self.rstate.req_buf,
+            self.rstate.req_head,
+            self.rstate.req_drops,
+            self.rstate.req_counts,
+            sample_log2=self.route_params.req_sample_log2,
+            source="route",
+            recorder=self.recorder,
+            statsd=statsd,
+        )
+        if reset:
+            from ringpop_tpu.models.route import reqtrace as rt
+
+            buf, head, drops, counts, _ = rt.init_reqtrace_fields(
+                self.route_params.req_capacity
+            )
+            self.rstate = self.rstate._replace(
+                req_buf=buf,
+                req_head=head,
+                req_drops=drops,
+                req_counts=counts,
+            )
+        return out
+
     # -- inspection -------------------------------------------------------
 
     def truth_ring(self) -> jax.Array:
@@ -631,6 +749,9 @@ class RoutedStorm(CheckpointableMixin):
             from ringpop_tpu.ops import histogram as hg
 
             hist = hg.init(len(ROUTE_HIST_TRACKS))
+        # the request-trace plane is telemetry too: a resume starts a
+        # fresh window (and tick stamp) under the CURRENT params
+        req = _init_reqtrace(self.route_params)
         if self.route_params.ring_impl == "incremental":
             return RouteState(
                 ring=rk.full_rebuild(self.buckets, mask),
@@ -638,6 +759,7 @@ class RoutedStorm(CheckpointableMixin):
                 mask=None,
                 rng=rng,
                 hist=hist,
+                **req,
             )
         return RouteState(
             ring=None,
@@ -645,6 +767,7 @@ class RoutedStorm(CheckpointableMixin):
             mask=mask,
             rng=rng,
             hist=hist,
+            **req,
         )
 
     def _ckpt_spec(self) -> CheckpointSpec:

@@ -1,0 +1,197 @@
+"""Device-side sampled per-request trace buffer for the routing plane.
+
+The routing plane observes itself only in aggregate — RouteMetrics
+counters and log2 histograms — but the reference's requestProxy tells a
+per-request story (send.js retry accounting: forward, checksum check,
+retry re-lookup, reroute or abort).  This module records that story on
+device, Dapper-style: a deterministic hash-of-key Bernoulli sample
+picks ~2^-sample_log2 of the key space, and every routed request whose
+key is sampled appends one fixed-width int32 record into a linear
+buffer carried through the scanned tick — the flight-recorder
+mechanics (models/sim/flight.py append_events: masked cumsum-scatter,
+overflow counts-never-overwrites) applied to the request plane.
+
+Neutrality contract: the record mask is ``sendable & sampled`` — a pure
+function of the same masks that drive the counters plus a hash of the
+traffic draw — and every buffer field is write-only, registered
+obs-only (plane.ROUTE_OBS_ONLY_FIELDS), proven non-interfering by the
+analysis prong (route-tick-reqtrace entry) and A/B-gated bitwise in
+tests/models/test_reqtrace.py.
+
+Sampling is per KEY, not per request: ``sample_mask`` re-mixes the
+ring-position key hash with a dedicated salt, so a sampled key's every
+request is traced (complete per-key span trees, obs/requests.py) and
+the sampled subset is an unbiased share of traffic even under Zipf
+skew (chi-square-tested across salts).
+
+Alongside the records, a small counter plane (``req_counts``, one slot
+per obs.requests.COUNT_FIELDS) sums each RouteMetrics mask restricted
+to the sampled subset — computed on device under the SAME masks — so
+reconciliation stays exact even when the record buffer overflows.
+
+Record layout and field registry: obs/requests.py (the host half).
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ringpop_tpu.obs import requests as rq
+from ringpop_tpu.ops.record_mix import record_mix
+
+
+def max_requests_per_tick(queries_per_tick: int) -> int:
+    """Exact upper bound on records one routing tick can append: every
+    query is sendable and every key sampled (sample_log2=0).  Consumers
+    sizing drop-free buffers derive from THIS so the contract lives
+    next to the emitter (the flight.max_events_per_tick discipline)."""
+    return queries_per_tick
+
+
+def req_capacity_for(queries_per_tick: int, ticks: int) -> int:
+    """Drop-free capacity for a ``ticks``-tick window at worst case."""
+    return ticks * max_requests_per_tick(queries_per_tick)
+
+
+def init_reqtrace_fields(capacity: int):
+    """(req_buf, req_head, req_drops, req_counts, req_tick) initial
+    values — the RouteState request-trace plane."""
+    return (
+        jnp.zeros((capacity, rq.RECORD_WIDTH), jnp.int32),
+        jnp.int32(0),
+        jnp.int32(0),
+        jnp.zeros(len(rq.COUNT_FIELDS), jnp.int32),
+        jnp.int32(0),
+    )
+
+
+def sample_mask(
+    key_hashes: jax.Array, salt: int, sample_log2: int
+) -> jax.Array:
+    """[Q] bool — deterministic hash-of-key Bernoulli sample at rate
+    2^-sample_log2 (``sample_log2=0`` samples everything).
+
+    The decision re-mixes the ring-position hash with a DEDICATED salt
+    (record_mix — independent of the traffic generator's key_hashes
+    salt), then keeps keys whose low ``sample_log2`` bits are zero:
+    consistent per key across ticks and ring impls, uniform across the
+    key space regardless of traffic skew."""
+    if sample_log2 == 0:
+        return jnp.ones(key_hashes.shape, bool)
+    z = jnp.zeros_like(key_hashes)
+    h = record_mix(key_hashes, z + jnp.uint32(salt), z)
+    return (h & jnp.uint32((1 << sample_log2) - 1)) == 0
+
+
+def append_requests(
+    buf: jax.Array,  # [cap, RECORD_WIDTH] int32
+    head: jax.Array,  # scalar int32
+    drops: jax.Array,  # scalar int32
+    mask: jax.Array,  # [Q] bool — which lanes append a record
+    columns: Tuple[jax.Array, ...],  # RECORD_WIDTH lanes ([Q] or scalar)
+):
+    """Masked append of up to Q records (flight.append_events shape):
+    selected lanes are enumerated with a cumulative sum and scattered
+    at ``head + rank``; out-of-capacity lanes route to a dropped slot
+    and bump the drop counter — overflow never overwrites, so the
+    stored stream is an honest prefix.  Returns (buf, head, drops)."""
+    cap = buf.shape[0]
+    q = mask.shape[0]
+    mask_i = mask.astype(jnp.int32)
+    # dtype pinned: under x64, sum/cumsum of int32 promote to int64 —
+    # which would widen the scan carry (req_head) and break carry-type
+    # equality between tick input and output
+    total = jnp.sum(mask_i, dtype=jnp.int32)
+    rank = jnp.cumsum(mask_i, dtype=jnp.int32) - 1
+    pos = head + rank
+    tgt = jnp.where(mask & (pos < cap), pos, cap)  # cap drops
+
+    def lane(v):
+        return jnp.broadcast_to(jnp.asarray(v, dtype=jnp.int32), (q,))
+
+    rec = jnp.stack([lane(c) for c in columns], axis=1)
+    buf = buf.at[tgt].set(rec, mode="drop")
+    head_new = jnp.minimum(head + total, cap)
+    drops = drops + jnp.maximum(head + total - cap, 0)
+    return buf, head_new, drops
+
+
+def record_tick_requests(
+    state,  # plane.RouteState AFTER the tick's route masks computed
+    params,  # plane.RouteParams (reqtrace on)
+    kh: jax.Array,  # [Q] uint32 — primary-key ring hashes
+    senders: jax.Array,  # [Q] int32
+    dest: jax.Array,  # [Q] int32 — stale-view owner (clipped)
+    own_truth: jax.Array,  # [Q] int32 — truth owner (-1 = none)
+    sendable: jax.Array,  # [Q] bool
+    misroute: jax.Array,  # [Q] bool
+    reroute_local: jax.Array,  # [Q] bool
+    reroute_remote: jax.Array,  # [Q] bool
+    differ: jax.Array,  # [Q] bool — checksums differed
+    rejects: jax.Array,  # [Q] bool — ... and consistency rejected
+    multi_ok: jax.Array,  # [Q] bool — second key rode the envelope
+    diverged: jax.Array,  # [Q] bool — keys-diverged abort
+    retried: jax.Array,  # [Q] bool — the stale->truth retry fired
+):
+    """Append this tick's sampled requests and bump the sampled-subset
+    counters; returns state with updated req_* fields.  Every argument
+    is one of route_tick's OWN masks/lanes — nothing is recomputed, so
+    the records are by construction what the counters summed."""
+    tick = state.req_tick + jnp.int32(1)
+    sampled = sample_mask(kh, params.req_salt, params.req_sample_log2)
+    rec_mask = sendable & sampled
+
+    def b(m):  # bool -> int32 lane
+        return m.astype(jnp.int32)
+
+    reroute = b(reroute_local) * rq.RR_LOCAL + b(reroute_remote) * rq.RR_REMOTE
+    outcome = (
+        b(differ) * rq.OUT_CHECKSUMS_DIFFER
+        + b(rejects) * rq.OUT_CHECKSUM_REJECT
+        + b(diverged) * rq.OUT_KEYS_DIVERGED
+    )
+    key_lane = jax.lax.bitcast_convert_type(kh, jnp.int32)
+    buf, head, drops = append_requests(
+        state.req_buf,
+        state.req_head,
+        state.req_drops,
+        rec_mask,
+        (
+            tick,  # broadcast scalar
+            key_lane,
+            senders,
+            dest,
+            own_truth,
+            b(misroute),
+            reroute,
+            b(retried),
+            b(multi_ok),
+            outcome,
+        ),
+    )
+
+    def cnt(m):
+        return jnp.sum(m & sampled, dtype=jnp.int32)
+
+    # slot order == obs.requests.COUNT_FIELDS
+    counts = state.req_counts + jnp.stack(
+        [
+            cnt(sendable),
+            cnt(misroute),
+            cnt(reroute_local),
+            cnt(reroute_remote),
+            cnt(diverged),
+            cnt(differ),
+            cnt(rejects),
+        ]
+    )
+    return state._replace(
+        req_buf=buf,
+        req_head=head,
+        req_drops=drops,
+        req_counts=counts,
+        req_tick=tick,
+    )

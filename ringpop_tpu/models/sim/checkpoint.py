@@ -102,6 +102,14 @@ _TRAJECTORY_NEUTRAL_PARAMS = frozenset(
         "bucket_bits",
         "max_changed",
         "max_dirty",
+        # request observatory (round 19): the sampled per-request trace
+        # plane is write-only like the flight recorder — a resume may
+        # toggle sampling or resize the buffer freely;
+        # RoutedStorm._rebuild_route_state opens a fresh trace window
+        "reqtrace",
+        "req_capacity",
+        "req_sample_log2",
+        "req_salt",
     }
 )
 # v2: incarnation fields are int32 tick stamps (engine.stamp_to_ms), not

@@ -42,6 +42,16 @@ become durable and queryable:
   exact wire-byte pricing, ``mesh.exchange.drain`` rows, the
   ``sharded.exchange.*`` statsd keys, and the measured-vs-model
   ``traffic_reconcile`` verdict every drained window ships.
+- :mod:`ringpop_tpu.obs.requests` — host half of the round-19 request
+  observatory (models/route/reqtrace.py): sampled per-request record
+  decoding, reconciliation against the device counters and
+  RouteMetrics, per-key span trees, the Perfetto request-lifecycle
+  export, and ``reqtrace.drain`` rows.
+- :mod:`ringpop_tpu.obs.slo` — sliding-window SLO plane: ring-buffered
+  per-window histogram deltas into windowed p50/p95/p99 + success
+  rate, declarative targets with error-budget burn rate, schema-gated
+  ``slo.window``/``slo.breach`` rows, and the burn-rate backpressure
+  consumer hook.
 - :mod:`ringpop_tpu.obs.xprof` — profiler trace harness:
   ``jax.profiler.trace`` capture with the warmup fenced outside the
   span, per-HLO-op self-time tables fuzzily keyed to COST_BUDGET

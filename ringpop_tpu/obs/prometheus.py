@@ -80,6 +80,71 @@ class PromWriter:
             "%s%s %s" % (name, label_str, _fmt_value(value))
         )
 
+    def histogram(
+        self,
+        name: str,
+        bucket_counts: Any,
+        help_: Optional[str] = None,
+        labels: Optional[Dict[str, Any]] = None,
+        sum_value: Optional[float] = None,
+    ) -> None:
+        """One native Prometheus histogram family from log2 bucket
+        counts (ops.histogram layout: bucket 0 holds {0}, bucket b holds
+        [2^(b-1), 2^b-1]).
+
+        Renders the cumulative ``<name>_bucket{le="..."}`` series — one
+        line per log2 bucket up to the last occupied one, bounds at the
+        bucket upper edges, plus the mandatory ``le="+Inf"`` line — and
+        the ``<name>_sum`` / ``<name>_count`` samples.  The true sum is
+        not recoverable from bucket counts, so ``_sum`` defaults to the
+        conservative upper-bound estimate ``sum(count * bucket_hi)``
+        unless the caller tracked it (``sum_value``)."""
+        from ringpop_tpu.ops import histogram as hg
+
+        counts = [int(c) for c in bucket_counts]
+        fam = self._families.get(name)
+        if fam is None:
+            fam = self._families[name] = {
+                "help": help_,
+                "type": "histogram",
+                "samples": [],
+            }
+            self._order.append(name)
+
+        def label_str(extra: Optional[Dict[str, Any]] = None) -> str:
+            merged = dict(labels or {})
+            if extra:
+                merged.update(extra)
+            if not merged:
+                return ""
+            return "{%s}" % ",".join(
+                '%s="%s"' % (k, _escape_label(v))
+                for k, v in sorted(merged.items())
+            )
+
+        last = max((b for b, c in enumerate(counts) if c), default=0)
+        cum = 0
+        for b in range(last + 1):
+            cum += counts[b]
+            fam["samples"].append(
+                "%s_bucket%s %d"
+                % (name, label_str({"le": str(hg.bucket_hi(b))}), cum)
+            )
+        total = sum(counts)
+        fam["samples"].append(
+            "%s_bucket%s %d" % (name, label_str({"le": "+Inf"}), total)
+        )
+        if sum_value is None:
+            sum_value = float(
+                sum(c * hg.bucket_hi(b) for b, c in enumerate(counts))
+            )
+        fam["samples"].append(
+            "%s_sum%s %s" % (name, label_str(), _fmt_value(sum_value))
+        )
+        fam["samples"].append(
+            "%s_count%s %d" % (name, label_str(), total)
+        )
+
     def render(self) -> str:
         lines: List[str] = []
         for name in self._order:
@@ -244,6 +309,87 @@ _COUNTERISH = (
     "rumors_retired",
     "dirty_rows",
 )
+
+
+def render_device_histograms(
+    hist: Any,
+    tracks: Any,
+    prefix: str = "ringpop_sim_",
+    labels: Optional[Dict[str, Any]] = None,
+) -> str:
+    """Prometheus text for a drained device histogram bank: one native
+    histogram family per track (``<prefix><track>``), rendered from the
+    [len(tracks), NBUCKETS] log2 bucket counts the engines carry
+    (obs.histograms drain layout)."""
+    import numpy as np
+
+    arr = np.asarray(hist)
+    w = PromWriter()
+    for i, track in enumerate(tracks):
+        w.histogram(
+            prefix + str(track),
+            arr[i],
+            "Device-side log2 histogram track %s" % track,
+            labels,
+        )
+    return w.render()
+
+
+def render_slo_plane(
+    plane: Any,
+    tick: int = 0,
+    prefix: str = "ringpop_slo_",
+    labels: Optional[Dict[str, Any]] = None,
+) -> str:
+    """Prometheus text for one obs.slo.SLOWindowPlane: the pooled
+    sliding-window bucket counts as a native histogram plus the window
+    row's health gauges (success rate, burn rate, breach flag) and
+    volume counters, all labeled by SLO target name."""
+    w = PromWriter()
+    row = plane.window_row(tick)
+    slo_labels = dict(labels or {}, target=row["target"])
+    w.histogram(
+        prefix + "window",
+        plane.window_counts(),
+        "Pooled sliding-window observations feeding the SLO verdict",
+        slo_labels,
+    )
+    w.sample(
+        prefix + "window_queries",
+        row["queries"],
+        "Requests in the sliding window",
+        "gauge",
+        slo_labels,
+    )
+    w.sample(
+        prefix + "window_errors",
+        row["errors"],
+        "Failed requests in the sliding window",
+        "gauge",
+        slo_labels,
+    )
+    w.sample(
+        prefix + "success_rate",
+        row["success_rate"],
+        "Windowed success rate",
+        "gauge",
+        slo_labels,
+    )
+    w.sample(
+        prefix + "burn_rate",
+        row["burn_rate"],
+        "Error-budget burn rate (1.0 = sustainable)",
+        "gauge",
+        slo_labels,
+    )
+    w.sample(
+        prefix + "breach",
+        1 if row["breach"] else 0,
+        "1 while the sliding window violates the SLO",
+        "gauge",
+        slo_labels,
+    )
+    return w.render()
 
 
 def render_tick_series(

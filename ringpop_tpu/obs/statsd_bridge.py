@@ -137,6 +137,50 @@ XPROF_KEY_MAP: Dict[str, Tuple[str, str]] = {
     "ops": ("gauge", "xprof.ops"),
 }
 
+# Request-trace drain telemetry (obs.requests.drain over the device-side
+# sampled per-request buffer, ISSUE 19): the drained record/drop volume
+# and the sampled-subset counters emit as deltas under the sim.reqtrace
+# namespace (the reference's per-request requestProxy stats are already
+# claimed by the RouteMetrics rows above — these are the SAMPLED view);
+# the configured sampling rate rides as a gauge.  Counter keys are keyed
+# by obs.requests.COUNT_FIELDS name (lockstep pinned in
+# tests/obs/test_statsd_bridge.py).
+REQTRACE_KEY_MAP: Dict[str, Tuple[str, str]] = {
+    "records": ("increment", "sim.reqtrace.records"),
+    "drops": ("increment", "sim.reqtrace.drops"),
+    "sample_log2": ("gauge", "sim.reqtrace.sample-log2"),
+    "queries": ("increment", "sim.reqtrace.sampled.queries"),
+    "misroutes": ("increment", "sim.reqtrace.sampled.misroutes"),
+    "reroute_local": ("increment", "sim.reqtrace.sampled.reroute.local"),
+    "reroute_remote": ("increment", "sim.reqtrace.sampled.reroute.remote"),
+    "keys_diverged": ("increment", "sim.reqtrace.sampled.keys-diverged"),
+    "checksums_differ": (
+        "increment",
+        "sim.reqtrace.sampled.checksums-differ",
+    ),
+    "checksum_rejects": (
+        "increment",
+        "sim.reqtrace.sampled.checksum-rejects",
+    ),
+}
+
+# Sliding-window SLO plane (obs.slo.SLOWindowPlane): per-window rows
+# emit under ``slo.<target>.<suffix>`` — windowed percentiles as TIMER
+# samples (|ms wire type, matching the histogram-summary discipline),
+# health ratios as gauges, breaches as counters.  Suffixes are keyed by
+# slo.window row field (lockstep pinned in
+# tests/obs/test_statsd_bridge.py).
+SLO_KEY_MAP: Dict[str, Tuple[str, str]] = {
+    "p50": ("timing", "p50"),
+    "p95": ("timing", "p95"),
+    "p99": ("timing", "p99"),
+    "success_rate": ("gauge", "success-rate"),
+    "burn_rate": ("gauge", "burn-rate"),
+    "queries": ("increment", "window.queries"),
+    "errors": ("increment", "window.errors"),
+}
+SLO_BREACH_KEY = "breach"
+
 # Recovery-plane lifecycle counters (models/sim/recovery.py): emitted by
 # CheckpointManager directly (they are per-event, not per-tick, so they
 # ride their own map rather than TICK_KEY_MAP).  The reference has no
@@ -266,6 +310,65 @@ class StatsdBridge:
                 self._stat(stat_type, key, value)
                 emitted += 1
         return emitted
+
+    def emit_reqtrace_drain(
+        self,
+        row: Dict[str, Any],
+        key_map: Optional[Dict[str, Tuple[str, str]]] = None,
+    ) -> int:
+        """One drained request-trace window (obs.requests.drain_row) ->
+        ``sim.reqtrace.*``: record/drop volume and the sampled-subset
+        counters emit only when nonzero (statsd increments are deltas);
+        the sampling rate always emits as a gauge.  Returns the number
+        of emissions."""
+        key_map = REQTRACE_KEY_MAP if key_map is None else key_map
+        flat = dict(row)
+        flat.update(flat.pop("counts", {}) or {})
+        emitted = 0
+        for field, value in flat.items():
+            mapped = key_map.get(field)
+            if mapped is None:
+                continue
+            stat_type, key = mapped
+            if stat_type == "increment":
+                if value:
+                    self.increment(key, int(value))
+                    emitted += 1
+            else:
+                self._stat(stat_type, key, value)
+                emitted += 1
+        return emitted
+
+    def emit_slo_window(
+        self,
+        row: Dict[str, Any],
+        key_map: Optional[Dict[str, Tuple[str, str]]] = None,
+    ) -> int:
+        """One ``slo.window`` row (obs.slo.SLOWindowPlane.window_row) ->
+        ``slo.<target>.<suffix>``: windowed percentiles as timer samples
+        (empty windows skip them), success/burn rates as gauges, window
+        query/error volume as counter deltas.  Returns the number of
+        emissions."""
+        key_map = SLO_KEY_MAP if key_map is None else key_map
+        prefix = "slo.%s" % row["target"]
+        emitted = 0
+        for field, (stat_type, suffix) in key_map.items():
+            value = row.get(field)
+            if value is None:
+                continue
+            key = "%s.%s" % (prefix, suffix)
+            if stat_type == "increment":
+                if value:
+                    self.increment(key, int(value))
+                    emitted += 1
+            else:
+                self._stat(stat_type, key, value)
+                emitted += 1
+        return emitted
+
+    def emit_slo_breach(self, target: str) -> None:
+        """One SLO breach -> ``slo.<target>.breach`` counter tick."""
+        self.increment("slo.%s.%s" % (target, SLO_BREACH_KEY))
 
     def emit_tick(self, row: Any) -> int:
         """One tick's metrics (NamedTuple or dict).  Counters emit only

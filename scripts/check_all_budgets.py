@@ -1,7 +1,7 @@
 #!/usr/bin/env python
 """Run every committed-manifest gate in one shot with a summary table.
 
-ISSUE 18 satellite: the repo now has seven chip-free gates, each a
+ISSUE 18 satellite: the repo now has eight chip-free gates, each a
 standalone ``scripts/check_*.py`` diffing live analysis against a
 committed artifact (or validating committed artifacts in place).  This
 driver runs them all (subprocesses: each gate owns its JAX state, same
@@ -37,6 +37,7 @@ GATES = (
     ("metrics-schema", "check_metrics_schema.py"),
     ("ckpt-manifest", "check_ckpt_manifest.py"),
     ("traffic-model", "check_traffic_model.py"),
+    ("bench-trajectory", "collate_bench_trajectory.py"),
 )
 
 

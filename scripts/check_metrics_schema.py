@@ -150,6 +150,41 @@ ROUTE_EVENT_FIELDS = {
         "ratio",
         "fallback_trips",
     ),
+    # round-19 request observatory: every drained request-trace window
+    # names its sampling configuration and carries the sampled-subset
+    # counter object (obs.requests.drain_row — counts is a dict holding
+    # every obs.requests.COUNT_FIELDS key, checked below); every SLO
+    # window row carries the windowed health verdict, and every breach
+    # names its violated clauses.  Field sets are kept in lockstep with
+    # obs/requests.py and obs/slo.py by tests/obs/test_runlog_schema.py.
+    "reqtrace.drain": (
+        "source",
+        "records",
+        "drops",
+        "cap",
+        "sample_log2",
+        "counts",
+    ),
+    "slo.window": (
+        "target",
+        "tick",
+        "window_ticks",
+        "windows",
+        "queries",
+        "errors",
+        "success_rate",
+        "burn_rate",
+        "breach",
+        "breach_reason",
+    ),
+    "slo.breach": (
+        "target",
+        "tick",
+        "window_ticks",
+        "reason",
+        "burn_rate",
+        "success_rate",
+    ),
     # profiler capture rows (obs.xprof.XPROF_FIELDS — pinned by
     # tests/obs/test_runlog_schema.py): every capture names its phase
     # and trace artifact even when the capture itself failed (ok=False)
@@ -163,6 +198,55 @@ ROUTE_EVENT_FIELDS = {
         "ops",
     ),
 }
+
+
+# static copies of the decoder's registries (the checker must not import
+# the package — it validates artifacts standalone); lockstep pinned to
+# obs.requests.COUNT_FIELDS / obs.slo.WINDOW_QS by
+# tests/obs/test_runlog_schema.py
+REQTRACE_COUNT_FIELDS = (
+    "queries",
+    "misroutes",
+    "reroute_local",
+    "reroute_remote",
+    "keys_diverged",
+    "checksums_differ",
+    "checksum_rejects",
+)
+SLO_WINDOW_QS = (50, 95, 99)
+
+
+def _check_reqtrace_drain(row: dict, path: str, ln: int) -> list:
+    """reqtrace.drain rows: the counts object must carry every
+    sampled-subset counter the decoder reconciles against."""
+    problems = []
+    counts = row.get("counts")
+    if not isinstance(counts, dict):
+        if "counts" in row:
+            problems.append(
+                "%s:%d: reqtrace.drain counts must be an object"
+                % (path, ln)
+            )
+        return problems
+    for field in REQTRACE_COUNT_FIELDS:
+        if field not in counts:
+            problems.append(
+                "%s:%d: reqtrace.drain counts missing %r"
+                % (path, ln, field)
+            )
+    return problems
+
+
+def _check_slo_window(row: dict, path: str, ln: int) -> list:
+    """slo.window rows: every windowed percentile key must be present
+    (None for an empty window is valid)."""
+    problems = []
+    for q in SLO_WINDOW_QS:
+        if "p%d" % q not in row:
+            problems.append(
+                "%s:%d: slo.window row missing %r" % (path, ln, "p%d" % q)
+            )
+    return problems
 
 
 def _check_hist_drain(row: dict, path: str, ln: int) -> list:
@@ -229,6 +313,10 @@ def _check_route_rows(path: str) -> list:
                             )
                 if row.get("name") == "hist.drain":
                     problems.extend(_check_hist_drain(row, path, ln))
+                elif row.get("name") == "reqtrace.drain":
+                    problems.extend(_check_reqtrace_drain(row, path, ln))
+                elif row.get("name") == "slo.window":
+                    problems.extend(_check_slo_window(row, path, ln))
     return problems
 
 

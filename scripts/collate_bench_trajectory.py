@@ -1,0 +1,284 @@
+#!/usr/bin/env python
+"""Fold every committed ``BENCH_*.json`` into ``BENCH_TRAJECTORY.json``
+— the unified bench trajectory (ISSUE 19 satellite).
+
+Each growth round commits one flat ``BENCH_r<round>[_tag][_backend]``
+snapshot; until now nothing read them together, so the repo's headline
+numbers had no visible history.  The collator parses round and backend
+out of each filename, keeps every numeric metric (bool gates fold to
+0/1), groups metrics into their bench phase by name prefix, and writes
+one deterministic artifact: per-backend, per-phase metric series keyed
+by round.  Metadata strings (cmd, tail, note, runlog paths) and list
+payloads stay out — the trajectory tracks numbers.
+
+Usage::
+
+    python scripts/collate_bench_trajectory.py            # gate: committed
+                                                          # artifact must match
+                                                          # a regeneration
+    python scripts/collate_bench_trajectory.py --write    # regenerate
+    python scripts/collate_bench_trajectory.py --check    # flag >10%
+                                                          # regressions between
+                                                          # consecutive rounds
+                                                          # (same backend)
+
+The no-argument mode is the eighth ``check_all_budgets.py`` gate: it
+exits 1 when the committed trajectory is stale (a new BENCH file landed
+without re-running ``--write``) and prints — without failing on — the
+``--check`` regression report, so drift is visible on every gate run.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import re
+import sys
+from pathlib import Path
+
+REPO_ROOT = Path(__file__).resolve().parents[1]
+ARTIFACT = REPO_ROOT / "BENCH_TRAJECTORY.json"
+
+_NAME_RE = re.compile(r"^BENCH_r(\d+)((?:_[a-z0-9]+)*)\.json$")
+_BACKENDS = ("cpu", "tpu", "gpu")
+
+# metric-name prefix -> bench phase (first match wins; order matters:
+# churn_parity_ before parity_).  Unmatched metrics ride "core" — the
+# headline swim numbers and run metadata scalars.
+PHASE_PREFIXES = (
+    ("churn_parity_", "churn_parity"),
+    ("parity_", "parity"),
+    ("scalable_", "scalable"),
+    ("route_", "route"),
+    ("reqtrace_", "reqtrace"),
+    ("slo_", "slo"),
+    ("ckpt_", "ckpt"),
+    ("mesh_", "mesh"),
+    ("fuzz_", "fuzz"),
+    ("hist_", "hist"),
+    ("full_", "full"),
+    ("exchange_", "exchange"),
+    ("xprof_", "xprof"),
+)
+
+# fractional drop (improvement-direction-aware) between consecutive
+# rounds of one backend that --check flags
+REGRESSION_THRESHOLD = 0.10
+
+# metric-name suffix heuristics for improvement direction: rates and
+# throughputs regress DOWN, latencies and overheads regress UP.
+# Higher-better is matched FIRST ("..._per_sec" must not fall into the
+# "_sec" bucket).  Unmatched metrics — including the round-dependent
+# "value"/"elapsed_s" headline scalars, whose meaning shifts with the
+# round's bench configuration — are informational and never flagged.
+_HIGHER_BETTER = (
+    "_per_sec",
+    "_mbps",
+    "_gbps",
+    "_vs_baseline",
+    "_efficiency",
+    "_equal",
+    "_converged",
+)
+_LOWER_BETTER = ("_ms", "_overhead_frac", "_drops")
+
+
+def parse_name(name: str):
+    """``BENCH_r<round>[_tag...][_backend].json`` -> (round, backend)
+    or None for non-matching names.  The backend is the trailing token
+    when it names a known platform; earlier rounds committed none, and
+    those fold under "unknown"."""
+    m = _NAME_RE.match(name)
+    if not m:
+        return None
+    rnd = int(m.group(1))
+    tokens = [t for t in m.group(2).split("_") if t]
+    backend = tokens[-1] if tokens and tokens[-1] in _BACKENDS else "unknown"
+    return rnd, backend
+
+
+def phase_of(metric: str) -> str:
+    for prefix, phase in PHASE_PREFIXES:
+        if metric.startswith(prefix):
+            return phase
+    return "core"
+
+
+def numeric_metrics(payload: dict) -> dict:
+    """The flat numeric view of one BENCH snapshot: ints/floats kept,
+    bools folded to 0/1 (the bitwise gate verdicts ARE trajectory
+    signal), everything else — strings, lists, nested objects, null —
+    dropped."""
+    out = {}
+    for key, value in payload.items():
+        if isinstance(value, bool):
+            out[key] = int(value)
+        elif isinstance(value, (int, float)):
+            out[key] = value
+    return out
+
+
+def collate(root: Path = REPO_ROOT) -> dict:
+    """Fold the committed BENCH files into the trajectory structure:
+    ``backends.<backend>.rounds`` (sorted, as strings in ``series``
+    keys for JSON stability) and ``backends.<backend>.phases.<phase>.
+    <metric> = {round: value}``."""
+    sources = []
+    backends: dict = {}
+    for path in sorted(root.glob("BENCH_*.json")):
+        if path.name == ARTIFACT.name:
+            continue
+        parsed = parse_name(path.name)
+        if parsed is None:
+            continue
+        rnd, backend = parsed
+        try:
+            payload = json.loads(path.read_text(encoding="utf-8"))
+        except ValueError:
+            continue
+        if not isinstance(payload, dict):
+            continue
+        sources.append(path.name)
+        b = backends.setdefault(backend, {"rounds": [], "phases": {}})
+        if rnd not in b["rounds"]:
+            b["rounds"].append(rnd)
+        for metric, value in numeric_metrics(payload).items():
+            series = b["phases"].setdefault(phase_of(metric), {})
+            series.setdefault(metric, {})[str(rnd)] = value
+    for b in backends.values():
+        b["rounds"].sort()
+    return {
+        "generated_by": "scripts/collate_bench_trajectory.py",
+        "sources": sources,
+        "backends": {k: backends[k] for k in sorted(backends)},
+    }
+
+
+def direction(metric: str):
+    """+1 higher-is-better, -1 lower-is-better, None informational."""
+    for suffix in _HIGHER_BETTER:
+        if metric.endswith(suffix):
+            return +1
+    for suffix in _LOWER_BETTER:
+        if metric.endswith(suffix):
+            return -1
+    return None
+
+
+def regressions(trajectory: dict, threshold: float = REGRESSION_THRESHOLD):
+    """>threshold moves AGAINST a metric's improvement direction
+    between consecutive recorded rounds of the same backend."""
+    out = []
+    for backend, b in trajectory.get("backends", {}).items():
+        for phase, series in b.get("phases", {}).items():
+            for metric, points in series.items():
+                sign = direction(metric)
+                if sign is None:
+                    continue
+                rounds = sorted(points, key=int)
+                for prev, cur in zip(rounds, rounds[1:]):
+                    a, z = points[prev], points[cur]
+                    if not a:
+                        continue
+                    delta = sign * (z - a) / abs(a)
+                    if delta < -threshold:
+                        out.append(
+                            {
+                                "backend": backend,
+                                "phase": phase,
+                                "metric": metric,
+                                "from_round": int(prev),
+                                "to_round": int(cur),
+                                "from": a,
+                                "to": z,
+                                "drop_frac": -delta,
+                            }
+                        )
+    return out
+
+
+def render(trajectory: dict) -> str:
+    return json.dumps(trajectory, indent=2, sort_keys=True) + "\n"
+
+
+def report_regressions(trajectory: dict, threshold: float) -> int:
+    found = regressions(trajectory, threshold)
+    for r in found:
+        print(
+            "REGRESSION %(backend)s %(phase)s.%(metric)s "
+            "r%(from_round)d -> r%(to_round)d: %(from)g -> %(to)g "
+            "(-%(pct).0f%%)"
+            % dict(r, pct=100 * r["drop_frac"])
+        )
+    return len(found)
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument(
+        "--write",
+        action="store_true",
+        help="(re)write BENCH_TRAJECTORY.json from the committed files",
+    )
+    parser.add_argument(
+        "--check",
+        action="store_true",
+        help="exit 1 if any metric regressed >threshold between "
+        "consecutive rounds of one backend",
+    )
+    parser.add_argument(
+        "--threshold", type=float, default=REGRESSION_THRESHOLD
+    )
+    args = parser.parse_args(argv)
+
+    trajectory = collate()
+    if args.write:
+        ARTIFACT.write_text(render(trajectory), encoding="utf-8")
+        n = sum(
+            len(b["rounds"]) for b in trajectory["backends"].values()
+        )
+        print(
+            "wrote %s (%d snapshots, backends: %s)"
+            % (
+                ARTIFACT.name,
+                n,
+                ", ".join(trajectory["backends"]) or "none",
+            )
+        )
+        report_regressions(trajectory, args.threshold)
+        return 0
+    if args.check:
+        found = report_regressions(trajectory, args.threshold)
+        print(
+            "%d regression(s) above %.0f%%"
+            % (found, 100 * args.threshold)
+        )
+        return 1 if found else 0
+
+    # gate mode: the committed artifact must match a regeneration
+    if not ARTIFACT.exists():
+        print(
+            "%s missing — run scripts/collate_bench_trajectory.py --write"
+            % ARTIFACT.name,
+            file=sys.stderr,
+        )
+        return 1
+    committed = ARTIFACT.read_text(encoding="utf-8")
+    fresh = render(trajectory)
+    if committed != fresh:
+        print(
+            "%s is stale — run scripts/collate_bench_trajectory.py --write"
+            % ARTIFACT.name,
+            file=sys.stderr,
+        )
+        return 1
+    print(
+        "%s: OK (%d source snapshots)"
+        % (ARTIFACT.name, len(trajectory["sources"]))
+    )
+    report_regressions(trajectory, args.threshold)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,139 @@
+#!/usr/bin/env python
+"""Generate the committed request-observatory sample artifacts (runlogs/).
+
+Runs an n=64 RoutedStorm with the sampled per-request trace buffer AND
+the device histograms enabled, drained in fixed windows that feed the
+sliding-window SLO plane.  The middle windows inject a churn burst
+(kill a quarter of the cluster, rejoin later), so the committed runlog
+demonstrates the full story the request observatory tells:
+
+- ``runlogs/sample_requests_n64.runlog.jsonl`` — per-tick sim+route
+  metric rows, one ``reqtrace.drain`` + ``hist.drain`` + ``slo.window``
+  row per drained window, and the ``slo.breach`` rows the churn burst
+  fires (schema-gated by scripts/check_metrics_schema.py),
+- ``runlogs/sample_requests_n64.requests.trace.json`` — the Perfetto
+  request-lifecycle sidecar (one track per sender, flow arrows for
+  remote reroutes; load at https://ui.perfetto.dev).
+
+Deterministic (fixed seed, CPU-pinnable via JAX_PLATFORMS=cpu), so the
+artifacts regenerate reproducibly::
+
+    JAX_PLATFORMS=cpu python scripts/export_request_trace.py
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO_ROOT)
+
+N = 64
+WINDOW_TICKS = 5
+WINDOWS = 8
+BURST_WINDOWS = (2, 3)  # churn burst: kill in window 2, rejoin in 3
+RUN_ID = "sample_requests_n%d" % N
+
+
+def main() -> int:
+    import numpy as np
+
+    from ringpop_tpu.models.route import reqtrace as rt
+    from ringpop_tpu.models.route.plane import RoutedStorm, RouteParams
+    from ringpop_tpu.models.sim import engine_scalable as es
+    from ringpop_tpu.models.sim.storm import StormSchedule
+    from ringpop_tpu.obs import RunRecorder
+    from ringpop_tpu.obs import requests as oreq
+    from ringpop_tpu.obs.slo import SLOBackpressure, SLOTarget, SLOWindowPlane
+
+    out_dir = os.path.join(REPO_ROOT, "runlogs")
+    os.makedirs(out_dir, exist_ok=True)
+
+    qpt = 256
+    route = RouteParams(
+        n=N,
+        queries_per_tick=qpt,
+        key_space=1024,
+        histograms=True,
+        reqtrace=True,
+        # drop-free at worst case for one drain window (sized the
+        # flight-recorder way: capacity >= ticks * max-per-tick)
+        req_capacity=rt.req_capacity_for(qpt, WINDOW_TICKS),
+        req_sample_log2=2,  # trace 1/4 of the key space
+    )
+    rs = RoutedStorm(
+        N,
+        params=es.ScalableParams(n=N, u=192, suspicion_ticks=4),
+        route=route,
+        seed=1,
+    )
+    rec = RunRecorder(
+        os.path.join(out_dir, "%s.runlog.jsonl" % RUN_ID),
+        run_id=RUN_ID,
+        config={"tool": "scripts/export_request_trace.py", "seed": 1},
+    )
+    # regenerate in place: the recorder appends, so stale rows must go
+    open(rec.path, "w").close()
+    rs.attach_recorder(rec)
+
+    backpressure = SLOBackpressure(base_period_ms=200.0)
+    slo = SLOWindowPlane(
+        SLOTarget(name="route", success_objective=0.999, burn_alert=2.0),
+        window_len=3,
+        recorder=rec,
+        consumer=backpressure,
+    )
+
+    # the burst: a quarter of the cluster dies in window 2, rejoins in 3
+    burst = np.random.default_rng(7).choice(N, N // 4, replace=False)
+    all_requests = []
+    tick = 0
+    for w in range(WINDOWS):
+        sched = StormSchedule(ticks=WINDOW_TICKS, n=N)
+        if w == BURST_WINDOWS[0]:
+            sched.kill[1, burst] = True
+        elif w == BURST_WINDOWS[1]:
+            sched.revive[1, burst] = True
+        _, rm = rs.run(sched)
+        tick += WINDOW_TICKS
+
+        hist = np.asarray(rs.rstate.hist)  # window delta: reset follows
+        rs.drain_histograms(reset=True)
+        slo.observe_route_window(tick, hist, rm)
+        drained = rs.drain_requests(reset=True)
+        assert drained["drops"] == 0, "sized capacity must be drop-free"
+        recon = oreq.reconcile_metrics(
+            np.asarray(
+                [drained["counts"][f] for f in oreq.COUNT_FIELDS]
+            ),
+            rm,
+        )
+        assert all(v["ok"] for v in recon.values()), recon
+        all_requests.extend(drained["records"])
+
+    assert slo.breaches > 0, "the churn burst must fire a breach"
+    assert backpressure.factor() == 1.0, (
+        "the quiet tail windows must clear the breach"
+    )
+
+    trace = oreq.export_request_trace(all_requests, N)
+    sidecar = rec.record_trace_sidecar(trace, name="requests")
+
+    rec.finish(
+        requests_traced=len(all_requests),
+        slo_breaches=slo.breaches,
+        windows=WINDOWS,
+        window_ticks=WINDOW_TICKS,
+    )
+    print("wrote %s" % os.path.relpath(rec.path, REPO_ROOT))
+    print("wrote %s" % os.path.relpath(sidecar, REPO_ROOT))
+    print(
+        "requests=%d breaches=%d"
+        % (len(all_requests), slo.breaches)
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

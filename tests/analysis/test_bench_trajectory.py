@@ -1,0 +1,164 @@
+"""Bench-trajectory collator (scripts/collate_bench_trajectory.py):
+filename parsing, phase/direction classification, deterministic
+collation, the regression detector, and the committed-artifact gate the
+eighth check_all_budgets.py entry runs (ISSUE 19 satellite)."""
+
+from __future__ import annotations
+
+import importlib.util
+import json
+import os
+
+import pytest
+
+REPO_ROOT = os.path.dirname(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+
+
+def _load():
+    spec = importlib.util.spec_from_file_location(
+        "collate_bench_trajectory",
+        os.path.join(
+            REPO_ROOT, "scripts", "collate_bench_trajectory.py"
+        ),
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+@pytest.fixture(scope="module")
+def mod():
+    return _load()
+
+
+def test_parse_name(mod):
+    assert mod.parse_name("BENCH_r4.json") == (4, "unknown")
+    assert mod.parse_name("BENCH_r12_cpu.json") == (12, "cpu")
+    assert mod.parse_name("BENCH_r7_mesh_tpu.json") == (7, "tpu")
+    # a trailing non-platform tag folds under "unknown", not a backend
+    assert mod.parse_name("BENCH_r7_mesh.json") == (7, "unknown")
+    assert mod.parse_name("BENCH_TRAJECTORY.json") is None
+    assert mod.parse_name("BENCH_rX.json") is None
+    assert mod.parse_name("notes.json") is None
+
+
+def test_phase_of_ordering(mod):
+    # churn_parity_ must win over parity_ (prefix order matters)
+    assert mod.phase_of("churn_parity_ticks") == "churn_parity"
+    assert mod.phase_of("parity_ticks") == "parity"
+    assert mod.phase_of("route_queries_per_sec") == "route"
+    assert mod.phase_of("reqtrace_records") == "reqtrace"
+    assert mod.phase_of("slo_p99") == "slo"
+    assert mod.phase_of("value") == "core"
+
+
+def test_numeric_metrics_keeps_numbers_folds_bools(mod):
+    out = mod.numeric_metrics(
+        {
+            "a": 3,
+            "b": 2.5,
+            "gate": True,
+            "off": False,
+            "cmd": "python bench.py",  # string: dropped
+            "series": [1, 2],  # list: dropped
+            "nested": {"x": 1},  # object: dropped
+            "none": None,
+        }
+    )
+    assert out == {"a": 3, "b": 2.5, "gate": 1, "off": 0}
+
+
+def test_direction_higher_better_wins_over_suffix_collision(mod):
+    # the _per_sec / _sec collision: throughputs are HIGHER-better
+    assert mod.direction("parity_mode_node_ticks_per_sec") == +1
+    assert mod.direction("route_wire_mbps") == +1
+    assert mod.direction("rings_equal") == +1
+    assert mod.direction("drain_ms") == -1
+    assert mod.direction("hist_overhead_frac") == -1
+    assert mod.direction("reqtrace_drops") == -1
+    # round-dependent headline scalars are informational, never flagged
+    assert mod.direction("value") is None
+    assert mod.direction("elapsed_s") is None
+
+
+def _write_bench(root, name, payload):
+    (root / name).write_text(json.dumps(payload), encoding="utf-8")
+
+
+def test_collate_and_regressions(mod, tmp_path):
+    _write_bench(
+        tmp_path,
+        "BENCH_r1_cpu.json",
+        {"route_ticks_per_sec": 100.0, "drain_ms": 10.0, "note": "x"},
+    )
+    _write_bench(
+        tmp_path,
+        "BENCH_r2_cpu.json",
+        {"route_ticks_per_sec": 80.0, "drain_ms": 10.5},
+    )
+    _write_bench(tmp_path, "BENCH_r2_tpu.json", {"route_ticks_per_sec": 5.0})
+    _write_bench(tmp_path, "broken.json", {"x": 1})  # ignored
+    (tmp_path / "BENCH_r3_cpu.json").write_text("not json")  # ignored
+    traj = mod.collate(tmp_path)
+    assert traj["sources"] == [
+        "BENCH_r1_cpu.json",
+        "BENCH_r2_cpu.json",
+        "BENCH_r2_tpu.json",
+    ]
+    cpu = traj["backends"]["cpu"]
+    assert cpu["rounds"] == [1, 2]
+    assert cpu["phases"]["route"]["route_ticks_per_sec"] == {
+        "1": 100.0,
+        "2": 80.0,
+    }
+    assert "note" not in str(cpu["phases"])
+    # backends never cross-compare: the tpu round is no regression
+    found = mod.regressions(traj)
+    assert len(found) == 1
+    r = found[0]
+    assert (r["backend"], r["metric"]) == ("cpu", "route_ticks_per_sec")
+    assert r["from_round"] == 1 and r["to_round"] == 2
+    assert r["drop_frac"] == pytest.approx(0.2)
+    # the 5% drain_ms wobble stays under the 10% threshold...
+    assert not any(f["metric"] == "drain_ms" for f in found)
+    # ...but a tighter threshold flags it (direction-aware: UP is bad)
+    tight = mod.regressions(traj, threshold=0.04)
+    assert any(f["metric"] == "drain_ms" for f in tight)
+
+
+def test_render_is_deterministic(mod, tmp_path):
+    _write_bench(tmp_path, "BENCH_r1.json", {"b": 2, "a": 1})
+    one = mod.render(mod.collate(tmp_path))
+    two = mod.render(mod.collate(tmp_path))
+    assert one == two
+    assert one.endswith("\n")
+    json.loads(one)  # valid JSON
+
+
+def test_committed_artifact_matches_regeneration(mod):
+    """The gate itself: BENCH_TRAJECTORY.json is committed and must
+    byte-match a fresh collation of the committed BENCH files."""
+    artifact = os.path.join(REPO_ROOT, "BENCH_TRAJECTORY.json")
+    assert os.path.exists(artifact), (
+        "run scripts/collate_bench_trajectory.py --write"
+    )
+    with open(artifact, encoding="utf-8") as fh:
+        committed = fh.read()
+    assert committed == mod.render(mod.collate()), (
+        "BENCH_TRAJECTORY.json is stale — re-run "
+        "scripts/collate_bench_trajectory.py --write"
+    )
+    traj = json.loads(committed)
+    assert traj["sources"], "the trajectory must fold real snapshots"
+
+
+def test_gate_is_registered_in_check_all_budgets(mod):
+    spec = importlib.util.spec_from_file_location(
+        "check_all_budgets",
+        os.path.join(REPO_ROOT, "scripts", "check_all_budgets.py"),
+    )
+    driver = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(driver)
+    assert ("bench-trajectory", "collate_bench_trajectory.py") in driver.GATES

@@ -1,0 +1,368 @@
+"""Sampled per-request trace records are trajectory-neutral and honest.
+
+Pins (ISSUE 19): enabling the request-trace plane changes NO protocol
+state bit on the routed storm across BOTH ring impls (the gate-
+equivalence acceptance, test_hist_neutral.py discipline); decoded
+records reconcile exactly against the device-side sampled counters and
+the counters against the window's RouteMetrics totals (equal at
+sample_log2=0, a subset otherwise); capacity sized by
+``req_capacity_for`` is drop-free and overflow keeps an honest prefix;
+hash-of-key sampling is chi-square-unbiased across Zipf-skewed key
+mixes; the checkpoint knob is trajectory-neutral on resume."""
+
+import numpy as np
+import pytest
+
+from ringpop_tpu.models.route import reqtrace as rt
+from ringpop_tpu.models.route import traffic
+from ringpop_tpu.models.route.plane import RoutedStorm, RouteParams
+from ringpop_tpu.models.sim import engine_scalable as es
+from ringpop_tpu.models.sim.storm import StormSchedule
+from ringpop_tpu.obs import requests as oreq
+
+
+def _params(n, **kw):
+    return es.ScalableParams(n=n, u=192, suspicion_ticks=4, **kw)
+
+
+def _route(n, **kw):
+    base = dict(queries_per_tick=256, key_space=1024)
+    base.update(kw)
+    return RouteParams(n=n, **base)
+
+
+def _storm(n, ticks, seed=3):
+    return StormSchedule.churn_storm(
+        ticks=ticks, n=n, fraction=0.15, seed=seed
+    )
+
+
+def _run(n, ticks, seed=3, storm_seed=3, **route_kw):
+    rs = RoutedStorm(
+        n, params=_params(n), route=_route(n, **route_kw), seed=seed
+    )
+    em, rm = rs.run(_storm(n, ticks, seed=storm_seed))
+    return rs, em, rm
+
+
+def _assert_cluster_states_equal(sa, sb):
+    for f in type(sa)._fields:
+        va, vb = getattr(sa, f), getattr(sb, f)
+        if va is None and vb is None:
+            continue
+        assert va is not None and vb is not None, f
+        assert np.array_equal(np.asarray(va), np.asarray(vb)), (
+            "field %s diverged under reqtrace" % f
+        )
+
+
+# -- gate equivalence --------------------------------------------------------
+
+
+def test_routed_storm_reqtrace_gate_equivalence_n64():
+    """Both ring impls, histograms on, sampling off/on: membership
+    state, metrics, and the truth ring are bitwise-invisible to the
+    trace plane — and the records themselves are impl-independent
+    (the masks are)."""
+    n = 64
+    runs = {}
+    for impl in ("incremental", "full"):
+        for reqtrace in (False, True):
+            rs, em, rm = _run(
+                n,
+                30,
+                ring_impl=impl,
+                histograms=True,
+                reqtrace=reqtrace,
+                req_capacity=rt.req_capacity_for(256, 30),
+                req_sample_log2=2,
+            )
+            runs[impl, reqtrace] = (rs, em, rm)
+    for impl in ("incremental", "full"):
+        (ra, ea, ma), (rb, eb, mb) = runs[impl, False], runs[impl, True]
+        _assert_cluster_states_equal(ra.cluster.state, rb.cluster.state)
+        assert ra.ring_checksum() == rb.ring_checksum()
+        for f in ma._fields:
+            assert np.array_equal(
+                np.asarray(getattr(ma, f)), np.asarray(getattr(mb, f))
+            ), f
+        for f in ea._fields:
+            assert np.array_equal(
+                np.asarray(getattr(ea, f)), np.asarray(getattr(eb, f))
+            ), f
+        assert ra.rstate.req_buf is None
+        assert rb.rstate.req_buf is not None
+    # impl-independence of the trace itself: same masks, same records
+    ri, rf = runs["incremental", True][0], runs["full", True][0]
+    np.testing.assert_array_equal(
+        np.asarray(ri.rstate.req_buf), np.asarray(rf.rstate.req_buf)
+    )
+    assert int(ri.rstate.req_head) == int(rf.rstate.req_head)
+    np.testing.assert_array_equal(
+        np.asarray(ri.rstate.req_counts), np.asarray(rf.rstate.req_counts)
+    )
+    assert int(ri.rstate.req_head) > 0, "the storm must trace something"
+
+
+@pytest.mark.slow
+def test_routed_storm_reqtrace_gate_equivalence_n1k():
+    n = 1000
+    out = []
+    for reqtrace in (False, True):
+        rs = RoutedStorm(
+            n,
+            params=es.ScalableParams(n=n, u=512),
+            route=RouteParams(
+                n=n,
+                queries_per_tick=256,
+                key_space=1024,
+                histograms=True,
+                reqtrace=reqtrace,
+                req_capacity=rt.req_capacity_for(256, 16),
+                req_sample_log2=2,
+            ),
+            seed=4,
+        )
+        em, rm = rs.run(
+            StormSchedule.churn_storm(16, n, fraction=0.1, seed=4)
+        )
+        out.append((rs, em, rm))
+    (ra, ea, ma), (rb, eb, mb) = out
+    _assert_cluster_states_equal(ra.cluster.state, rb.cluster.state)
+    assert ra.ring_checksum() == rb.ring_checksum()
+    for f in ma._fields:
+        assert np.array_equal(
+            np.asarray(getattr(ma, f)), np.asarray(getattr(mb, f))
+        ), f
+    assert int(rb.rstate.req_head) > 0
+
+
+# -- reconciliation honesty --------------------------------------------------
+
+
+def test_reconciliation_exact_at_sample_everything():
+    """sample_log2=0 traces EVERY sendable request: decoded records ==
+    device counters == the window's RouteMetrics totals, field for
+    field — the honesty acceptance."""
+    rs, _, rm = _run(
+        64,
+        20,
+        reqtrace=True,
+        req_capacity=rt.req_capacity_for(256, 20),
+        req_sample_log2=0,
+    )
+    st = rs.rstate
+    rec = oreq.reconcile_records(st.req_buf, st.req_head, st.req_counts)
+    assert all(v["match"] for v in rec.values()), rec
+    met = oreq.reconcile_metrics(st.req_counts, rm)
+    assert set(met) == set(oreq.COUNT_FIELDS)
+    for field, v in met.items():
+        assert v["sampled"] == v["total"], (field, v)
+    assert int(st.req_drops) == 0
+    # and the record stream is the full request stream
+    assert int(st.req_head) == int(np.asarray(rm.route_queries).sum())
+
+
+def test_reconciliation_sampled_subset():
+    """At a real sampling rate the counters are a subset of the totals
+    (never more), records still match the counters exactly, and the
+    drained row carries the same story."""
+    rs, _, rm = _run(
+        64,
+        20,
+        reqtrace=True,
+        req_capacity=rt.req_capacity_for(256, 20),
+        req_sample_log2=2,
+    )
+    st = rs.rstate
+    rec = oreq.reconcile_records(st.req_buf, st.req_head, st.req_counts)
+    assert all(v["match"] for v in rec.values()), rec
+    met = oreq.reconcile_metrics(st.req_counts, rm)
+    assert all(v["ok"] for v in met.values()), met
+    total = int(np.asarray(rm.route_queries).sum())
+    sampled = met["queries"]["sampled"]
+    assert 0 < sampled < total  # ~1/4 of a 5120-query storm
+    drained = rs.drain_requests(reset=True)
+    assert drained["drops"] == 0
+    assert len(drained["records"]) == sampled
+    assert drained["counts"]["queries"] == sampled
+    # reset starts a fresh window but keeps the monotone tick stamp
+    assert int(rs.rstate.req_head) == 0
+    assert int(rs.rstate.req_tick) == 20
+
+
+# -- capacity sizing + overflow honesty --------------------------------------
+
+
+def test_capacity_sizing_is_drop_free_at_worst_case():
+    """``req_capacity_for`` is the flight.max_events_per_tick contract
+    for the request plane: at sample_log2=0 (every request appends) a
+    window sized by it never drops — and the bound is EXACT, reached
+    by a quiet tick where every query is sendable."""
+    q, ticks = 256, 12
+    assert rt.max_requests_per_tick(q) == q
+    assert rt.req_capacity_for(q, ticks) == ticks * q
+    rs, _, rm = _run(
+        32,
+        ticks,
+        reqtrace=True,
+        req_capacity=rt.req_capacity_for(q, ticks),
+        req_sample_log2=0,
+    )
+    assert int(rs.rstate.req_drops) == 0
+    assert int(rs.rstate.req_head) == int(
+        np.asarray(rm.route_queries).sum()
+    )
+    # a quiet cluster saturates the per-tick bound exactly
+    quiet = RoutedStorm(
+        32,
+        params=_params(32),
+        route=_route(
+            32, reqtrace=True, req_capacity=2 * q, req_sample_log2=0
+        ),
+        seed=0,
+    )
+    quiet.run(StormSchedule(ticks=1, n=32))
+    assert int(quiet.rstate.req_head) == rt.max_requests_per_tick(q)
+
+
+def test_overflow_counts_never_overwrites():
+    """An undersized buffer keeps an HONEST PREFIX: head pins at cap,
+    every overflowing record bumps req_drops instead of clobbering, the
+    stored rows still reconcile as a prefix (records <= counters), and
+    the decoder annotates truncation."""
+    cap = 100  # << the ~5120 sendable requests of the storm
+    rs, _, rm = _run(
+        64, 20, reqtrace=True, req_capacity=cap, req_sample_log2=0
+    )
+    st = rs.rstate
+    total = int(np.asarray(rm.route_queries).sum())
+    assert int(st.req_head) == cap
+    assert int(st.req_drops) == total - cap
+    rec = oreq.reconcile_records(st.req_buf, st.req_head, st.req_counts)
+    for field, v in rec.items():
+        assert v["records"] <= v["counts"], (field, v)
+    # the prefix is the FIRST cap records: ticks are monotone from 1
+    arrs = oreq.decode_arrays(st.req_buf, st.req_head)
+    assert arrs["tick"][0] == 1
+    assert (np.diff(arrs["tick"]) >= 0).all()
+    reqs = oreq.decode_requests(st.req_buf, st.req_head, st.req_drops)
+    assert len(reqs) == cap
+    assert all(r["truncated_stream"] for r in reqs)
+    drained = rs.drain_requests(reset=True)
+    assert drained["drops"] == total - cap
+    # the counters kept counting THROUGH the overflow
+    assert drained["counts"]["queries"] == total
+
+
+# -- sampler unbiasedness (chi-square, Zipf mixes) ---------------------------
+
+
+def _chi2_binary(observed, trials, p):
+    e1 = trials * p
+    e0 = trials - e1
+    o1 = observed
+    o0 = trials - observed
+    return (o1 - e1) ** 2 / e1 + (o0 - e0) ** 2 / e0
+
+
+def test_sample_mask_chi_square_unbiased_over_key_space():
+    """Per-key Bernoulli decisions are uniform over the key space: for
+    each salt the sampled-key count over M distinct keys is a
+    Binomial(M, 2^-s) draw; the summed chi-square across 8 salts must
+    sit below the df=8 critical value at alpha=0.001 (26.12)."""
+    m, s = 4096, 2
+    kh = np.asarray(traffic.key_hashes(np.arange(m, dtype=np.int32)))
+    stat = 0.0
+    rates = []
+    for salt in (0x7E57A8, 1, 2, 3, 0xDEADBEEF, 17, 257, 65537):
+        mask = np.asarray(rt.sample_mask(kh, salt, s))
+        assert mask.shape == (m,)
+        stat += _chi2_binary(int(mask.sum()), m, 2.0**-s)
+        rates.append(mask.mean())
+    assert stat < 26.12, (stat, rates)
+
+
+def test_sample_mask_unbiased_under_zipf_traffic():
+    """The acceptance claim: sampling is per KEY, yet the sampled share
+    of TRAFFIC stays ~2^-s even when the traffic is heavily Zipf-skewed
+    (the top key draws ~14% of all queries) — averaged across salts the
+    per-key decisions wash out of the skew."""
+    m, s, q = 4096, 2, 1 << 16
+    kh = np.asarray(traffic.key_hashes(np.arange(m, dtype=np.int32)))
+    w = 1.0 / np.arange(1, m + 1) ** 1.1
+    w /= w.sum()
+    draws = np.random.default_rng(11).choice(m, size=q, p=w)
+    shares = []
+    for salt in (0x7E57A8, 1, 2, 3, 0xDEADBEEF, 17, 257, 65537):
+        mask = np.asarray(rt.sample_mask(kh, salt, s))
+        shares.append(float(mask[draws].mean()))
+        # no single salt collapses or saturates under the skew
+        assert 0.05 < shares[-1] < 0.6, (salt, shares[-1])
+    assert abs(np.mean(shares) - 2.0**-s) < 0.05, shares
+
+
+def test_sample_mask_rate_zero_and_consistency():
+    kh = np.asarray(traffic.key_hashes(np.arange(512, dtype=np.int32)))
+    assert np.asarray(rt.sample_mask(kh, 7, 0)).all()
+    a = np.asarray(rt.sample_mask(kh, 7, 3))
+    b = np.asarray(rt.sample_mask(kh, 7, 3))
+    np.testing.assert_array_equal(a, b)  # per-key, deterministic
+    c = np.asarray(rt.sample_mask(kh, 8, 3))
+    assert (a != c).any()  # a different salt picks a different subset
+
+
+# -- checkpoint neutrality ---------------------------------------------------
+
+
+def test_checkpoint_roundtrip_toggles_reqtrace_plane(tmp_path):
+    """A reqtrace-enabled storm checkpoint restores onto a reqtrace-off
+    storm (plane dropped) and vice versa (fresh window) — the knob is
+    trajectory-neutral in checkpoint params, and both resumes continue
+    metrics-bitwise-identically."""
+    n = 48
+    sched = StormSchedule.churn_storm(10, n, fraction=0.2, seed=4)
+
+    def mk(reqtrace):
+        kw = {}
+        if reqtrace:
+            kw = dict(
+                reqtrace=True,
+                req_capacity=rt.req_capacity_for(256, 10),
+                req_sample_log2=1,
+            )
+        return RoutedStorm(
+            n=n, params=_params(n), route=_route(n, **kw), seed=6
+        )
+
+    on = mk(True)
+    on.run(sched.window(0, 5))
+    assert int(on.rstate.req_head) > 0
+    path = str(tmp_path / "ck")
+    on.save(path)
+
+    off = mk(False)
+    off.load(path)
+    assert off.rstate.req_buf is None
+    on2 = mk(True)
+    on2.load(path)
+    # telemetry, not trajectory: the resume starts a fresh window
+    assert on2.rstate.req_buf is not None
+    assert int(on2.rstate.req_head) == 0
+    assert int(on2.rstate.req_tick) == 0
+
+    _assert_cluster_states_equal(off.cluster.state, on2.cluster.state)
+    em_a, rm_a = off.run(sched.window(5, 10))
+    em_b, rm_b = on2.run(sched.window(5, 10))
+    for f in rm_a._fields:
+        np.testing.assert_array_equal(
+            np.asarray(getattr(rm_a, f)), np.asarray(getattr(rm_b, f)), f
+        )
+    _assert_cluster_states_equal(off.cluster.state, on2.cluster.state)
+    assert off.ring_checksum() == on2.ring_checksum()
+
+
+def test_drain_requires_enabled():
+    rs, _, _ = _run(16, 4)
+    with pytest.raises(ValueError):
+        rs.drain_requests()

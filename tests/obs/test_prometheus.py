@@ -11,7 +11,9 @@ from ringpop_tpu.models.sim.cluster import EventSchedule, SimCluster
 from ringpop_tpu.net.timers import FakeTimers
 from ringpop_tpu.obs.prometheus import (
     PromWriter,
+    render_device_histograms,
     render_ringpop_metrics,
+    render_slo_plane,
     render_tick_series,
 )
 
@@ -134,3 +136,109 @@ def test_help_text_is_escaped_per_exposition_format():
     assert "x_total 1" in lines
     # label values keep their own (stricter) escaping, including quotes
     assert 'y{k="v\\"\\n\\\\"} 2' in lines
+
+
+def _parse_histogram(text, name):
+    """Parse one rendered histogram family back out of the exposition
+    text: ({le: cumulative}, sum, count, type)."""
+    buckets, hsum, hcount, type_ = {}, None, None, None
+    for line in text.splitlines():
+        if line == "# TYPE %s histogram" % name:
+            type_ = "histogram"
+        elif line.startswith(name + "_bucket"):
+            le = line.split('le="')[1].split('"')[0]
+            buckets[le] = int(line.rsplit(" ", 1)[1])
+        elif line.startswith(name + "_sum"):
+            hsum = float(line.rsplit(" ", 1)[1])
+        elif line.startswith(name + "_count"):
+            hcount = int(line.rsplit(" ", 1)[1])
+    return buckets, hsum, hcount, type_
+
+
+def test_histogram_family_round_trips_log2_buckets():
+    """ISSUE 19 satellite acceptance: render log2 bucket counts as a
+    native histogram, parse the text back, and recover the per-bucket
+    counts exactly — cumulative ordering, upper-edge le bounds, the
+    mandatory +Inf line, and _sum/_count intact."""
+    from ringpop_tpu.ops import histogram as hg
+
+    counts = [5, 3, 0, 0, 8, 0, 2] + [0] * (hg.NBUCKETS - 7)
+    w = PromWriter()
+    w.histogram("rt_depth", counts, "retry depth", {"run": "t1"})
+    text = w.render()
+    buckets, hsum, hcount, type_ = _parse_histogram(text, "rt_depth")
+    assert type_ == "histogram"
+    # one line per bucket up to the LAST occupied one, plus +Inf
+    assert set(buckets) == {
+        str(hg.bucket_hi(b)) for b in range(7)
+    } | {"+Inf"}
+    # cumulative series is nondecreasing and ends at the total
+    les = sorted(
+        (k for k in buckets if k != "+Inf"), key=lambda s: int(s)
+    )
+    cum = [buckets[k] for k in les]
+    assert cum == sorted(cum)
+    assert buckets["+Inf"] == cum[-1] == sum(counts)
+    # per-bucket counts recover exactly from the cumulative deltas
+    recovered = np.diff([0] + cum).tolist()
+    assert recovered == counts[:7]
+    # _count matches, _sum is the conservative upper-bound estimate
+    assert hcount == sum(counts)
+    assert hsum == float(
+        sum(c * hg.bucket_hi(b) for b, c in enumerate(counts))
+    )
+    # labels ride every line of the family
+    assert 'rt_depth_bucket{le="0",run="t1"} 5' in text
+    assert (
+        'rt_depth_bucket{le="+Inf",run="t1"} %d' % sum(counts) in text
+    )
+
+
+def test_histogram_sum_override_and_empty():
+    from ringpop_tpu.ops import histogram as hg
+
+    w = PromWriter()
+    w.histogram("empty", [0] * hg.NBUCKETS)
+    w.histogram("known", [2, 1] + [0] * (hg.NBUCKETS - 2), sum_value=1.5)
+    text = w.render()
+    eb, es, ec, _ = _parse_histogram(text, "empty")
+    assert eb == {"0": 0, "+Inf": 0} and es == 0.0 and ec == 0
+    kb, ks, kc, _ = _parse_histogram(text, "known")
+    assert ks == 1.5 and kc == 3
+
+
+def test_render_device_histograms_one_family_per_track():
+    from ringpop_tpu.ops import histogram as hg
+
+    hist = np.zeros((2, hg.NBUCKETS), np.int64)
+    hist[0, 1] = 7
+    hist[1, 3] = 2
+    text = render_device_histograms(
+        hist, ("retry_depth", "reroute_hops"), labels={"run": "x"}
+    )
+    a, _, ac, at = _parse_histogram(text, "ringpop_sim_retry_depth")
+    b, _, bc, bt = _parse_histogram(text, "ringpop_sim_reroute_hops")
+    assert at == bt == "histogram"
+    assert ac == 7 and bc == 2
+    assert a["+Inf"] == 7 and b["+Inf"] == 2
+
+
+def test_render_slo_plane_exposes_window_and_health():
+    from ringpop_tpu.obs import slo as oslo
+    from ringpop_tpu.ops import histogram as hg
+
+    plane = oslo.SLOWindowPlane(
+        oslo.SLOTarget(name="route", success_objective=0.999),
+        window_len=2,
+    )
+    counts = np.zeros(hg.NBUCKETS, np.int64)
+    counts[1] = 100
+    plane.observe(1, counts, queries=100, errors=50)  # a breach
+    text = render_slo_plane(plane, tick=1)
+    buckets, _, hcount, _ = _parse_histogram(text, "ringpop_slo_window")
+    assert hcount == 100 and buckets["+Inf"] == 100
+    assert 'target="route"' in text
+    assert 'ringpop_slo_window_queries{target="route"} 100' in text
+    assert 'ringpop_slo_window_errors{target="route"} 50' in text
+    assert 'ringpop_slo_breach{target="route"} 1' in text
+    assert "# TYPE ringpop_slo_burn_rate gauge" in text

@@ -1,0 +1,239 @@
+"""Host half of the request observatory: decoder round-trip,
+reconciliation, span trees, Perfetto export, and the ``reqtrace.drain``
+row riding a schema-valid runlog (pure host side — the device half is
+pinned in tests/models/test_reqtrace.py)."""
+
+from __future__ import annotations
+
+import importlib.util
+import json
+import os
+
+import numpy as np
+import pytest
+
+from ringpop_tpu.obs import chrome_trace as ct
+from ringpop_tpu.obs import requests as oreq
+
+REPO_ROOT = os.path.dirname(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+
+
+def _key(u):
+    """uint32 key hash -> the int32 slot value the device stores."""
+    return int(np.array([u], np.uint32).view(np.int32)[0])
+
+
+# four requests telling the full lifecycle story; key 0xBEEF repeats
+# (sampling is per key, so its trace is complete across ticks)
+_ROWS = [
+    # tick key              snd dst own mis rr                depth multi outcome
+    [1, _key(0x80000001), 3, 5, 5, 0, oreq.RR_NONE, 0, 0, 0],
+    [1, _key(0xBEEF), 0, 2, 4, 1, oreq.RR_REMOTE, 1, 0, 0],
+    [
+        2,
+        _key(7),
+        1,
+        6,
+        6,
+        0,
+        oreq.RR_LOCAL,
+        1,
+        0,
+        oreq.OUT_CHECKSUMS_DIFFER | oreq.OUT_CHECKSUM_REJECT,
+    ],
+    [2, _key(0xBEEF), 0, 2, 4, 1, oreq.RR_REMOTE, 1, 1, oreq.OUT_KEYS_DIVERGED],
+]
+
+_COUNTS = [4, 2, 1, 2, 1, 1, 1]  # matches COUNT_FIELDS order
+
+
+def _buf(cap=8):
+    buf = np.zeros((cap, oreq.RECORD_WIDTH), np.int32)
+    buf[: len(_ROWS)] = np.asarray(_ROWS, np.int32)
+    return buf, len(_ROWS)
+
+
+def test_decode_arrays_recovers_uint32_keys():
+    buf, head = _buf()
+    arrs = oreq.decode_arrays(buf, head)
+    assert set(arrs) == set(oreq.FIELDS)
+    assert arrs["key"].dtype == np.uint32
+    assert arrs["key"][0] == 0x80000001  # sign-bit key survives bitcast
+    assert list(arrs["tick"]) == [1, 1, 2, 2]
+    with pytest.raises(ValueError):
+        oreq.decode_arrays(np.zeros((4, 3), np.int32), 4)
+
+
+def test_decode_requests_annotates_truncation():
+    buf, head = _buf()
+    clean = oreq.decode_requests(buf, head, drops=0)
+    assert len(clean) == head
+    assert "truncated_stream" not in clean[0]
+    cut = oreq.decode_requests(buf, head, drops=5)
+    assert all(r["truncated_stream"] for r in cut)
+
+
+def test_counts_dict_validates_shape():
+    assert oreq.counts_dict(_COUNTS)["queries"] == 4
+    with pytest.raises(ValueError):
+        oreq.counts_dict([1, 2, 3])
+
+
+def test_reconcile_records_exact_and_prefix():
+    buf, head = _buf()
+    rec = oreq.reconcile_records(buf, head, _COUNTS)
+    assert set(rec) == set(oreq.COUNT_FIELDS)
+    assert all(v["match"] for v in rec.values()), rec
+    # a dropped tail shows as records < counts, never records > counts
+    short = oreq.reconcile_records(buf, head - 1, _COUNTS)
+    assert not short["queries"]["match"]
+    assert all(
+        v["records"] <= v["counts"] for v in short.values()
+    ), short
+
+
+def test_reconcile_metrics_subset_vs_totals():
+    metrics = {
+        "route_queries": np.array([8, 8]),
+        "route_misroutes": np.array([2, 1]),
+        "route_reroute_local": np.array([1, 0]),
+        "route_reroute_remote": np.array([1, 1]),
+        "route_keys_diverged": np.array([1, 0]),
+        "route_checksums_differ": np.array([1, 1]),
+        "route_checksum_rejects": np.array([1, 0]),
+    }
+    out = oreq.reconcile_metrics(_COUNTS, metrics)
+    assert all(v["ok"] for v in out.values()), out
+    assert out["queries"] == {"sampled": 4, "total": 16, "ok": True}
+    # an impossible sampled > total is flagged, not silently accepted
+    bad = list(_COUNTS)
+    bad[1] = 99
+    assert not oreq.reconcile_metrics(bad, metrics)["misroutes"]["ok"]
+
+
+def test_outcome_label_precedence():
+    buf, head = _buf()
+    reqs = oreq.decode_requests(buf, head)
+    assert [oreq.outcome_label(r) for r in reqs] == [
+        "ok",
+        "reroute.remote",
+        "reject.checksum",  # reject outranks the local reroute
+        "abort.keys-diverged",  # abort outranks everything
+    ]
+
+
+def test_span_trees_group_per_key_complete_lifecycle():
+    buf, head = _buf()
+    reqs = oreq.decode_requests(buf, head)
+    trees = oreq.span_trees(reqs)
+    assert set(trees) == {0x80000001, 0xBEEF, 7}
+    # the sampled key's two requests arrive tick-ordered
+    beef = trees[0xBEEF]
+    assert [s["tick"] for s in beef] == [1, 2]
+    # first: retry with a remote reroute child to the truth owner
+    retry = beef[0]["children"][0]
+    assert retry["name"] == "retry"
+    assert retry["children"][0] == {"name": "reroute.remote", "dest": 4}
+    # second: the multi-key pair diverged inside the retry
+    names = [c["name"] for c in beef[1]["children"][0]["children"]]
+    assert "abort.keys-diverged" in names
+    # the checksum story carries its reject verdict
+    ck = trees[7][0]["children"][0]
+    assert ck == {"name": "checksums-differ", "rejected": True}
+    with pytest.raises(TypeError):
+        oreq.span_trees([(1, 2), (3, 4)])
+
+
+def test_export_request_trace_validates_and_flows():
+    buf, head = _buf()
+    reqs = oreq.decode_requests(buf, head)
+    trace = oreq.export_request_trace(reqs, n=8, period_ms=200)
+    assert ct.validate_chrome_trace(json.dumps(trace)) == []
+    evs = trace["traceEvents"]
+    # one process meta + one thread meta per distinct sender
+    assert sum(1 for e in evs if e["ph"] == "M") == 1 + 3
+    spans = [e for e in evs if e["ph"] == "X"]
+    assert len(spans) == len(reqs)
+    # a retried request spans two protocol periods
+    durs = {e["name"]: e["dur"] for e in spans}
+    assert durs["ok"] == 200_000
+    assert durs["reroute.remote"] == 400_000
+    # both remote reroutes draw a flow arrow to the truth owner's track
+    starts = [e for e in evs if e["ph"] == "s"]
+    ends = [e for e in evs if e["ph"] == "t"]
+    assert len(starts) == len(ends) == 2
+    assert {e["tid"] for e in ends} == {4}
+    assert {e["id"] for e in starts} == {e["id"] for e in ends}
+
+
+def _load_checker():
+    spec = importlib.util.spec_from_file_location(
+        "check_metrics_schema",
+        os.path.join(REPO_ROOT, "scripts", "check_metrics_schema.py"),
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_drain_rides_a_schema_valid_runlog(tmp_path):
+    """obs.requests.drain logs ONE reqtrace.drain event row that the
+    repo's schema gate accepts, and the Perfetto sidecar written next
+    to it validates — the committed-artifact path end to end."""
+    from ringpop_tpu.obs.recorder import RunRecorder, read_run_log
+
+    buf, head = _buf()
+    path = str(tmp_path / "req.runlog.jsonl")
+    rec = RunRecorder(path, run_id="t", config={})
+    out = oreq.drain(
+        buf, head, 0, _COUNTS, sample_log2=2, recorder=rec
+    )
+    assert out["records"] == oreq.decode_requests(buf, head)
+    assert out["cap"] == buf.shape[0]
+    assert out["counts"] == oreq.counts_dict(_COUNTS)
+    rec.record_trace_sidecar(
+        oreq.export_request_trace(out["records"], n=8), name="requests"
+    )
+    rec.finish()
+    rows = read_run_log(path)["events"]
+    drains = [r for r in rows if r["name"] == "reqtrace.drain"]
+    assert len(drains) == 1
+    assert drains[0]["records"] == head
+    assert drains[0]["counts"]["queries"] == 4
+    checker = _load_checker()
+    assert checker.check([path], verbose=False) == []
+
+
+def test_drain_row_missing_count_field_fails_the_gate(tmp_path):
+    """The schema gate is not vacuous: a drain row whose counts object
+    lost a counter (recorder drift) is rejected."""
+    checker = _load_checker()
+    good = oreq.drain_row("route", 4, 0, 8, 2, oreq.counts_dict(_COUNTS))
+    bad = dict(good, counts={"queries": 4})
+    log = tmp_path / "bad.runlog.jsonl"
+    header = json.dumps(
+        {
+            "kind": "header",
+            "schema": 1,
+            "run_id": "r",
+            "config": {},
+            "provenance": {},
+        }
+    )
+    log.write_text(
+        header
+        + "\n"
+        + json.dumps(dict(bad, kind="event", name="reqtrace.drain"))
+        + "\n"
+    )
+    problems = checker.check([str(log)], verbose=False)
+    assert problems, "checker accepted a counts object missing fields"
+    log.write_text(
+        header
+        + "\n"
+        + json.dumps(dict(good, kind="event", name="reqtrace.drain"))
+        + "\n"
+    )
+    assert checker.check([str(log)], verbose=False) == []

@@ -258,3 +258,114 @@ def test_mesh_event_rows_validated(tmp_path):
     # a complete row alone passes
     log.write_text(_header_line() + "\n" + json.dumps(good_window) + "\n")
     assert checker.check([str(log)], verbose=False) == []
+
+
+def test_reqtrace_and_slo_fields_stay_in_lockstep_with_obs():
+    """Round-19 observatory rows: the checker's static registries ARE
+    the obs-package registries — a renamed sampled counter, a changed
+    percentile set, or a drifted row builder fails here (the checker
+    must not import the package, so the copies are pinned)."""
+    import numpy as np
+
+    from ringpop_tpu.obs import requests as oreq
+    from ringpop_tpu.obs import slo as oslo
+    from ringpop_tpu.ops import histogram as hg
+
+    checker = _load_checker()
+    assert checker.REQTRACE_COUNT_FIELDS == oreq.COUNT_FIELDS
+    assert checker.SLO_WINDOW_QS == oslo.WINDOW_QS
+    # the drain-row builder produces exactly the required field set
+    row = oreq.drain_row(
+        "route", 0, 0, 8, 2, {f: 0 for f in oreq.COUNT_FIELDS}
+    )
+    assert set(checker.ROUTE_EVENT_FIELDS["reqtrace.drain"]) == set(row)
+    # the window row carries the required set plus the percentile keys
+    plane = oslo.SLOWindowPlane()
+    plane.observe(1, np.zeros(hg.NBUCKETS), queries=1, errors=0)
+    wrow = plane.window_row(1)
+    want = set(checker.ROUTE_EVENT_FIELDS["slo.window"]) | {
+        "p%d" % q for q in checker.SLO_WINDOW_QS
+    }
+    assert set(wrow) == want
+    # the breach row names exactly the required fields (+ its p99)
+    assert set(checker.ROUTE_EVENT_FIELDS["slo.breach"]) | {"p99"} == {
+        "target",
+        "tick",
+        "window_ticks",
+        "reason",
+        "burn_rate",
+        "success_rate",
+        "p99",
+    }
+
+
+def test_observatory_request_rows_validated(tmp_path):
+    """Round-19 rows: a reqtrace.drain whose counts object lost a
+    counter, or an slo.window missing a percentile key, is a drifted
+    recorder, not a valid artifact."""
+    import json
+
+    checker = _load_checker()
+    log = tmp_path / "req.runlog.jsonl"
+    good_drain = {
+        "kind": "event",
+        "name": "reqtrace.drain",
+        "source": "route",
+        "records": 4,
+        "drops": 0,
+        "cap": 64,
+        "sample_log2": 2,
+        "counts": {f: 0 for f in checker.REQTRACE_COUNT_FIELDS},
+    }
+    bad_drain = dict(good_drain)
+    bad_drain["counts"] = {"queries": 4}  # lost its counters
+    good_window = {
+        "kind": "event",
+        "name": "slo.window",
+        "target": "route",
+        "tick": 5,
+        "window_ticks": 20,
+        "windows": 4,
+        "queries": 100,
+        "errors": 0,
+        "p50": None,  # empty window: None is VALID, absence is not
+        "p95": None,
+        "p99": None,
+        "success_rate": 1.0,
+        "burn_rate": 0.0,
+        "breach": False,
+        "breach_reason": "",
+    }
+    bad_window = {
+        k: v for k, v in good_window.items() if k != "p99"
+    }
+    log.write_text(
+        "\n".join(
+            [
+                _header_line(),
+                json.dumps(good_drain),
+                json.dumps(bad_drain),
+                json.dumps(good_window),
+                json.dumps(bad_window),
+                json.dumps({"kind": "event", "name": "slo.breach"}),
+            ]
+        )
+        + "\n"
+    )
+    problems = checker.check([str(log)], verbose=False)
+    assert any(
+        "reqtrace.drain counts missing 'misroutes'" in p
+        for p in problems
+    )
+    assert any("slo.window row missing 'p99'" in p for p in problems)
+    assert any(
+        "slo.breach event missing 'reason'" in p for p in problems
+    )
+    # the complete rows alone pass
+    log.write_text(
+        "\n".join(
+            [_header_line(), json.dumps(good_drain), json.dumps(good_window)]
+        )
+        + "\n"
+    )
+    assert checker.check([str(log)], verbose=False) == []

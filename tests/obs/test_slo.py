@@ -1,0 +1,242 @@
+"""Sliding-window SLO plane: windowed percentiles pinned against a
+host-numpy nearest-rank oracle (the ISSUE 19 acceptance), burn-rate
+math, breach fire/clear/eviction, schema-valid rows, and the
+backpressure consumer hook."""
+
+from __future__ import annotations
+
+import importlib.util
+import os
+
+import numpy as np
+import pytest
+
+from ringpop_tpu.obs import slo as oslo
+from ringpop_tpu.ops import histogram as hg
+
+REPO_ROOT = os.path.dirname(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+
+
+def _counts_of(samples):
+    counts = np.zeros(hg.NBUCKETS, np.int64)
+    np.add.at(counts, hg.bucket_index_np(samples), 1)
+    return counts
+
+
+def _oracle(pooled_samples, q):
+    """Nearest-rank percentile of the RAW samples, reported as its log2
+    bucket's upper bound — what a bucketed histogram must answer."""
+    arr = np.sort(np.asarray(pooled_samples))
+    rank = max(1, int(np.ceil(q / 100.0 * arr.size)))
+    return hg.bucket_hi(int(hg.bucket_index_np(arr[rank - 1 : rank])[0]))
+
+
+def test_windowed_percentiles_match_numpy_oracle():
+    """The acceptance pin: after every observe(), each sliding-window
+    percentile equals the nearest-rank percentile of the pooled RAW
+    observations of the held windows (bucketing is monotone, so the
+    bucket of the nearest-rank raw sample IS the nearest-rank bucket)."""
+    rng = np.random.default_rng(5)
+    plane = oslo.SLOWindowPlane(window_len=3)
+    held = []
+    for w in range(7):
+        # heavy-tailed raw latencies, a different scale each window
+        samples = rng.integers(0, 1 << (3 + 2 * (w % 4)), size=500)
+        held.append(samples)
+        held = held[-3:]
+        row = plane.observe(w, _counts_of(samples), queries=500, errors=0)
+        pooled = np.concatenate(held)
+        for q in oslo.WINDOW_QS:
+            assert row["p%d" % q] == _oracle(pooled, q), (w, q)
+        assert row["windows"] == len(held)
+
+
+def test_ring_eviction_and_pooling():
+    plane = oslo.SLOWindowPlane(window_len=2)
+    a, b, c = (np.zeros(hg.NBUCKETS, np.int64) for _ in range(3))
+    a[1], b[2], c[3] = 10, 20, 30
+    plane.observe(1, a, queries=10, errors=1, ticks=4)
+    plane.observe(2, b, queries=20, errors=2, ticks=4)
+    row = plane.observe(3, c, queries=30, errors=3, ticks=4)
+    # window a evicted: only b+c pooled
+    want = b + c
+    np.testing.assert_array_equal(plane.window_counts(), want)
+    assert row["windows"] == 2
+    assert row["window_ticks"] == 8
+    assert row["queries"] == 50 and row["errors"] == 5
+
+
+def test_empty_window_percentiles_are_none():
+    plane = oslo.SLOWindowPlane()
+    row = plane.observe(0, np.zeros(hg.NBUCKETS), queries=0, errors=0)
+    assert row["p50"] is None and row["p99"] is None
+    assert row["success_rate"] == 1.0 and row["burn_rate"] == 0.0
+    assert not row["breach"]
+
+
+def test_burn_rate_math():
+    assert oslo.burn_rate(0, 0, 0.999) == 0.0
+    assert oslo.burn_rate(0, 1000, 0.999) == 0.0
+    assert oslo.burn_rate(5, 0, 0.999) == 0.0  # no queries, no burn
+    # 1 error / 1000 queries against a 0.1% budget burns at exactly 1x
+    assert oslo.burn_rate(1, 1000, 0.999) == pytest.approx(1.0)
+    assert oslo.burn_rate(2, 1000, 0.999) == pytest.approx(2.0)
+    # a 100% objective has zero budget: any error burns at +inf
+    assert oslo.burn_rate(1, 10, 1.0) == float("inf")
+
+
+def test_breach_fires_and_clears():
+    plane = oslo.SLOWindowPlane(
+        oslo.SLOTarget(
+            name="route", success_objective=0.999, burn_alert=2.0
+        ),
+        window_len=2,
+    )
+    zero = np.zeros(hg.NBUCKETS)
+    clean = plane.observe(1, zero, queries=1000, errors=0)
+    assert not clean["breach"] and plane.breaches == 0
+    burst = plane.observe(2, zero, queries=1000, errors=50)
+    assert burst["breach"]
+    # the burst violates both the objective and the fast-burn alert
+    assert burst["breach_reason"] == "success-rate,burn-rate"
+    assert burst["burn_rate"] == pytest.approx((50 / 2000) / 0.001)
+    assert plane.breaches == 1
+    # one clean window still holds the burst (sliding!), two evict it
+    assert plane.observe(3, zero, queries=1000, errors=0)["breach"]
+    cleared = plane.observe(4, zero, queries=1000, errors=0)
+    assert not cleared["breach"] and cleared["breach_reason"] == ""
+    assert plane.breaches == 2
+
+
+def test_p99_ceiling_breach():
+    counts = np.zeros(hg.NBUCKETS, np.int64)
+    counts[6] = 100  # every observation in [32, 63]
+    plane = oslo.SLOWindowPlane(
+        oslo.SLOTarget(p99_max=31, burn_alert=2.0), window_len=1
+    )
+    row = plane.observe(1, counts, queries=100, errors=0)
+    assert row["p99"] == 63
+    assert row["breach"] and row["breach_reason"] == "p99"
+    # a roomier ceiling clears it
+    ok = oslo.SLOWindowPlane(
+        oslo.SLOTarget(p99_max=63), window_len=1
+    ).observe(1, counts, queries=100, errors=0)
+    assert not ok["breach"]
+
+
+def test_validation():
+    with pytest.raises(ValueError):
+        oslo.SLOWindowPlane(window_len=0)
+    plane = oslo.SLOWindowPlane()
+    with pytest.raises(ValueError):
+        plane.observe(0, np.zeros(3), queries=1, errors=0)
+    with pytest.raises(ValueError):
+        oslo.SLOBackpressure(max_factor=0.5)
+
+
+def test_backpressure_consumer_hook():
+    bp = oslo.SLOBackpressure(base_period_ms=200.0, max_factor=8.0)
+    plane = oslo.SLOWindowPlane(
+        oslo.SLOTarget(success_objective=0.999, burn_alert=2.0),
+        window_len=1,
+        consumer=bp,
+    )
+    zero = np.zeros(hg.NBUCKETS)
+    plane.observe(1, zero, queries=1000, errors=0)
+    assert bp.factor() == 1.0 and bp.period_ms() == 200.0
+    # burn 5x -> period stretches 5x
+    plane.observe(2, zero, queries=1000, errors=5)
+    assert bp.factor() == pytest.approx(5.0)
+    assert bp.period_ms() == pytest.approx(1000.0)
+    # a catastrophic burn clamps at max_factor
+    plane.observe(3, zero, queries=1000, errors=500)
+    assert bp.factor() == 8.0
+    # the window clearing snaps back to base
+    plane.observe(4, zero, queries=1000, errors=0)
+    assert bp.factor() == 1.0 and bp.period_ms() == 200.0
+
+
+def test_observe_route_window_feeds_from_drained_telemetry():
+    """The routing-plane feeder: one drained histogram window + the
+    window's RouteMetrics stack become (counts, queries, errors) with
+    the requestProxy failure surface as errors."""
+    from ringpop_tpu.models.route.plane import (
+        ROUTE_HIST_TRACKS,
+        RoutedStorm,
+        RouteParams,
+    )
+    from ringpop_tpu.models.sim import engine_scalable as es
+    from ringpop_tpu.models.sim.storm import StormSchedule
+
+    n = 32
+    rs = RoutedStorm(
+        n,
+        params=es.ScalableParams(n=n, u=192, suspicion_ticks=4),
+        route=RouteParams(
+            n=n, queries_per_tick=256, key_space=1024, histograms=True
+        ),
+        seed=2,
+    )
+    _, rm = rs.run(
+        StormSchedule.churn_storm(8, n, fraction=0.2, seed=2)
+    )
+    hist = np.asarray(rs.rstate.hist)
+    plane = oslo.SLOWindowPlane(window_len=4)
+    row = plane.observe_route_window(8, hist, rm)
+    assert row["window_ticks"] == 8
+    assert row["queries"] == int(np.asarray(rm.route_queries).sum())
+    want_errors = int(
+        np.asarray(rm.route_misroutes).sum()
+        + np.asarray(rm.route_checksum_rejects).sum()
+        + np.asarray(rm.route_keys_diverged).sum()
+    )
+    assert row["errors"] == want_errors
+    # the pooled window IS the drained retry_depth track
+    np.testing.assert_array_equal(
+        plane.window_counts(),
+        hist[ROUTE_HIST_TRACKS.index("retry_depth")].astype(np.int64),
+    )
+    # retry_depth p-values come from buckets {0,1}: hi in {0,1}
+    assert row["p50"] in (0, 1)
+
+
+def _load_checker():
+    spec = importlib.util.spec_from_file_location(
+        "check_metrics_schema",
+        os.path.join(REPO_ROOT, "scripts", "check_metrics_schema.py"),
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_slo_rows_ride_a_schema_valid_runlog(tmp_path):
+    """Every observe() emits one slo.window row — and a breach one
+    slo.breach row — that the repo's schema gate accepts."""
+    from ringpop_tpu.obs.recorder import RunRecorder, read_run_log
+
+    path = str(tmp_path / "slo.runlog.jsonl")
+    rec = RunRecorder(path, run_id="t", config={})
+    plane = oslo.SLOWindowPlane(
+        oslo.SLOTarget(success_objective=0.999, burn_alert=2.0),
+        window_len=2,
+        recorder=rec,
+    )
+    counts = np.zeros(hg.NBUCKETS, np.int64)
+    counts[2] = 100
+    plane.observe(1, counts, queries=1000, errors=0)
+    plane.observe(2, counts, queries=1000, errors=100)
+    rec.finish()
+    events = read_run_log(path)["events"]
+    assert [e["name"] for e in events] == [
+        "slo.window",
+        "slo.window",
+        "slo.breach",
+    ]
+    breach = events[-1]
+    assert breach["reason"] == "success-rate,burn-rate"
+    assert breach["p99"] == 3  # bucket 2 hi
+    checker = _load_checker()
+    assert checker.check([path], verbose=False) == []

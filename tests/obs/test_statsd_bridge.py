@@ -209,3 +209,107 @@ def test_key_map_covers_both_engines():
     for fields in (TickMetrics._fields, ScalableMetrics._fields):
         for f in fields:
             assert f in TICK_KEY_MAP or f in unmapped_ok, f
+
+
+def test_reqtrace_key_map_stays_in_lockstep_with_count_fields():
+    """ISSUE 19 keys: every sampled-subset counter (obs.requests
+    .COUNT_FIELDS) maps to an increment under sim.reqtrace.sampled.*,
+    record/drop volume to increments, the sampling rate to a gauge —
+    drift in either direction fails here."""
+    from ringpop_tpu.obs import requests as oreq
+    from ringpop_tpu.obs.statsd_bridge import REQTRACE_KEY_MAP
+
+    assert set(REQTRACE_KEY_MAP) == set(oreq.COUNT_FIELDS) | {
+        "records",
+        "drops",
+        "sample_log2",
+    }
+    for f in oreq.COUNT_FIELDS:
+        stat_type, key = REQTRACE_KEY_MAP[f]
+        assert stat_type == "increment", f
+        assert key.startswith("sim.reqtrace.sampled."), f
+    assert REQTRACE_KEY_MAP["records"][0] == "increment"
+    assert REQTRACE_KEY_MAP["drops"][0] == "increment"
+    assert REQTRACE_KEY_MAP["sample_log2"][0] == "gauge"
+
+
+def test_emit_reqtrace_drain_wire_types():
+    """Zero counters are suppressed (statsd increments are deltas), the
+    sampling-rate gauge always emits, and the nested counts object is
+    flattened onto the key map."""
+    from ringpop_tpu.obs import requests as oreq
+
+    cap = CapturingStatsd()
+    bridge = StatsdBridge(statsd=cap, host_port="127.0.0.1:4080")
+    row = oreq.drain_row(
+        "route",
+        records=42,
+        drops=0,  # zero counter: suppressed
+        cap=1280,  # unmapped: ignored
+        sample_log2=2,
+        counts={
+            "queries": 42,
+            "misroutes": 5,
+            "reroute_local": 0,
+            "reroute_remote": 5,
+            "keys_diverged": 0,
+            "checksums_differ": 1,
+            "checksum_rejects": 1,
+        },
+    )
+    emitted = bridge.emit_reqtrace_drain(row)
+    prefix = "ringpop.127_0_0_1_4080."
+    incs = {r[1]: r[2] for r in cap.records if r[0] == "increment"}
+    assert incs[prefix + "sim.reqtrace.records"] == 42
+    assert incs[prefix + "sim.reqtrace.sampled.queries"] == 42
+    assert incs[prefix + "sim.reqtrace.sampled.reroute.remote"] == 5
+    assert not any("drops" in r[1] for r in cap.records)
+    assert not any("reroute.local" in r[1] for r in cap.records)
+    assert not any(".cap" in r[1] for r in cap.records)
+    gauges = [r for r in cap.records if r[0] == "gauge"]
+    assert gauges == [
+        ("gauge", prefix + "sim.reqtrace.sample-log2", 2)
+    ]
+    assert emitted == len(cap.records)
+
+
+def test_slo_key_map_and_emit_wire_types():
+    """slo.window rows emit under slo.<target>.*: windowed percentiles
+    as |ms TIMER samples (None = empty window = skipped), health ratios
+    as gauges, window volume as nonzero-only increments; a breach ticks
+    slo.<target>.breach."""
+    from ringpop_tpu.obs import slo as oslo
+    from ringpop_tpu.obs.statsd_bridge import SLO_KEY_MAP
+
+    for q in oslo.WINDOW_QS:
+        assert SLO_KEY_MAP["p%d" % q][0] == "timing"
+    assert SLO_KEY_MAP["success_rate"] == ("gauge", "success-rate")
+    assert SLO_KEY_MAP["burn_rate"] == ("gauge", "burn-rate")
+    assert SLO_KEY_MAP["queries"][0] == "increment"
+    assert SLO_KEY_MAP["errors"][0] == "increment"
+
+    cap = CapturingStatsd()
+    bridge = StatsdBridge(statsd=cap, host_port="127.0.0.1:4081")
+    row = {
+        "target": "route",
+        "p50": 0,
+        "p95": 1,
+        "p99": None,  # empty-window percentile: skipped
+        "success_rate": 0.99,
+        "burn_rate": 10.0,
+        "queries": 1000,
+        "errors": 0,  # zero counter: suppressed
+        "breach": True,  # unmapped: rides emit_slo_breach
+    }
+    bridge.emit_slo_window(row)
+    bridge.emit_slo_breach("route")
+    prefix = "ringpop.127_0_0_1_4081.slo.route."
+    assert ("timing", prefix + "p50", 0) in cap.records
+    assert ("timing", prefix + "p95", 1) in cap.records
+    assert not any(r[1].endswith(".p99") for r in cap.records)
+    assert ("gauge", prefix + "burn-rate", 10.0) in cap.records
+    incs = {r[1]: r[2] for r in cap.records if r[0] == "increment"}
+    assert incs == {
+        prefix + "window.queries": 1000,
+        prefix + "breach": 1,
+    }
